@@ -1,0 +1,26 @@
+"""bigdl_tpu: a TPU-native distributed deep-learning framework with the
+capabilities of the original BigDL (reference: dgur1n/BigDL, surveyed in
+SURVEY.md), rebuilt from scratch on JAX/XLA/pjit/Pallas.
+
+Layer map (SURVEY.md §1 -> here):
+  tensor/TensorNumeric + MKL JNI  -> jax.Array + XLA (common.py dtype policy)
+  nn/ (Torch-style modules)       -> bigdl_tpu.nn (pure-functional core +
+                                     stateful facade)
+  dataset/                        -> bigdl_tpu.dataset
+  optim/ + parameters/ (Spark PS) -> bigdl_tpu.optim (pjit step, psum over ICI)
+  utils/Engine (topology)         -> bigdl_tpu.utils.Engine (jax.sharding.Mesh)
+  visualization/                  -> bigdl_tpu.visualization
+  models/                         -> bigdl_tpu.models
+  parallel (net-new: TP/SP/PP)    -> bigdl_tpu.parallel
+"""
+
+__version__ = "0.1.0"
+
+from . import common
+from .common import DTypePolicy, get_policy, set_policy, set_seed
+from .utils import Engine, Table, T, RandomGenerator, RNG
+from . import nn
+from . import optim
+from . import dataset
+from . import models
+from . import parallel

@@ -1,0 +1,97 @@
+"""Global configuration: dtype policy, default RNG stream, small helpers.
+
+TPU-native re-design notes
+--------------------------
+The reference (BigDL, /root/reference) threads a `TensorNumeric[T]` typeclass through
+every op so the same layer code runs at Float or Double precision
+(tensor/TensorNumeric.scala:21).  On TPU the analogous global knob is the *dtype
+policy*: parameters are kept in `param_dtype` (float32 by default) while compute and
+the gradient wire format may be bfloat16 — mirroring BigDL's bf16-truncated gradient
+wire format (parameters/FP16CompressedTensor.scala:271-279, which keeps the top 16
+bits of an IEEE float32, i.e. exactly bfloat16).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "DTypePolicy",
+    "get_policy",
+    "set_policy",
+    "get_default_rng",
+    "set_seed",
+    "next_rng_key",
+]
+
+
+class DTypePolicy:
+    """Dtype policy: param storage dtype, compute dtype, and wire (collective) dtype."""
+
+    def __init__(self, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                 wire_dtype=jnp.bfloat16):
+        self.param_dtype = param_dtype
+        self.compute_dtype = compute_dtype
+        # Gradients cross chips in this dtype (bf16 == BigDL's "FP16" truncation wire
+        # format, parameters/FP16CompressedTensor.scala:271-279).
+        self.wire_dtype = wire_dtype
+
+    def __repr__(self):
+        return (f"DTypePolicy(param={jnp.dtype(self.param_dtype).name}, "
+                f"compute={jnp.dtype(self.compute_dtype).name}, "
+                f"wire={jnp.dtype(self.wire_dtype).name})")
+
+
+_policy = DTypePolicy()
+
+
+def get_policy() -> DTypePolicy:
+    return _policy
+
+
+def set_policy(policy: DTypePolicy) -> None:
+    global _policy
+    _policy = policy
+
+
+class _RngStream:
+    """Host-side deterministic key stream (the facade's hidden RNG).
+
+    Plays the role of BigDL's thread-local RandomGenerator singleton
+    (utils/RandomGenerator.scala:23-35), re-designed as an explicit splittable
+    JAX PRNG key stream.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.reset(seed)
+
+    def reset(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = seed
+            self._key = jax.random.key(seed)
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_default_stream = _RngStream(int(os.environ.get("BIGDL_TPU_SEED", "0")))
+
+
+def get_default_rng() -> _RngStream:
+    return _default_stream
+
+
+def set_seed(seed: int) -> None:
+    """Global deterministic seed (BigDL: RandomGenerator.RNG.setSeed)."""
+    _default_stream.reset(seed)
+
+
+def next_rng_key():
+    return _default_stream.next_key()

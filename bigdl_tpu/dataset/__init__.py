@@ -1,0 +1,162 @@
+"""DataSet: data sources feeding the Optimizer.
+
+Reference: BigDL `dataset/DataSet.scala` — `AbstractDataSet[D,Seq]` (:46 —
+`data(train)/shuffle()/size()`), `LocalArrayDataSet` (:128), `DistributedDataSet`
+backed by cached RDD partitions (:164,240 — one cached `Array[T]` per partition
+plus a cached shuffled index array :251-299, infinite wraparound iterator for
+training :267-287), and the `object DataSet` builders (:319 — `array`, `rdd`,
+`ImageFolder`, `SeqFileFolder`).
+
+TPU-native re-design: Spark RDD caching collapses into per-process numpy arrays.
+`DistributedDataSet` here means *per-host sharding*: each JAX process holds
+1/process_count of the records (the reference's coalesce-to-nodeNumber-partitions,
+DataSet.scala:336-364); device-level sharding happens when the Optimizer
+device_puts a global batch with a NamedSharding over the 'data' mesh axis.
+Shuffling uses a seeded permutation identical on every process so global batches
+stay consistent (the reference instead shuffles a cached index array per
+partition, DataSet.scala:251-299).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .sample import Sample, MiniBatch, PaddingParam, FixedLength
+from .transformer import (Transformer, ChainedTransformer, SampleToMiniBatch,
+                          Identity)
+
+__all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
+           "TransformedDataSet", "DataSet", "Sample", "MiniBatch",
+           "PaddingParam", "FixedLength", "Transformer", "ChainedTransformer",
+           "SampleToMiniBatch", "Identity"]
+
+
+class AbstractDataSet:
+    """(reference: dataset/DataSet.scala:46)."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def data(self, train: bool) -> Iterator:
+        """One pass over the (transformed) records; the Optimizer re-calls this
+        each epoch (the reference uses an infinite wraparound iterator instead,
+        DataSet.scala:267-287)."""
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        """reference: `dataset -> transformer` (DataSet.scala:70)."""
+        return TransformedDataSet(self, transformer)
+
+    __rshift__ = transform
+
+
+class LocalArrayDataSet(AbstractDataSet):
+    """In-memory record list (reference: dataset/DataSet.scala:128)."""
+
+    def __init__(self, records: Sequence, seed: int = 1):
+        self.records = list(records)
+        self._perm = np.arange(len(self.records))
+        self._rng = np.random.default_rng(seed)
+
+    def size(self) -> int:
+        return len(self.records)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._perm)
+
+    def data(self, train: bool) -> Iterator:
+        order = self._perm if train else np.arange(len(self.records))
+        for i in order:
+            yield self.records[i]
+
+
+class DistributedDataSet(AbstractDataSet):
+    """Per-host sharded records (reference: CachedDistriDataSet,
+    dataset/DataSet.scala:240).
+
+    All processes construct it with the FULL record list (or a loader that can
+    produce any index); each keeps only its `process_index`-th shard resident.
+    `size()` reports the GLOBAL count; shuffles are seed-synchronized so every
+    host walks the same global permutation.
+    """
+
+    def __init__(self, records: Sequence, seed: int = 1,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        import jax
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        self._all = list(records)
+        self._rng = np.random.default_rng(seed)
+        self._perm = np.arange(len(self._all))
+
+    def size(self) -> int:
+        return len(self._all)
+
+    def local_size(self) -> int:
+        return len(range(self.process_index, len(self._all), self.process_count))
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._perm)
+
+    def data(self, train: bool) -> Iterator:
+        order = self._perm if train else np.arange(len(self._all))
+        # strided shard over the global permutation -> per-host local records
+        for i in order[self.process_index::self.process_count]:
+            yield self._all[i]
+
+
+class TransformedDataSet(AbstractDataSet):
+    """DataSet + transformer chain (reference: DataSet.transform,
+    DataSet.scala:70)."""
+
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self.base,
+                                  ChainedTransformer(self.transformer,
+                                                     transformer))
+
+    __rshift__ = transform
+
+
+class DataSet:
+    """Builder namespace (reference: object DataSet, dataset/DataSet.scala:319)."""
+
+    @staticmethod
+    def array(records, distributed: bool = False, seed: int = 1):
+        if distributed:
+            return DistributedDataSet(records, seed=seed)
+        return LocalArrayDataSet(records, seed=seed)
+
+    @staticmethod
+    def image_folder(path, distributed: bool = False):
+        """reference: DataSet.ImageFolder (DataSet.scala) — directory-per-class
+        image tree -> LabeledImage records."""
+        from .image import load_image_folder
+        return DataSet.array(load_image_folder(path), distributed=distributed)
+
+    @staticmethod
+    def record_file(path, distributed: bool = False):
+        """reference: DataSet.SeqFileFolder (hadoop SequenceFiles) — replaced by
+        the native BDRecord shard format (csrc/recordio.cpp, utils/recordio.py)."""
+        from ..utils.recordio import read_records
+        return DataSet.array(list(read_records(path)), distributed=distributed)

@@ -1,0 +1,118 @@
+"""Sample and MiniBatch: the data-record types.
+
+Reference: BigDL `dataset/Sample.scala:31,129` (ArraySample: feature tensor(s) +
+label tensor(s) packed in one flat array) and `dataset/MiniBatch.scala:39,110`
+(ArrayTensorMiniBatch with `slice` for per-thread splitting :154, and padding
+params `PaddingParam`/`FixedLength` :522,560).
+
+TPU-native notes: host-side records are plain numpy (cheap, picklable, feeds
+`jax.device_put` with a sharding in one hop); a MiniBatch may carry multiple
+feature/label tensors as nested lists (pytrees).  The per-thread `slice` of the
+reference (used to split a node's batch across core-level model replicas,
+DistriOptimizer.scala:165-183) is replaced by sharded `device_put` — the batch
+axis IS the data-parallel mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Sample", "MiniBatch", "PaddingParam", "FixedLength"]
+
+
+class Sample:
+    """One record: feature(s) + label(s) (reference: dataset/Sample.scala:31)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label=None):
+        self.feature = feature
+        self.label = label
+
+    def feature_size(self):
+        return (self.feature.shape if not isinstance(self.feature, (list, tuple))
+                else [f.shape for f in self.feature])
+
+    def label_size(self):
+        if self.label is None:
+            return None
+        return (self.label.shape if not isinstance(self.label, (list, tuple))
+                else [l.shape for l in self.label])
+
+    @staticmethod
+    def from_ndarray(features, labels=None) -> "Sample":
+        def conv(x):
+            if x is None:
+                return None
+            if isinstance(x, (list, tuple)):
+                return [np.asarray(e) for e in x]
+            return np.asarray(x)
+        return Sample(conv(features), conv(labels))
+
+    def __repr__(self):
+        return f"Sample(feature={self.feature_size()}, label={self.label_size()})"
+
+
+class MiniBatch:
+    """A batch of stacked samples (reference: dataset/MiniBatch.scala:39).
+
+    `input`/`target` are numpy arrays or nested lists of them.  `valid` is the
+    number of real (non-padding) rows — used when the last eval batch is padded
+    up to the static batch size so the compiled step never sees a new shape.
+    """
+
+    __slots__ = ("input", "target", "valid")
+
+    def __init__(self, input, target=None, valid: Optional[int] = None):
+        self.input = input
+        self.target = target
+        first = input[0] if isinstance(input, (list, tuple)) else input
+        self.valid = valid if valid is not None else first.shape[0]
+
+    def size(self) -> int:
+        first = self.input[0] if isinstance(self.input, (list, tuple)) else self.input
+        return first.shape[0]
+
+    def get_input(self):
+        return self.input
+
+    def get_target(self):
+        return self.target
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """Sub-batch [offset, offset+length) (MiniBatch.scala:154). 0-based."""
+        def sl(x):
+            if isinstance(x, (list, tuple)):
+                return [sl(e) for e in x]
+            return x[offset:offset + length]
+        return MiniBatch(sl(self.input),
+                         None if self.target is None else sl(self.target))
+
+    def __repr__(self):
+        def shape(x):
+            if isinstance(x, (list, tuple)):
+                return [shape(e) for e in x]
+            return x.shape
+        return (f"MiniBatch(input={shape(self.input)}, "
+                f"target={None if self.target is None else shape(self.target)})")
+
+
+class PaddingParam:
+    """Variable-length padding config (reference: dataset/MiniBatch.scala:522).
+
+    padding_value fills; padding_strategy decides the padded length."""
+
+    def __init__(self, padding_value: float = 0.0, padding_strategy=None):
+        self.padding_value = padding_value
+        self.padding_strategy = padding_strategy  # None = longest in batch
+
+
+class FixedLength(PaddingParam):
+    """Pad every sequence to a fixed length (dataset/MiniBatch.scala:560) —
+    on TPU this is also the bucketing tool that avoids retraces."""
+
+    def __init__(self, length: int, padding_value: float = 0.0):
+        super().__init__(padding_value)
+        self.length = length

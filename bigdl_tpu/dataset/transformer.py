@@ -1,0 +1,119 @@
+"""Transformer: composable iterator-to-iterator data transforms.
+
+Reference: BigDL `dataset/Transformer.scala:44` — `Transformer[A,B]` transforms an
+`Iterator[A]` into an `Iterator[B]`, composed with `->` (:49) via
+`ChainedTransformer` (:86); `SampleToMiniBatch` (:309,354) batches Samples with
+optional padding.
+
+TPU-native notes: Python composition operator is `>>` (Scala's `->` isn't
+expressible).  Transformers run on the host CPU feeding the device; for
+heavy image pipelines see dataset/image.py (numpy-vectorized) and the native
+prefetcher in csrc/.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from .sample import FixedLength, MiniBatch, PaddingParam, Sample
+
+__all__ = ["Transformer", "ChainedTransformer", "SampleToMiniBatch", "Identity"]
+
+
+class Transformer:
+    """Iterator -> Iterator transform (reference: dataset/Transformer.scala:44)."""
+
+    def __call__(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        """`a >> b` == reference's `a -> b` (Transformer.scala:49)."""
+        return ChainedTransformer(self, other)
+
+    def clone_transformer(self):
+        import copy
+        return copy.deepcopy(self)
+
+
+class ChainedTransformer(Transformer):
+    """(Transformer.scala:86)."""
+
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def __call__(self, it):
+        return self.second(self.first(it))
+
+
+class Identity(Transformer):
+    def __call__(self, it):
+        return it
+
+
+def _stack_features(values, padding: PaddingParam = None):
+    """Stack a list of numpy arrays, padding the non-batch dims if requested."""
+    if isinstance(values[0], (list, tuple)):
+        n = len(values[0])
+        return [_stack_features([v[i] for v in values], padding)
+                for i in range(n)]
+    shapes = [v.shape for v in values]
+    if all(s == shapes[0] for s in shapes) and not isinstance(padding, FixedLength):
+        return np.stack(values)
+    # variable length: pad dim0 of each sample (sequence axis)
+    if isinstance(padding, FixedLength):
+        max_len = padding.length
+    else:
+        max_len = max(s[0] for s in shapes)
+    pad_val = padding.padding_value if padding else 0.0
+    out = np.full((len(values), max_len) + shapes[0][1:], pad_val,
+                  dtype=values[0].dtype)
+    for i, v in enumerate(values):
+        out[i, :v.shape[0]] = v
+    return out
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (reference: dataset/Transformer.scala:309).
+
+    `drop_last=True` keeps batch shapes static for the compiled train step
+    (the reference wraps around instead; on TPU a shape change = a retrace).
+    `pad_last=True` pads the final partial batch to full size and records the
+    true row count in MiniBatch.valid (for evaluation).
+    """
+
+    def __init__(self, batch_size: int, feature_padding: PaddingParam = None,
+                 label_padding: PaddingParam = None, drop_last: bool = False,
+                 pad_last: bool = False):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_last = drop_last
+        self.pad_last = pad_last
+
+    def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
+        buf = []
+        for sample in it:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+        if buf and not self.drop_last:
+            if self.pad_last:
+                valid = len(buf)
+                while len(buf) < self.batch_size:
+                    buf.append(buf[-1])
+                b = self._batch(buf)
+                b.valid = valid
+                yield b
+            else:
+                yield self._batch(buf)
+
+    def _batch(self, samples) -> MiniBatch:
+        feats = _stack_features([s.feature for s in samples],
+                                self.feature_padding)
+        if samples[0].label is None:
+            return MiniBatch(feats)
+        labels = _stack_features([s.label for s in samples], self.label_padding)
+        return MiniBatch(feats, labels)

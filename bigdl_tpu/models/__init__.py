@@ -1,0 +1,3 @@
+"""Model zoo (reference: BigDL models/ + example/, SURVEY.md §2.11)."""
+
+from .lenet import LeNet5

@@ -1,0 +1,255 @@
+"""Convolution layers.
+
+Reference: BigDL `nn/SpatialConvolution.scala:42` implements conv as explicit
+im2col + MKL gemm scalar loops (`NNPrimitive.im2colFloat`,
+SpatialConvolution.scala:470-530), parallelized over output frames with
+`Engine.model.invoke` (:202).  `nn/SpatialDilatedConvolution.scala`,
+`nn/SpatialFullConvolution.scala` (deconvolution), `nn/TemporalConvolution.scala`
+(1-D), `nn/VolumetricConvolution.scala` (3-D), `nn/SpatialShareConvolution.scala`,
+`nn/SpatialConvolutionMap.scala`.
+
+TPU-native re-design: NO im2col port.  Every conv lowers to
+`jax.lax.conv_general_dilated`, which XLA tiles directly onto the MXU; layout is
+NHWC/HWIO (TPU-preferred), compute in the policy dtype (bf16) with float32
+accumulation.  Groups map to `feature_group_count`; deconvolution maps to
+`conv_transpose`-style lhs dilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import get_policy
+from .initialization import default_bias_init, default_weight_init
+from .module import Module
+
+__all__ = ["SpatialConvolution", "SpatialDilatedConvolution",
+           "SpatialFullConvolution", "TemporalConvolution",
+           "VolumetricConvolution", "SpatialShareConvolution"]
+
+
+class SpatialConvolution(Module):
+    """2-D convolution over NHWC input (reference: nn/SpatialConvolution.scala:42,
+    which uses NCHW — layout re-designed for TPU).
+
+    Weight: (kh, kw, cin/groups, cout) HWIO.  Argument order keeps the reference's
+    (nInputPlane, nOutputPlane, kW, kH, dW, dH, padW, padH, nGroup) signature.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
+                 propagate_back: bool = True, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _weight_shape(self):
+        kh, kw = self.kernel
+        return (kh, kw, self.n_input_plane // self.n_group, self.n_output_plane)
+
+    def _init(self, rng):
+        kw_, kb = jax.random.split(rng)
+        shape = self._weight_shape()
+        fan_in = shape[0] * shape[1] * shape[2]
+        fan_out = shape[0] * shape[1] * shape[3] // self.n_group
+        winit = self.weight_initializer or default_weight_init
+        binit = self.bias_initializer or default_bias_init
+        p = {"weight": winit(kw_, shape, fan_in, fan_out, get_policy().param_dtype)}
+        if self.with_bias:
+            p["bias"] = binit(kb, (self.n_output_plane,), fan_in, fan_out,
+                              get_policy().param_dtype)
+        return p
+
+    def _conv(self, x, w, lhs_dilation=None, rhs_dilation=None, padding=None):
+        c = get_policy().compute_dtype
+        pad_h, pad_w = self.pad
+        y = lax.conv_general_dilated(
+            x.astype(c), w.astype(c),
+            window_strides=self.stride,
+            padding=padding if padding is not None
+                    else [(pad_h, pad_h), (pad_w, pad_w)],
+            lhs_dilation=lhs_dilation,
+            rhs_dilation=rhs_dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32)
+        return y.astype(c)
+
+    def _apply(self, params, x):
+        y = self._conv(x, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Reference nn/SpatialShareConvolution.scala exists only to share im2col
+    buffers between layers — meaningless under XLA (the compiler owns buffers), so
+    it is a pure alias kept for API parity."""
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Atrous conv (nn/SpatialDilatedConvolution.scala) via rhs_dilation."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 dilation_w=1, dilation_h=1, with_bias=True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
+                         stride_w, stride_h, pad_w, pad_h, 1, True, with_bias,
+                         w_regularizer, b_regularizer)
+        self.dilation = (dilation_h, dilation_w)
+
+    def _apply(self, params, x):
+        y = self._conv(x, params["weight"], rhs_dilation=self.dilation)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution / deconvolution (nn/SpatialFullConvolution.scala),
+    via lhs (input) dilation — XLA lowers this as efficiently as a gradient conv.
+
+    Output size: (in-1)*stride - 2*pad + kernel + adj.
+    """
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0, adj_w=0, adj_h=0,
+                 n_group=1, no_bias=False, w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.n_group = n_group
+        self.with_bias = not no_bias
+
+    def _init(self, rng):
+        kw_, kb = jax.random.split(rng)
+        kh, kw = self.kernel
+        # stored like the forward conv of the reverse direction: HWIO with
+        # I=n_input_plane/groups acting as the *input* of the transposed op
+        shape = (kh, kw, self.n_input_plane // self.n_group, self.n_output_plane)
+        fan_in = kh * kw * shape[2]
+        winit = self.weight_initializer or default_weight_init
+        binit = self.bias_initializer or default_bias_init
+        p = {"weight": winit(kw_, shape, fan_in, fan_in, get_policy().param_dtype)}
+        if self.with_bias:
+            p["bias"] = binit(kb, (self.n_output_plane,), fan_in, fan_in,
+                              get_policy().param_dtype)
+        return p
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        ah, aw = self.adj
+        w = params["weight"].astype(c)
+        # flip spatial dims: transposed conv correlates with the flipped kernel
+        w = w[::-1, ::-1, :, :]
+        y = lax.conv_general_dilated(
+            x.astype(c), w,
+            window_strides=(1, 1),
+            padding=[(kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw)],
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32).astype(c)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class TemporalConvolution(Module):
+    """1-D convolution over (batch, time, features) (nn/TemporalConvolution.scala).
+
+    Weight stored as (kernel, in, out); lowers to conv_general_dilated with
+    ("NWC", "WIO", "NWC") so the MXU still sees a big matmul.
+    """
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1, propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+
+    def _init(self, rng):
+        kw_, kb = jax.random.split(rng)
+        shape = (self.kernel_w, self.input_frame_size, self.output_frame_size)
+        fan_in = self.kernel_w * self.input_frame_size
+        winit = self.weight_initializer or default_weight_init
+        binit = self.bias_initializer or default_bias_init
+        return {
+            "weight": winit(kw_, shape, fan_in, fan_in, get_policy().param_dtype),
+            "bias": binit(kb, (self.output_frame_size,), fan_in, fan_in,
+                          get_policy().param_dtype),
+        }
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        y = lax.conv_general_dilated(
+            x.astype(c), params["weight"].astype(c),
+            window_strides=(self.stride_w,),
+            padding=[(0, 0)],
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            preferred_element_type=jnp.float32).astype(c)
+        return y + params["bias"].astype(y.dtype)
+
+
+class VolumetricConvolution(Module):
+    """3-D convolution over (batch, depth, height, width, channels)
+    (nn/VolumetricConvolution.scala; reference layout NCDHW → NDHWC here)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 with_bias=True, w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+
+    def _init(self, rng):
+        kw_, kb = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        shape = (kt, kh, kw, self.n_input_plane, self.n_output_plane)
+        fan_in = kt * kh * kw * self.n_input_plane
+        winit = self.weight_initializer or default_weight_init
+        binit = self.bias_initializer or default_bias_init
+        p = {"weight": winit(kw_, shape, fan_in, fan_in, get_policy().param_dtype)}
+        if self.with_bias:
+            p["bias"] = binit(kb, (self.n_output_plane,), fan_in, fan_in,
+                              get_policy().param_dtype)
+        return p
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        pt, ph, pw = self.pad
+        y = lax.conv_general_dilated(
+            x.astype(c), params["weight"].astype(c),
+            window_strides=self.stride,
+            padding=[(pt, pt), (ph, ph), (pw, pw)],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            preferred_element_type=jnp.float32).astype(c)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y

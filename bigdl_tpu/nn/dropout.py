@@ -1,0 +1,113 @@
+"""Dropout and embedding layers.
+
+Reference: BigDL `nn/Dropout.scala` (inverted-scaling dropout over a bernoulli
+mask), `nn/LookupTable.scala` (embedding with optional max-norm renorm),
+`nn/GradientReversal.scala`.
+
+TPU-native notes: the bernoulli mask comes from the explicit PRNG key threaded
+through `apply` — deterministic under jit and independent of device count.
+LookupTable is a gather (one-hot matmul is left to XLA's discretion).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common import get_policy
+from .module import Module
+
+__all__ = ["Dropout", "LookupTable", "GradientReversal"]
+
+
+class Dropout(Module):
+    """Inverted dropout (nn/Dropout.scala): zero with prob p, scale by 1/(1-p)
+    when `scale` (the reference's default) is true."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float):
+        self.p = p
+        return self
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout in training mode requires an rng key")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        if self.scale:
+            y = y / keep
+        return y.astype(x.dtype), state
+
+
+class LookupTable(Module):
+    """Embedding lookup (nn/LookupTable.scala): indices -> rows of a
+    (n_index, n_output) weight.  Indices are 0-based (reference is 1-based Torch;
+    pass `one_based=True` for parity with reference data)."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = None,
+                 max_norm: float = None, norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, one_based: bool = False,
+                 w_regularizer=None):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.one_based = one_based
+        self.w_regularizer = w_regularizer
+
+    def _init(self, rng):
+        w = jax.random.normal(rng, (self.n_index, self.n_output),
+                              get_policy().param_dtype)
+        if self.padding_value is not None:
+            pad_idx = int(self.padding_value) - (1 if self.one_based else 0)
+            if 0 <= pad_idx < self.n_index:
+                w = w.at[pad_idx].set(0.0)
+        return {"weight": w}
+
+    def _apply(self, params, idx):
+        w = params["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = jnp.where(norms > self.max_norm, w * (self.max_norm / norms), w)
+        i = idx.astype(jnp.int32)
+        if self.one_based:
+            i = i - 1
+        return jnp.take(w, i, axis=0)
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (nn/GradientReversal.scala) —
+    via jax.custom_vjp so it also works inside the compiled train step."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (jax.tree.map(lambda t: -self.the_lambda * t, g),)
+
+        rev.defvjp(fwd, bwd)
+        self._rev = rev
+
+    def set_lambda(self, lam: float):
+        self.the_lambda = lam
+        return self
+
+    def _apply(self, params, x):
+        return self._rev(x)

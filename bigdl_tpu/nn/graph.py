@@ -1,0 +1,133 @@
+"""Graph container: arbitrary-DAG models.
+
+Reference: BigDL `nn/Graph.scala:58` — a module built from `ModuleNode`s, executed
+in topological order (:64-120) over `utils/DirectedGraph.scala`; `Input`
+placeholder nodes (nn/Input.scala, created via Graph.scala:320).
+
+Usage (mirrors the reference's functional-graph API):
+
+    inp = Input()
+    h = Linear(10, 20)(inp)
+    a = ReLU()(h)
+    b = Tanh()(h)
+    out = CAddTable()([a, b])
+    model = Graph(inp, out)
+
+TPU-native notes: execution order is resolved at trace time (host Python), so the
+whole DAG flattens into one XLA program — the topo sort has zero runtime cost.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+
+from ..utils.graph import DirectedGraph, Node
+from .module import Module
+
+__all__ = ["ModuleNode", "Input", "Graph"]
+
+
+class ModuleNode(Node):
+    """A Node whose element is a Module; calling a Module on node(s) builds edges
+    (reference: the implicit `inputs` API of nn/Graph.scala)."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+
+class _InputModule(Module):
+    def _apply(self, params, x):
+        return x
+
+
+def Input() -> ModuleNode:
+    """Placeholder input node (reference: nn/Input.scala)."""
+    return ModuleNode(_InputModule())
+
+
+def _node(module: Module, inputs) -> ModuleNode:
+    n = ModuleNode(module)
+    if inputs is None:
+        return n
+    if isinstance(inputs, (list, tuple)):
+        for i in inputs:
+            i.point_to(n)
+    else:
+        inputs.point_to(n)
+    return n
+
+
+# make every Module callable on nodes: module(node) -> node
+_orig_call = Module.__call__
+
+
+def _module_call(self, *args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], ModuleNode):
+        return _node(self, args[0])
+    if (len(args) == 1 and isinstance(args[0], (list, tuple)) and args[0]
+            and all(isinstance(a, ModuleNode) for a in args[0])):
+        return _node(self, args[0])
+    return _orig_call(self, *args, **kwargs)
+
+
+Module.__call__ = _module_call
+
+
+class Graph(Module):
+    """DAG container (reference: nn/Graph.scala:58)."""
+
+    def __init__(self, inputs: Union[ModuleNode, Sequence[ModuleNode]],
+                 outputs: Union[ModuleNode, Sequence[ModuleNode]]):
+        super().__init__()
+        self.input_nodes: List[ModuleNode] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.output_nodes: List[ModuleNode] = (
+            list(outputs) if isinstance(outputs, (list, tuple)) else [outputs])
+        # topo order over the union of everything reachable from the inputs
+        virtual_src = Node(None)
+        for i in self.input_nodes:
+            virtual_src.point_to(i)
+        order = DirectedGraph(virtual_src).topology_sort()
+        self.exec_order: List[ModuleNode] = [n for n in order
+                                             if n is not virtual_src]
+        # detach the virtual source again
+        for i in self.input_nodes:
+            i.prev_nodes.remove(virtual_src)
+        self.modules = [n.element for n in self.exec_order]
+
+    def init(self, rng):
+        keys = jax.random.split(rng, max(len(self.modules), 1))
+        ps, ss = [], []
+        for m, k in zip(self.modules, keys):
+            p, s = m.init(k)
+            ps.append(p)
+            ss.append(s)
+        return ps, ss
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        rngs = ([None] * len(self.exec_order) if rng is None
+                else list(jax.random.split(rng, max(len(self.exec_order), 1))))
+        values = {}
+        inputs_list = (input if isinstance(input, (list, tuple))
+                       else [input])
+        for inp_node, x in zip(self.input_nodes, inputs_list):
+            values[id(inp_node)] = x
+
+        new_states = []
+        for n, p, s, k in zip(self.exec_order, params, state, rngs):
+            if id(n) in values:  # an Input node
+                new_states.append(s)
+                continue
+            preds = n.prev_nodes
+            if len(preds) == 1:
+                x = values[id(preds[0])]
+            else:
+                x = [values[id(pn)] for pn in preds]
+            y, ns = n.element.apply(p, s, x, training=training, rng=k)
+            values[id(n)] = y
+            new_states.append(ns)
+
+        outs = [values[id(o)] for o in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else outs), new_states

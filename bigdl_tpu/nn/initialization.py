@@ -1,0 +1,130 @@
+"""Parameter initialization methods.
+
+Reference: BigDL `nn/InitializationMethod.scala:139` — `RandomUniform` (:163,181),
+`RandomNormal` (:194), `Zeros` (:206), `Ones`, `ConstInitMethod`, `Xavier` (:257),
+`BilinearFiller` (:277), `MsraFiller`; applied through
+`nn/abstractnn/Initializable.scala`.
+
+Each initializer is a callable `(rng, shape, fan_in, fan_out, dtype) -> jnp.ndarray`.
+Fan computation follows the reference's `VariableFormat` conventions (a Linear weight
+of shape (out, in) has fan_in=in; a conv weight (kh, kw, cin, cout) has
+fan_in=kh*kw*cin).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Zeros", "Ones", "ConstInitMethod", "RandomUniform", "RandomNormal",
+    "Xavier", "MsraFiller", "BilinearFiller", "default_weight_init",
+    "default_bias_init", "compute_fans",
+]
+
+
+def compute_fans(shape):
+    """fan_in/fan_out for dense (out,in) and conv (kh,kw,cin,cout) shapes."""
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:  # (out, in)
+        return shape[1], shape[0]
+    receptive = int(np.prod(shape[:-2]))
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); with no bounds, Torch's 1/sqrt(fan_in) convention
+    (InitializationMethod.scala:163-190)."""
+
+    def __init__(self, lower=None, upper=None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        if self.lower is None:
+            fi, _ = (fan_in, fan_out) if fan_in else compute_fans(shape)
+            stdv = 1.0 / float(np.sqrt(fi))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean=0.0, stdv=1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """U(-a, a), a = sqrt(6/(fan_in+fan_out)) (InitializationMethod.scala:257)."""
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        if fan_in is None:
+            fan_in, fan_out = compute_fans(shape)
+        a = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+
+
+class MsraFiller(InitializationMethod):
+    """He/MSRA init: N(0, sqrt(2/fan)) (InitializationMethod.scala MsraFiller)."""
+
+    def __init__(self, variance_norm_average=False):
+        self.variance_norm_average = variance_norm_average
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        if fan_in is None:
+            fan_in, fan_out = compute_fans(shape)
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
+        std = float(np.sqrt(2.0 / n))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear-upsampling kernel (InitializationMethod.scala:277); for
+    SpatialFullConvolution weights of shape (kh, kw, cin, cout)."""
+
+    def __call__(self, rng, shape, fan_in=None, fan_out=None, dtype=jnp.float32):
+        kh, kw = shape[0], shape[1]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (kh - 1) / (2.0 * f_h) if kh > 1 else 0.0
+        c_w = (kw - 1) / (2.0 * f_w) if kw > 1 else 0.0
+        ys = np.arange(kh).reshape(-1, 1)
+        xs = np.arange(kw).reshape(1, -1)
+        filt = (1 - np.abs(ys / f_h - c_h)) * (1 - np.abs(xs / f_w - c_w))
+        w = np.zeros(shape, dtype=np.float32)
+        w[..., :, :] = filt[..., None, None]
+        return jnp.asarray(w, dtype)
+
+
+#: Torch default: U(-1/sqrt(fanIn), 1/sqrt(fanIn)) for both weight and bias
+default_weight_init = RandomUniform()
+default_bias_init = RandomUniform()

@@ -1,0 +1,223 @@
+"""Simple elementwise / reduction math layers.
+
+Reference: one file each under BigDL `nn/`: Power.scala, Sqrt.scala, Square.scala,
+Clamp.scala, Max.scala, Min.scala, Mean.scala, Sum.scala, Exp.scala, Log.scala,
+Abs.scala, Scale.scala, MM.scala, MV.scala, Cosine.scala, Euclidean.scala,
+DotProduct.scala, PairwiseDistance.scala, CosineDistance.scala.
+
+All trivial XLA-fusable ops; axes are 0-based.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common import get_policy
+from .module import Module
+
+__all__ = ["Power", "Sqrt", "Square", "Clamp", "Max", "Min", "Mean", "Sum",
+           "Exp", "Log", "Abs", "Scale", "MM", "MV", "Cosine", "Euclidean",
+           "DotProduct", "PairwiseDistance", "CosineDistance"]
+
+
+class Power(Module):
+    """(shift + scale * x) ^ power (nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _apply(self, params, x):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Sqrt(Module):
+    def _apply(self, params, x):
+        return jnp.sqrt(x)
+
+
+class Square(Module):
+    def _apply(self, params, x):
+        return jnp.square(x)
+
+
+class Clamp(Module):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def _apply(self, params, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Max(Module):
+    """Max along `dim` (nn/Max.scala); returns values only (the reference also
+    tracks indices internally for backward — autodiff handles that here)."""
+
+    def __init__(self, dim: int = -1, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, x):
+        return jnp.max(x, axis=self.dim)
+
+
+class Min(Module):
+    def __init__(self, dim: int = -1, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, x):
+        return jnp.min(x, axis=self.dim)
+
+
+class Mean(Module):
+    def __init__(self, dimension: int = 0, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension, self.squeeze = dimension, squeeze
+
+    def _apply(self, params, x):
+        return jnp.mean(x, axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Sum(Module):
+    def __init__(self, dimension: int = 0, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension, self.size_average, self.squeeze = \
+            dimension, size_average, squeeze
+
+    def _apply(self, params, x):
+        if self.size_average:
+            return jnp.mean(x, axis=self.dimension, keepdims=not self.squeeze)
+        return jnp.sum(x, axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Exp(Module):
+    def _apply(self, params, x):
+        return jnp.exp(x)
+
+
+class Log(Module):
+    def _apply(self, params, x):
+        return jnp.log(x)
+
+
+class Abs(Module):
+    def _apply(self, params, x):
+        return jnp.abs(x)
+
+
+class Scale(Module):
+    """CMul then CAdd with learnable per-channel weight/bias (nn/Scale.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+
+    def _init(self, rng):
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}
+
+    def _apply(self, params, x):
+        return x * params["weight"] + params["bias"]
+
+
+class MM(Module):
+    """Batch/plain matrix-matrix product of a two-tensor input (nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _apply(self, params, inputs):
+        a, b = inputs[0], inputs[1]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+class MV(Module):
+    """Matrix-vector product of a two-tensor input (nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def _apply(self, params, inputs):
+        m, v = inputs[0], inputs[1]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class Cosine(Module):
+    """Cosine similarity of input rows to each of `output_size` learned anchors
+    (nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+
+    def _init(self, rng):
+        stdv = 1.0 / (self.input_size ** 0.5)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), jnp.float32, -stdv, stdv)}
+
+    def _apply(self, params, x):
+        w = params["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """Euclidean distance of input rows to learned centers (nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 fast_backward: bool = True):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+
+    def _init(self, rng):
+        stdv = 1.0 / (self.input_size ** 0.5)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), jnp.float32, -stdv, stdv)}
+
+    def _apply(self, params, x):
+        diff = x[:, None, :] - params["weight"][None, :, :]
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-12)
+
+
+class DotProduct(Module):
+    """Row-wise dot product of a two-tensor input (nn/DotProduct.scala)."""
+
+    def _apply(self, params, inputs):
+        a, b = inputs[0], inputs[1]
+        return jnp.sum(a * b, axis=-1)
+
+
+class PairwiseDistance(Module):
+    """Row-wise L_p distance of a two-tensor input (nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def _apply(self, params, inputs):
+        d = inputs[0] - inputs[1]
+        return jnp.sum(jnp.abs(d) ** self.norm, axis=-1) ** (1.0 / self.norm)
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity of a two-tensor input (nn/CosineDistance.scala)."""
+
+    def _apply(self, params, inputs):
+        a, b = inputs[0], inputs[1]
+        an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
+        bn = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+        return jnp.sum(an * bn, axis=-1)

@@ -1,0 +1,342 @@
+"""Module system: the TPU-native re-design of BigDL's AbstractModule.
+
+Reference: `nn/abstractnn/AbstractModule.scala:54` defines a *stateful* Torch-style
+module: mutable `output`/`gradInput` caches (:62,67), `forward` = timed
+`updateOutput` (:213), `backward` = `updateGradInput` + `accGradParameters` (:231),
+`parameters()` exposing weight/gradient tensor pairs, and `getParameters()` (:284)
+flattening everything into ONE contiguous weight vector + ONE gradient vector — the
+contract BigDL's whole distributed design hangs off.
+
+TPU-native re-design
+--------------------
+The mutable-module style cannot live inside `jax.jit` (tracing requires pure
+functions), so each Module here is two things at once:
+
+1. **A pure functional core** — `init(rng) -> (params, state)` and
+   `apply(params, state, input, training, rng) -> (output, new_state)` where
+   `params`/`state` are pytrees.  This is what the Optimizer jits/pjits: a whole
+   train step (forward + loss + backward + update + psum) compiles to one XLA
+   program, where BigDL dispatched each op separately to MKL via JNI
+   (tensor/TensorNumeric.scala:195-312).
+
+2. **A thin stateful facade** for API parity and interactive use — `forward`,
+   `backward`, `zero_grad_parameters`, `update_parameters`, `parameters`,
+   `get_parameters` behave like the reference (backward computes gradInput via
+   `jax.vjp` and *accumulates* parameter gradients, matching accGradParameters
+   semantics).
+
+`Activity` (Tensor ∨ Table union, nn/abstractnn/Activity.scala) needs no machinery:
+any pytree (array, list, dict, Table) is a valid input/output.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import get_policy, next_rng_key
+
+__all__ = ["Module", "Container", "Criterion"]
+
+_uid_counter = itertools.count()
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def _tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+class Module:
+    """Base class for all layers (BigDL: AbstractModule, abstractnn/AbstractModule.scala:54)."""
+
+    def __init__(self):
+        self.name = f"{type(self).__name__}_{next(_uid_counter)}"
+        self.training_mode: bool = True
+        # facade state
+        self.params = None   # pytree of parameters (None until build())
+        self.state = None    # pytree of non-trained state (e.g. BN running stats)
+        self.grads = None    # accumulated parameter gradients (accGradParameters)
+        self.output = None
+        self.grad_input = None
+        self._last_rng = None
+        # per-module gradient scaling (AbstractModule.scala:73 scaleW/scaleB)
+        self.scale_w: float = 1.0
+        self.scale_b: float = 1.0
+        # initializer overrides (nn/abstractnn/Initializable.scala:23)
+        self.weight_initializer = None
+        self.bias_initializer = None
+
+    # ------------------------------------------------------------------
+    # pure functional core — override _init / _apply (stateless layers) or
+    # init / apply (layers with state or randomness)
+    # ------------------------------------------------------------------
+
+    def init(self, rng):
+        """Create (params, state) pytrees."""
+        return self._init(rng), self._init_state()
+
+    def _init(self, rng):
+        return {}
+
+    def _init_state(self):
+        return {}
+
+    def apply(self, params, state, input, *, training: bool = False, rng=None):
+        """Pure forward. Returns (output, new_state)."""
+        return self._apply(params, input), state
+
+    def _apply(self, params, input):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement _apply or apply")
+
+    def has_params(self) -> bool:
+        return len(jax.tree.leaves(self.init(jax.random.key(0))[0])) > 0
+
+    # ------------------------------------------------------------------
+    # stateful facade (Torch-style API parity)
+    # ------------------------------------------------------------------
+
+    def build(self, rng=None):
+        """Materialize parameters (lazy; called automatically on first forward)."""
+        if rng is None:
+            rng = next_rng_key()
+        self.params, self.state = self.init(rng)
+        self.grads = _tree_zeros_like(self.params)
+        return self
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        """BigDL: Initializable.setInitMethod (abstractnn/Initializable.scala:29)."""
+        self.weight_initializer = weight_init
+        self.bias_initializer = bias_init
+        if self.params is not None:
+            self.build()
+        return self
+
+    def forward(self, input):
+        """BigDL: AbstractModule.forward (AbstractModule.scala:213)."""
+        if self.params is None:
+            self.build()
+        rng = next_rng_key()
+        self._last_rng = rng
+        out, new_state = self.apply(self.params, self.state, input,
+                                    training=self.training_mode, rng=rng)
+        self.state = new_state
+        self.output = out
+        return out
+
+    __call__ = forward
+
+    def backward(self, input, grad_output):
+        """gradInput + accumulated parameter grads (AbstractModule.scala:231-236)."""
+        if self.params is None:
+            raise RuntimeError("backward before forward")
+
+        def f(p, x):
+            y, _ = self.apply(p, self.state, x, training=self.training_mode,
+                              rng=self._last_rng)
+            return y
+
+        _, vjp = jax.vjp(f, self.params, input)
+        gp, gx = vjp(grad_output)
+        gp = self._scale_param_grads(gp)
+        self.grads = _tree_add(self.grads, gp)
+        self.grad_input = gx
+        return gx
+
+    def update_grad_input(self, input, grad_output):
+        """BigDL: updateGradInput — gradInput only, no param-grad accumulation."""
+        def f(x):
+            y, _ = self.apply(self.params, self.state, x,
+                              training=self.training_mode, rng=self._last_rng)
+            return y
+        _, vjp = jax.vjp(f, input)
+        (gx,) = vjp(grad_output)
+        self.grad_input = gx
+        return gx
+
+    def acc_grad_parameters(self, input, grad_output):
+        """BigDL: accGradParameters — accumulate dL/dParams only."""
+        def f(p):
+            y, _ = self.apply(p, self.state, input,
+                              training=self.training_mode, rng=self._last_rng)
+            return y
+        _, vjp = jax.vjp(f, self.params)
+        (gp,) = vjp(grad_output)
+        self.grads = _tree_add(self.grads, self._scale_param_grads(gp))
+
+    def _scale_param_grads(self, gp):
+        if self.scale_w == 1.0 and self.scale_b == 1.0:
+            return gp
+        def scale(path, leaf):
+            key = path[-1].key if hasattr(path[-1], "key") else ""
+            if key == "bias":
+                return leaf * self.scale_b
+            return leaf * self.scale_w
+        return jax.tree_util.tree_map_with_path(scale, gp)
+
+    # -- parameter access ----------------------------------------------
+
+    def parameters(self):
+        """(weights, gradWeights) leaf lists (BigDL: AbstractModule.parameters)."""
+        if self.params is None:
+            self.build()
+        return jax.tree.leaves(self.params), jax.tree.leaves(self.grads)
+
+    def get_parameters(self):
+        """ONE flat weight vector + ONE flat gradient vector.
+
+        BigDL contract: AbstractModule.getParameters (AbstractModule.scala:284)
+        flattens all parameters into a single contiguous tensor pair; the
+        distributed optimizer slices that flat vector across nodes.  JAX arrays
+        are immutable so these are copies, not views — the compiled train step
+        never uses this path (it maps pytrees directly); it exists for API parity,
+        checkpoint compactness, and tests.
+        """
+        ws, gs = self.parameters()
+        if not ws:
+            return jnp.zeros((0,)), jnp.zeros((0,))
+        return (jnp.concatenate([w.reshape(-1) for w in ws]),
+                jnp.concatenate([g.reshape(-1) for g in gs]))
+
+    def set_flat_parameters(self, flat):
+        """Inverse of get_parameters()[0]: scatter a flat vector back."""
+        leaves, treedef = jax.tree.flatten(self.params)
+        out, off = [], 0
+        for leaf in leaves:
+            n = leaf.size
+            out.append(jnp.asarray(flat[off:off + n]).reshape(leaf.shape).astype(leaf.dtype))
+            off += n
+        self.params = jax.tree.unflatten(treedef, out)
+        return self
+
+    def zero_grad_parameters(self):
+        if self.grads is not None:
+            self.grads = _tree_zeros_like(self.grads)
+
+    def update_parameters(self, learning_rate: float):
+        """w -= lr * gradW (BigDL: AbstractModule.updateParameters)."""
+        self.params = jax.tree.map(
+            lambda w, g: w - learning_rate * g, self.params, self.grads)
+
+    def get_parameters_table(self):
+        """name -> params dict (BigDL: getParametersTable, used by summaries)."""
+        return {self.name: self.params}
+
+    # -- modes ---------------------------------------------------------
+
+    def training(self):
+        self.training_mode = True
+        return self
+
+    def evaluate(self):
+        self.training_mode = False
+        return self
+
+    def is_training(self) -> bool:
+        return self.training_mode
+
+    # -- misc parity helpers ------------------------------------------
+
+    def set_name(self, name: str):
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    def set_scale_w(self, s: float):
+        self.scale_w = s
+        return self
+
+    def set_scale_b(self, s: float):
+        self.scale_b = s
+        return self
+
+    def clone_module(self) -> "Module":
+        """Deep copy (BigDL: cloneModule via serialization, AbstractModule.scala:353)."""
+        import copy
+        return copy.deepcopy(self)
+
+    def reset(self):
+        """Re-randomize parameters (BigDL: AbstractModule.reset)."""
+        self.build()
+        return self
+
+    def __repr__(self):
+        return self.name
+
+
+class Container(Module):
+    """Base for composite modules (BigDL: nn/Container.scala:40).
+
+    Child params/state are list-pytrees in child order.
+    """
+
+    def __init__(self, *modules: Module):
+        super().__init__()
+        self.modules: list = list(modules)
+
+    def add(self, module: Module):
+        """BigDL: Container.add (nn/Container.scala:54)."""
+        self.modules.append(module)
+        return self
+
+    def __len__(self):
+        return len(self.modules)
+
+    def __getitem__(self, i):
+        return self.modules[i]
+
+    def init(self, rng):
+        keys = jax.random.split(rng, max(len(self.modules), 1))
+        ps, ss = [], []
+        for m, k in zip(self.modules, keys):
+            p, s = m.init(k)
+            ps.append(p)
+            ss.append(s)
+        return ps, ss
+
+    def _split_rng(self, rng):
+        if rng is None:
+            return [None] * len(self.modules)
+        return list(jax.random.split(rng, max(len(self.modules), 1)))
+
+    # facade conveniences: keep children's own facade params in sync is NOT done;
+    # the container owns the authoritative (params, state) pytrees.
+
+    def __repr__(self):
+        inner = "\n  ".join(repr(m).replace("\n", "\n  ") for m in self.modules)
+        return f"{self.name} {{\n  {inner}\n}}"
+
+
+class Criterion:
+    """Loss base (BigDL: nn/abstractnn/AbstractCriterion.scala).
+
+    Pure core: `loss(output, target) -> scalar` (mean-reduced over batch by
+    default, matching BigDL's sizeAverage=true convention).  Facade: forward /
+    backward mirroring AbstractCriterion.
+    """
+
+    def __init__(self):
+        self.output = None
+        self.grad_input = None
+
+    def loss(self, output, target):
+        raise NotImplementedError
+
+    def forward(self, output, target):
+        self.output = self.loss(output, target)
+        return self.output
+
+    __call__ = forward
+
+    def backward(self, output, target):
+        self.grad_input = jax.grad(lambda o: self.loss(o, target))(output)
+        return self.grad_input

@@ -1,0 +1,193 @@
+"""Pooling layers.
+
+Reference: BigDL `nn/SpatialMaxPooling.scala`, `nn/SpatialAveragePooling.scala`,
+`nn/VolumetricMaxPooling.scala`, `nn/RoiPooling.scala`, `nn/Nms.scala`.
+
+TPU-native notes: pooling lowers to `lax.reduce_window`, which XLA maps onto the
+VPU; the reference's explicit index-tracking max-pool backward (scalar loops) is
+replaced by XLA's automatic `reduce_window` gradient (a select-and-scatter op).
+NHWC layout; `ceil_mode` matches the reference's ceil/floor output-size switch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+
+__all__ = ["SpatialMaxPooling", "SpatialAveragePooling", "VolumetricMaxPooling",
+           "RoiPooling"]
+
+
+def _pool_pads(size, kernel, stride, pad, ceil_mode):
+    """Per-dim (lo, hi) padding; hi is extended so the window count matches
+    Torch's ceil/floor formula (SpatialMaxPooling.scala out-size logic)."""
+    if ceil_mode:
+        out = int(np.ceil((size + 2 * pad - kernel) / stride)) + 1
+        # Torch: last window must start inside the (padded) input
+        if pad > 0 and (out - 1) * stride >= size + pad:
+            out -= 1
+    else:
+        out = int(np.floor((size + 2 * pad - kernel) / stride)) + 1
+    # extra hi padding so the last window fits; never negative (elements no
+    # window covers are simply ignored — output size is unaffected)
+    needed = (out - 1) * stride + kernel - size - pad
+    return out, (pad, max(needed, 0))
+
+
+class SpatialMaxPooling(Module):
+    """Max pool over NHWC (nn/SpatialMaxPooling.scala). Signature keeps the
+    reference's (kW, kH, dW, dH, padW, padH) order."""
+
+    def __init__(self, k_w: int, k_h: int, d_w: int = None, d_h: int = None,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kernel = (k_h, k_w)
+        self.stride = (d_h or k_h, d_w or k_w)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _apply(self, params, x):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        _, pad_h = _pool_pads(x.shape[1], kh, sh, ph, self.ceil_mode)
+        _, pad_w = _pool_pads(x.shape[2], kw, sw, pw, self.ceil_mode)
+        neg = (-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+               else np.iinfo(x.dtype).min)
+        return lax.reduce_window(
+            x, neg, lax.max,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=((0, 0), pad_h, pad_w, (0, 0)))
+
+
+class SpatialAveragePooling(Module):
+    """Average pool (nn/SpatialAveragePooling.scala).  `count_include_pad`
+    matches the reference's divisor convention."""
+
+    def __init__(self, k_w: int, k_h: int, d_w: int = None, d_h: int = None,
+                 pad_w: int = 0, pad_h: int = 0, global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kernel = (k_h, k_w)
+        self.stride = (d_h or k_h, d_w or k_w)
+        self.pad = (pad_h, pad_w)
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _apply(self, params, x):
+        if self.global_pooling:
+            kh, kw = x.shape[1], x.shape[2]
+            sh, sw = kh, kw
+            ph = pw = 0
+        else:
+            kh, kw = self.kernel
+            sh, sw = self.stride
+            ph, pw = self.pad
+        _, pad_h = _pool_pads(x.shape[1], kh, sh, ph, self.ceil_mode)
+        _, pad_w = _pool_pads(x.shape[2], kw, sw, pw, self.ceil_mode)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=((0, 0), pad_h, pad_w, (0, 0)))
+        if not self.divide:
+            return summed
+        if self.count_include_pad:
+            return summed / (kh * kw)
+        ones = jnp.ones((1, x.shape[1], x.shape[2], 1), x.dtype)
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=((0, 0), pad_h, pad_w, (0, 0)))
+        return summed / counts
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pool over NDHWC (nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0):
+        super().__init__()
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def _apply(self, params, x):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        pt, ph, pw = self.pad
+        return lax.reduce_window(
+            x, -np.inf, lax.max,
+            window_dimensions=(1, kt, kh, kw, 1),
+            window_strides=(1, st, sh, sw, 1),
+            padding=((0, 0), (pt, pt), (ph, ph), (pw, pw), (0, 0)))
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling (nn/RoiPooling.scala).
+
+    Input: [features NHWC, rois (R, 5) rows = (batch_idx, x1, y1, x2, y2)].
+    Output: (R, pooled_h, pooled_w, C).  Static output shape (R fixed per batch)
+    keeps it jit-compatible; implemented with gather + reduce_window-free max over
+    dynamically sliced bins using vmap'd index arithmetic.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float = 1.0):
+        super().__init__()
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def _apply(self, params, inputs):
+        feats, rois = inputs[0], inputs[1]
+        H, W = feats.shape[1], feats.shape[2]
+        ph, pw = self.pooled_h, self.pooled_w
+
+        def pool_one(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * self.spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * self.spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * self.spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+            rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+            bin_h, bin_w = rh / ph, rw / pw
+            fmap = feats[b]  # (H, W, C)
+            ys = jnp.arange(H)[:, None]
+            xs = jnp.arange(W)[None, :]
+
+            def one_bin(i, j):
+                hstart = jnp.floor(i * bin_h).astype(jnp.int32) + y1
+                hend = jnp.ceil((i + 1) * bin_h).astype(jnp.int32) + y1
+                wstart = jnp.floor(j * bin_w).astype(jnp.int32) + x1
+                wend = jnp.ceil((j + 1) * bin_w).astype(jnp.int32) + x1
+                mask = ((ys >= hstart) & (ys < hend) &
+                        (xs >= wstart) & (xs < wend))[..., None]
+                return jnp.max(jnp.where(mask, fmap, -jnp.inf), axis=(0, 1))
+
+            ii = jnp.arange(ph)
+            jj = jnp.arange(pw)
+            out = jax.vmap(lambda i: jax.vmap(lambda j: one_bin(i, j))(jj))(ii)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(pool_one)(rois)

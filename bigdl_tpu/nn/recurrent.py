@@ -1,0 +1,342 @@
+"""Recurrent layers.
+
+Reference: BigDL `nn/Recurrent.scala:33` unrolls a `Cell` over the time dimension
+with a Scala while-loop over cloned-and-weight-shared cells (:80-152) — a
+sequential, per-timestep, per-process loop.  Cells: `nn/Cell.scala:44` (base),
+`nn/RNN.scala` (RnnCell), `nn/LSTM.scala`, `nn/LSTMPeephole.scala`, `nn/GRU.scala`,
+`nn/ConvLSTMPeephole.scala`; wrappers `nn/TimeDistributed.scala`,
+`nn/BiRecurrent.scala`.
+
+TPU-native re-design: the time loop is `jax.lax.scan` — ONE compiled loop with the
+cell's gate matmuls fused into a single (in+hidden, 4*hidden) MXU-friendly gemm per
+step; weights are trivially shared because the same params pytree is closed over
+every step.  Layout: (batch, time, features), scanned time-major internally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import get_policy
+from .module import Container, Module
+
+__all__ = ["Cell", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "ConvLSTMPeephole",
+           "Recurrent", "TimeDistributed", "BiRecurrent"]
+
+
+class Cell(Module):
+    """RNN-cell base (reference: nn/Cell.scala:44).
+
+    Contract: `init_hidden(batch_size, dtype)` -> hidden pytree;
+    `step(params, x_t, hidden)` -> (output_t, new_hidden), both pure.
+    """
+
+    hidden_size: int
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, x_t, hidden):
+        raise NotImplementedError
+
+    # a bare cell applied to (batch, features) input acts on one step with zero state
+    def _apply(self, params, x):
+        out, _ = self.step(params, x, self.init_hidden(x.shape[0], x.dtype))
+        return out
+
+
+def _uniform(rng, shape, stdv):
+    return jax.random.uniform(rng, shape, get_policy().param_dtype, -stdv, stdv)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN: h' = act(W x + U h + b) (reference: nn/RNN.scala RnnCell)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def _init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        stdv = 1.0 / (self.hidden_size ** 0.5)
+        return {"w_ih": _uniform(k1, (self.input_size, self.hidden_size), stdv),
+                "w_hh": _uniform(k2, (self.hidden_size, self.hidden_size), stdv),
+                "bias": _uniform(k3, (self.hidden_size,), stdv)}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h):
+        c = get_policy().compute_dtype
+        pre = (x_t.astype(c) @ params["w_ih"].astype(c)
+               + h.astype(c) @ params["w_hh"].astype(c) + params["bias"])
+        h_new = self.activation(pre)
+        return h_new, h_new
+
+
+class LSTM(Cell):
+    """LSTM cell (reference: nn/LSTM.scala).  The four gate projections are fused
+    into one (in+hidden, 4*hidden) matmul so each scan step is a single MXU gemm.
+    Gate order: input, forget, cell(gain), output."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p  # dropout on gate inputs (reference's p) — applied by Recurrent
+
+    def _init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / (self.hidden_size ** 0.5)
+        return {
+            "kernel": _uniform(k1, (self.input_size + self.hidden_size,
+                                    4 * self.hidden_size), stdv),
+            "bias": _uniform(k2, (4 * self.hidden_size,), stdv),
+        }
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return (jnp.zeros((batch_size, self.hidden_size), dtype),
+                jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def step(self, params, x_t, hidden):
+        h, cst = hidden
+        cd = get_policy().compute_dtype
+        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
+        gates = lax.dot_general(z, params["kernel"].astype(cd),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        gates = gates + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * cst.astype(jnp.float32) + i * g
+        h_new = o * jnp.tanh(c_new)
+        h_new = h_new.astype(x_t.dtype)
+        return h_new, (h_new, c_new.astype(x_t.dtype))
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections (reference: nn/LSTMPeephole.scala):
+    gates also see the cell state through diagonal weights."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+
+    def _init(self, rng):
+        ks = jax.random.split(rng, 5)
+        stdv = 1.0 / (self.hidden_size ** 0.5)
+        H = self.hidden_size
+        return {
+            "kernel": _uniform(ks[0], (self.input_size + H, 4 * H), stdv),
+            "bias": _uniform(ks[1], (4 * H,), stdv),
+            "peep_i": _uniform(ks[2], (H,), stdv),
+            "peep_f": _uniform(ks[3], (H,), stdv),
+            "peep_o": _uniform(ks[4], (H,), stdv),
+        }
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return (jnp.zeros((batch_size, self.hidden_size), dtype),
+                jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def step(self, params, x_t, hidden):
+        h, cst = hidden
+        cd = get_policy().compute_dtype
+        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
+        gates = lax.dot_general(z, params["kernel"].astype(cd),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        gates = gates + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        cf = cst.astype(jnp.float32)
+        i = jax.nn.sigmoid(i + params["peep_i"] * cf)
+        f = jax.nn.sigmoid(f + params["peep_f"] * cf)
+        g = jnp.tanh(g)
+        c_new = f * cf + i * g
+        o = jax.nn.sigmoid(o + params["peep_o"] * c_new)
+        h_new = (o * jnp.tanh(c_new)).astype(x_t.dtype)
+        return h_new, (h_new, c_new.astype(x_t.dtype))
+
+
+class GRU(Cell):
+    """GRU cell (reference: nn/GRU.scala). Reset/update gates fused in one gemm."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+
+    def _init(self, rng):
+        ks = jax.random.split(rng, 4)
+        stdv = 1.0 / (self.hidden_size ** 0.5)
+        H = self.hidden_size
+        return {
+            "gate_kernel": _uniform(ks[0], (self.input_size + H, 2 * H), stdv),
+            "gate_bias": _uniform(ks[1], (2 * H,), stdv),
+            "cand_kernel": _uniform(ks[2], (self.input_size + H, H), stdv),
+            "cand_bias": _uniform(ks[3], (H,), stdv),
+        }
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h):
+        cd = get_policy().compute_dtype
+        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
+        gates = jax.nn.sigmoid(
+            lax.dot_general(z, params["gate_kernel"].astype(cd),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+            + params["gate_bias"])
+        r, u = jnp.split(gates, 2, axis=-1)
+        zc = jnp.concatenate([x_t, (r * h.astype(jnp.float32)).astype(x_t.dtype)],
+                             axis=-1).astype(cd)
+        cand = jnp.tanh(
+            lax.dot_general(zc, params["cand_kernel"].astype(cd),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+            + params["cand_bias"])
+        h_new = ((1.0 - u) * h.astype(jnp.float32) + u * cand).astype(x_t.dtype)
+        return h_new, h_new
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with peepholes over NHWC maps
+    (reference: nn/ConvLSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.kernel = kernel_i
+        self.stride = stride
+        self.with_peephole = with_peephole
+        self.hidden_size = output_size
+        self._spatial = None  # (H, W), bound at first step
+
+    def _init(self, rng):
+        ks = jax.random.split(rng, 5)
+        k = self.kernel
+        cin = self.input_size + self.output_size
+        fan_in = k * k * cin
+        stdv = 1.0 / (fan_in ** 0.5)
+        p = {"kernel": _uniform(ks[0], (k, k, cin, 4 * self.output_size), stdv),
+             "bias": _uniform(ks[1], (4 * self.output_size,), stdv)}
+        if self.with_peephole:
+            p["peep_i"] = jnp.zeros((self.output_size,), jnp.float32)
+            p["peep_f"] = jnp.zeros((self.output_size,), jnp.float32)
+            p["peep_o"] = jnp.zeros((self.output_size,), jnp.float32)
+        return p
+
+    def init_hidden(self, batch_size, dtype=jnp.float32, spatial=None):
+        if spatial is None:
+            spatial = self._spatial
+        h, w = spatial
+        z = jnp.zeros((batch_size, h, w, self.output_size), dtype)
+        return (z, z)
+
+    def step(self, params, x_t, hidden):
+        h, cst = hidden
+        z = jnp.concatenate([x_t, h], axis=-1)
+        pad = self.kernel // 2
+        gates = lax.conv_general_dilated(
+            z, params["kernel"].astype(z.dtype),
+            (self.stride, self.stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32) + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        cf = cst.astype(jnp.float32)
+        if self.with_peephole:
+            i = i + params["peep_i"] * cf
+            f = f + params["peep_f"] * cf
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c_new = f * cf + i * g
+        if self.with_peephole:
+            o = o + params["peep_o"] * c_new
+        o = jax.nn.sigmoid(o)
+        h_new = (o * jnp.tanh(c_new)).astype(x_t.dtype)
+        return h_new, (h_new, c_new.astype(x_t.dtype))
+
+
+class Recurrent(Container):
+    """Unroll a Cell over the time axis of (batch, time, features...) input
+    (reference: nn/Recurrent.scala:33; the clone-per-timestep loop becomes
+    ONE lax.scan)."""
+
+    def __init__(self, cell: Cell = None):
+        super().__init__()
+        if cell is not None:
+            self.add(cell)
+        self._return_state = False
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        cell: Cell = self.modules[0]
+        cp = params[0]
+        if isinstance(cell, ConvLSTMPeephole):
+            cell._spatial = (x.shape[2], x.shape[3])
+        # cell input dropout (the reference's `p` on LSTM/GRU,
+        # nn/LSTM.scala) — applied as VARIATIONAL dropout: one mask shared
+        # across all time steps (a TPU-friendly re-design; the reference draws
+        # per-gate masks per step)
+        p = getattr(cell, "p", 0.0)
+        if training and p > 0.0 and rng is not None:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(rng, keep, (x.shape[0],) + x.shape[2:])
+            x = jnp.where(mask[:, None], x, 0.0) / keep
+        h0 = cell.init_hidden(x.shape[0], x.dtype)
+        xs = jnp.moveaxis(x, 1, 0)  # time-major for scan
+
+        def body(h, x_t):
+            out, h_new = cell.step(cp, x_t, h)
+            return h_new, out
+
+        h_last, outs = lax.scan(body, h0, xs)
+        out = jnp.moveaxis(outs, 0, 1)  # back to (batch, time, ...)
+        if self._return_state:
+            return (out, h_last), state
+        return out, state
+
+
+class TimeDistributed(Container):
+    """Apply a layer independently at every time step (reference:
+    nn/TimeDistributed.scala) — a reshape, not a loop: (b, t, ...) -> (b*t, ...)."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        out, ns = self.modules[0].apply(params[0], state[0], flat,
+                                        training=training, rng=rng)
+        return out.reshape((b, t) + out.shape[1:]), [ns]
+
+
+class BiRecurrent(Container):
+    """Bidirectional wrapper (reference: nn/BiRecurrent.scala): run the cell
+    forward and (a separate copy) backward over time, merge with `merge`
+    ('concat' along features, or 'sum' — reference default is CAddTable/sum)."""
+
+    def __init__(self, cell: Cell, merge: str = "sum"):
+        super().__init__()
+        import copy
+        self.add(Recurrent(cell))
+        self.add(Recurrent(copy.deepcopy(cell)))
+        self.merge = merge
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        fwd, ns0 = self.modules[0].apply(params[0], state[0], x,
+                                         training=training, rng=rng)
+        rev_in = jnp.flip(x, axis=1)
+        bwd, ns1 = self.modules[1].apply(params[1], state[1], rev_in,
+                                         training=training, rng=rng)
+        bwd = jnp.flip(bwd, axis=1)
+        if self.merge == "concat":
+            out = jnp.concatenate([fwd, bwd], axis=-1)
+        else:
+            out = fwd + bwd
+        return out, [ns0, ns1]

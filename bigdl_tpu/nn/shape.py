@@ -1,0 +1,240 @@
+"""Shape-manipulation layers.
+
+Reference: one file each under BigDL `nn/`: Reshape.scala, InferReshape.scala,
+View.scala, Transpose.scala, Replicate.scala, Squeeze.scala, Unsqueeze.scala,
+Select.scala, Narrow.scala, Index.scala, MaskedSelect.scala, Reverse.scala,
+Padding.scala, SpatialZeroPadding.scala, Contiguous.scala.
+
+TPU-native notes: all of these are metadata ops under XLA (free or fused).  Axis
+arguments are 0-based over the full tensor INCLUDING batch; the reference's
+1-based-over-non-batch convention is documented per class.  `MaskedSelect` is the
+one dynamic-shape op — under jit it returns a fixed-size output via the
+where-and-fill idiom, with the true count as an aux (data-dependent shapes cannot
+exist in a compiled TPU program).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+
+__all__ = ["Reshape", "InferReshape", "View", "Transpose", "Replicate", "Squeeze",
+           "Unsqueeze", "Select", "Narrow", "Index", "MaskedSelect", "Reverse",
+           "Padding", "SpatialZeroPadding", "Contiguous"]
+
+
+class Reshape(Module):
+    """Reshape the non-batch dims to `size` (nn/Reshape.scala); batch_mode=None
+    auto-detects like the reference, True forces keeping dim0 as batch."""
+
+    def __init__(self, size, batch_mode: bool = True):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def _apply(self, params, x):
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + self.size)
+        return x.reshape(self.size)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (inferred) and 0 (copy input dim) entries
+    (nn/InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def _apply(self, params, x):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out))
+        return x.reshape(tuple(out))
+
+
+class View(Module):
+    """nn/View.scala — reshape keeping total element count; sizes may contain -1."""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(int(s) for s in sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n):
+        self.num_input_dims = n
+        return self
+
+    def _apply(self, params, x):
+        # batch-mode heuristic like the reference: if element counts differ by the
+        # batch factor, keep dim0
+        n_view = int(np.prod([s for s in self.sizes if s > 0]))
+        if -1 in self.sizes or x.size != n_view:
+            return x.reshape((x.shape[0],) + self.sizes)
+        return x.reshape(self.sizes)
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (nn/Transpose.scala). 0-based axes."""
+
+    def __init__(self, permutations):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def _apply(self, params, x):
+        perm = list(range(x.ndim))
+        for a, b in self.permutations:
+            perm[a], perm[b] = perm[b], perm[a]
+        return jnp.transpose(x, perm)
+
+
+class Replicate(Module):
+    """Insert a new axis of size n_features at `dim` (nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = None):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def _apply(self, params, x):
+        y = jnp.expand_dims(x, self.dim)
+        reps = [1] * y.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(y, reps)
+
+
+class Squeeze(Module):
+    """Drop size-1 dims (nn/Squeeze.scala); dim=None squeezes all."""
+
+    def __init__(self, dim: int = None, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, x):
+        return jnp.squeeze(x, self.dim) if self.dim is not None else jnp.squeeze(x)
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, num_input_dims: int = None):
+        super().__init__()
+        self.pos = pos
+
+    def _apply(self, params, x):
+        return jnp.expand_dims(x, self.pos)
+
+
+class Select(Module):
+    """Slice index `index` off axis `dim` (nn/Select.scala). 0-based; negative
+    indices count from the end like numpy."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def _apply(self, params, x):
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along `dim` (nn/Narrow.scala); negative
+    length means 'to the end minus |length|-1' like the reference."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def _apply(self, params, x):
+        length = self.length
+        if length < 0:
+            length = x.shape[self.dim] - self.offset + length + 1
+        idx = [slice(None)] * x.ndim
+        idx[self.dim] = slice(self.offset, self.offset + length)
+        return x[tuple(idx)]
+
+
+class Index(Module):
+    """Index one tensor by another along `dim` (nn/Index.scala).
+    Input: [tensor, indices]."""
+
+    def __init__(self, dim: int):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, inputs):
+        t, idx = inputs[0], inputs[1]
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.dim)
+
+
+class MaskedSelect(Module):
+    """nn/MaskedSelect.scala — select elements where mask != 0.
+
+    Outside jit returns the compacted 1-D array (exact reference semantics).
+    Inside jit (traced), returns a fixed-length vector of the masked values
+    front-packed and zero-padded, since XLA requires static shapes.
+    """
+
+    def _apply(self, params, inputs):
+        t, mask = inputs[0], inputs[1]
+        mask = mask.astype(bool)
+        if isinstance(jnp.asarray(t), jax.core.Tracer):
+            flat_t, flat_m = t.reshape(-1), mask.reshape(-1)
+            order = jnp.argsort(~flat_m, stable=True)
+            packed = jnp.where(flat_m[order], flat_t[order], 0.0)
+            return packed
+        return t[mask]
+
+
+class Reverse(Module):
+    """Reverse along `dim` (nn/Reverse.scala). 0-based."""
+
+    def __init__(self, dimension: int = 0):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, x):
+        return jnp.flip(x, self.dimension)
+
+
+class Padding(Module):
+    """Pad `pad` entries (negative = front) along `dim` with `value`
+    (nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = 0,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+
+    def _apply(self, params, x):
+        widths = [(0, 0)] * x.ndim
+        widths[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W of an NHWC tensor (nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: int = None, pad_top: int = None,
+                 pad_bottom: int = None):
+        super().__init__()
+        self.l = pad_left
+        self.r = pad_right if pad_right is not None else pad_left
+        self.t = pad_top if pad_top is not None else pad_left
+        self.b = pad_bottom if pad_bottom is not None else pad_left
+
+    def _apply(self, params, x):
+        return jnp.pad(x, [(0, 0), (self.t, self.b), (self.l, self.r), (0, 0)])
+
+
+class Contiguous(Module):
+    """nn/Contiguous.scala — no-op under XLA (layout is the compiler's)."""
+
+    def _apply(self, params, x):
+        return x

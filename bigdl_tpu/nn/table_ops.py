@@ -1,0 +1,151 @@
+"""Multi-input (Table) layers.
+
+Reference: one file each under BigDL `nn/`: CAddTable.scala, CSubTable.scala,
+CMulTable.scala, CDivTable.scala, CMaxTable.scala, CMinTable.scala,
+JoinTable.scala, SplitTable.scala, NarrowTable.scala, FlattenTable.scala,
+SelectTable.scala, MixtureTable.scala, Pack.scala.
+
+Inputs/outputs are Python lists (pytrees) — the reference's `Table` Activity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+
+__all__ = ["CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
+           "CMinTable", "JoinTable", "SplitTable", "NarrowTable", "FlattenTable",
+           "SelectTable", "MixtureTable", "Pack"]
+
+
+class CAddTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def _apply(self, params, inputs):
+        return functools.reduce(jnp.add, inputs)
+
+
+class CSubTable(Module):
+    def _apply(self, params, inputs):
+        return inputs[0] - inputs[1]
+
+
+class CMulTable(Module):
+    def _apply(self, params, inputs):
+        return functools.reduce(jnp.multiply, inputs)
+
+
+class CDivTable(Module):
+    def _apply(self, params, inputs):
+        return inputs[0] / inputs[1]
+
+
+class CMaxTable(Module):
+    def _apply(self, params, inputs):
+        return functools.reduce(jnp.maximum, inputs)
+
+
+class CMinTable(Module):
+    def _apply(self, params, inputs):
+        return functools.reduce(jnp.minimum, inputs)
+
+
+class JoinTable(Module):
+    """Concatenate table elements along `dimension` (nn/JoinTable.scala).
+    0-based axis; `n_input_dims` kept for signature parity."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, inputs):
+        return jnp.concatenate(list(inputs), axis=self.dimension)
+
+
+class SplitTable(Module):
+    """Split a tensor into a table along `dimension` (nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, x):
+        n = x.shape[self.dimension]
+        return [jnp.take(x, i, axis=self.dimension) for i in range(n)]
+
+
+class NarrowTable(Module):
+    """Sub-range of a table (nn/NarrowTable.scala). 0-based offset."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def _apply(self, params, inputs):
+        length = self.length
+        if length < 0:
+            length = len(inputs) - self.offset + length + 1
+        return list(inputs)[self.offset:self.offset + length]
+
+
+class FlattenTable(Module):
+    """Flatten nested tables into one flat list (nn/FlattenTable.scala)."""
+
+    def _apply(self, params, inputs):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (list, tuple)):
+                for e in t:
+                    rec(e)
+            else:
+                out.append(t)
+
+        rec(inputs)
+        return out
+
+
+class SelectTable(Module):
+    """Pick element `index` of a table (nn/SelectTable.scala). 0-based."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def _apply(self, params, inputs):
+        return inputs[self.index]
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend (nn/MixtureTable.scala): input =
+    [gate (batch, n), experts: list of n (batch, ...) or tensor (batch, n, ...)];
+    output = sum_i gate_i * expert_i."""
+
+    def __init__(self, dim: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, inputs):
+        gate, experts = inputs[0], inputs[1]
+        if isinstance(experts, (list, tuple)):
+            experts = jnp.stack(list(experts), axis=1)  # (batch, n, ...)
+        g = gate.reshape(gate.shape + (1,) * (experts.ndim - gate.ndim))
+        return jnp.sum(g * experts, axis=1)
+
+
+class Pack(Module):
+    """Stack table elements along a new `dimension` (nn/Pack.scala). 0-based."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, inputs):
+        if isinstance(inputs, (list, tuple)):
+            return jnp.stack(list(inputs), axis=self.dimension)
+        return jnp.expand_dims(inputs, self.dimension)

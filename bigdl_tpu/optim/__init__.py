@@ -1,0 +1,18 @@
+"""bigdl_tpu.optim — optimization methods, schedules, triggers, validation, and
+the Optimizer facade (reference: BigDL optim/, SURVEY.md §2.5)."""
+
+from .method import (OptimMethod, SGD, Adam, Adagrad, Adadelta, Adamax,
+                     RMSprop, LBFGS)
+from .schedules import (LearningRateSchedule, Default, Poly, Step, MultiStep,
+                        EpochDecay, EpochStep, NaturalExp, Exponential,
+                        EpochSchedule, Regime, Plateau, SequentialSchedule,
+                        Warmup)
+from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
+                          L1L2Regularizer)
+from .trigger import Trigger
+from .validation import (ValidationResult, AccuracyResult, LossResult,
+                         ValidationMethod, Top1Accuracy, Top5Accuracy, Loss,
+                         MAE, HitRatio, NDCG)
+from .metrics import Metrics
+from .optimizer import (Optimizer, DistriOptimizer, LocalOptimizer, Evaluator,
+                        Predictor)

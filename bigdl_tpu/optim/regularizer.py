@@ -1,0 +1,83 @@
+"""Weight regularizers.
+
+Reference: BigDL `optim/Regularizer.scala:30,87,175,186` — L1/L2/L1L2, applied
+inside each layer's accGradParameters.
+
+TPU-native notes: a regularizer contributes `grad(w)` terms that the Optimizer
+adds to the autodiff gradients inside the compiled step (walking the module tree
+in parallel with the params pytree), preserving the reference's per-layer
+regularizer placement (`w_regularizer`/`b_regularizer` constructor args on
+layers).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Regularizer", "L1Regularizer", "L2Regularizer", "L1L2Regularizer",
+           "apply_regularizer_grads"]
+
+
+class Regularizer:
+    def grad(self, w):
+        raise NotImplementedError
+
+    def loss(self, w):
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    """l1 * sign(w) + l2 * w (optim/Regularizer.scala:87)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def grad(self, w):
+        g = 0.0
+        if self.l1:
+            g = g + self.l1 * jnp.sign(w)
+        if self.l2:
+            g = g + self.l2 * w
+        return g
+
+    def loss(self, w):
+        l = 0.0
+        if self.l1:
+            l = l + self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            l = l + 0.5 * self.l2 * jnp.sum(jnp.square(w))
+        return l
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)
+
+
+def apply_regularizer_grads(module, params, grads):
+    """Walk (module tree, params, grads) in parallel; add per-layer regularizer
+    gradients.  Mirrors the reference's placement: accGradParameters applies
+    wRegularizer to the weight and bRegularizer to the bias
+    (e.g. nn/SpatialConvolution.scala accGradParameters tail)."""
+    # Containers AND Graph both hold a `modules` list aligned with their
+    # list-typed params pytree
+    if isinstance(params, list) and hasattr(module, "modules"):
+        return [apply_regularizer_grads(m, p, g)
+                for m, p, g in zip(module.modules, params, grads)]
+    if not isinstance(params, dict) or not params:
+        return grads
+    wr = getattr(module, "w_regularizer", None)
+    br = getattr(module, "b_regularizer", None)
+    if wr is None and br is None:
+        return grads
+    out = dict(grads)
+    if wr is not None and "weight" in params:
+        out["weight"] = grads["weight"] + wr.grad(params["weight"])
+    if br is not None and "bias" in params:
+        out["bias"] = grads["bias"] + br.grad(params["bias"])
+    return out

@@ -1,0 +1,231 @@
+"""Learning-rate schedules.
+
+Reference: the `LearningRateSchedule` family inside BigDL `optim/SGD.scala:203` —
+`EpochSchedule` (:224), `Poly` (:281), `Step` (:316), `MultiStep` (:349),
+`EpochDecay` (:385), `EpochStep` (:412), `NaturalExp` (:446), `Exponential`
+(:467), `Default` (:491), `Plateau` (:534), with `Regime` (:516) as the
+epoch-range config holder.
+
+TPU-native notes: schedules run on the HOST each iteration and feed the compiled
+train step a scalar `lr` argument — hyper-parameter changes never trigger a
+retrace.  Each schedule implements `get_lr(optim, state) -> float` where `state`
+carries `evalCounter` (iteration), `epoch`, and optionally `score`/`loss`
+(the reference mutates `optimMethod.state` the same way,
+DistriOptimizer.scala:282-298).
+"""
+
+from __future__ import annotations
+
+__all__ = ["LearningRateSchedule", "Default", "Poly", "Step", "MultiStep",
+           "EpochDecay", "EpochStep", "NaturalExp", "Exponential",
+           "EpochSchedule", "Regime", "Plateau", "SequentialSchedule", "Warmup"]
+
+import math
+
+
+class LearningRateSchedule:
+    def get_lr(self, optim, state) -> float:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """clr = lr / (1 + neval * lrd) (SGD.scala:491)."""
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        lrd = getattr(optim, "learning_rate_decay", 0.0)
+        return optim.learning_rate / (1 + neval * lrd)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/maxIter)^power (SGD.scala:281)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def get_lr(self, optim, state):
+        neval = min(state.get("evalCounter", 0), self.max_iteration)
+        return optim.learning_rate * (1.0 - neval / self.max_iteration) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^floor(iter/stepSize) (SGD.scala:316)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        return optim.learning_rate * self.gamma ** (neval // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed) (SGD.scala:349)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        k = sum(1 for s in self.step_sizes if neval >= s)
+        return optim.learning_rate * self.gamma ** k
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch) (SGD.scala:385)."""
+
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def get_lr(self, optim, state):
+        return optim.learning_rate * (0.1 ** self.decay_fn(state.get("epoch", 1)))
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^floor((epoch-1)/stepSize) (SGD.scala:412)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def get_lr(self, optim, state):
+        epoch = state.get("epoch", 1)
+        return optim.learning_rate * self.gamma ** ((epoch - 1) // self.step_size)
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(iter/decayStep)) (SGD.scala:446)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        return optim.learning_rate * math.exp(-self.gamma *
+                                              (neval // self.decay_step))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(iter/decayStep), optionally staircased (SGD.scala:467)."""
+
+    def __init__(self, decay_step: int, decay_rate: float, stair_case: bool = False):
+        self.decay_step, self.decay_rate, self.stair_case = \
+            decay_step, decay_rate, stair_case
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        p = neval / self.decay_step
+        if self.stair_case:
+            p = math.floor(p)
+        return optim.learning_rate * self.decay_rate ** p
+
+
+class Regime:
+    """Epoch-range hyper-parameter block (SGD.scala:516)."""
+
+    def __init__(self, start_epoch: int, end_epoch: int, config: dict):
+        self.start_epoch, self.end_epoch, self.config = \
+            start_epoch, end_epoch, config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-per-epoch regime table (SGD.scala:224)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def get_lr(self, optim, state):
+        epoch = state.get("epoch", 1)
+        lr = optim.learning_rate
+        for r in self.regimes:
+            if r.start_epoch <= epoch <= r.end_epoch:
+                lr = r.config.get("learningRate", lr)
+                # side effects for other hypers, mirroring the reference
+                if "weightDecay" in r.config and hasattr(optim, "weight_decay"):
+                    optim.weight_decay = r.config["weightDecay"]
+        return lr
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce-on-plateau (SGD.scala:534): monitor 'score' (or 'loss'), scale lr
+    by `factor` after `patience` non-improving epochs."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown_len = mode, epsilon, cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown = 0
+        self.current_lr = None
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.epsilon
+        return value > self.best + self.epsilon
+
+    def get_lr(self, optim, state):
+        if self.current_lr is None:
+            self.current_lr = optim.learning_rate
+        value = state.get(self.monitor)
+        if value is not None and state.get("_plateau_seen") != state.get("epoch"):
+            state["_plateau_seen"] = state.get("epoch")
+            if self.cooldown > 0:
+                self.cooldown -= 1
+                self.wait = 0
+            if self._improved(value):
+                self.best = value
+                self.wait = 0
+            elif self.cooldown <= 0:
+                self.wait += 1
+                if self.wait >= self.patience:
+                    self.current_lr = max(self.current_lr * self.factor,
+                                          self.min_lr)
+                    self.cooldown = self.cooldown_len
+                    self.wait = 0
+        return self.current_lr
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup from lr to lr + delta*warmupIteration, then `after`
+    (not in the 2017 reference — standard add-on for large-batch TPU training)."""
+
+    def __init__(self, delta: float, warmup_iteration: int,
+                 after: LearningRateSchedule = None):
+        self.delta = delta
+        self.warmup_iteration = warmup_iteration
+        self.after = after or Default()
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        if neval < self.warmup_iteration:
+            return optim.learning_rate + self.delta * neval
+        return self.after.get_lr(optim, state)
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for a given iteration count."""
+
+    def __init__(self):
+        self.entries = []  # (schedule, n_iterations)
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.entries.append((schedule, max_iteration))
+        return self
+
+    def get_lr(self, optim, state):
+        neval = state.get("evalCounter", 0)
+        offset = 0
+        for sched, n in self.entries:
+            if neval < offset + n:
+                sub = dict(state)
+                sub["evalCounter"] = neval - offset
+                return sched.get_lr(optim, sub)
+            offset += n
+        sched, n = self.entries[-1]
+        sub = dict(state)
+        sub["evalCounter"] = neval - offset + n
+        return sched.get_lr(optim, sub)

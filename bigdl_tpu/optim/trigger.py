@@ -1,0 +1,75 @@
+"""Triggers: when to validate / checkpoint / stop.
+
+Reference: BigDL `optim/Trigger.scala:30` — `everyEpoch` (:37),
+`severalIteration` (:63), `maxEpoch` (:79), `maxIteration`, `maxScore`,
+`minLoss`, each a predicate over the driver's mutable state Table.
+
+Host-side predicates over the driver-state dict; identical semantics.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Trigger"]
+
+
+class Trigger:
+    def __init__(self, fn, name="trigger"):
+        self._fn = fn
+        self.name = name
+
+    def __call__(self, state) -> bool:
+        return self._fn(state)
+
+    # -- factories (optim/Trigger.scala) --
+
+    @staticmethod
+    def every_epoch():
+        """Fires when the epoch number advanced past the last firing (:37)."""
+        box = {"last": 0}
+
+        def fn(state):
+            e = state.get("epoch", 1)
+            # fires at the first iteration of a new epoch, like the reference
+            # (which records the epoch at creation and fires when it changes)
+            if state.get("_epoch_just_finished", False) and e != box["last"]:
+                box["last"] = e
+                return True
+            return False
+
+        return Trigger(fn, "everyEpoch")
+
+    @staticmethod
+    def several_iteration(interval: int):
+        """Fires every `interval` iterations (:63)."""
+        return Trigger(
+            lambda s: s.get("neval", 1) % interval == 0,
+            f"severalIteration({interval})")
+
+    @staticmethod
+    def max_epoch(maximum: int):
+        """End-when trigger: epoch > max (:79)."""
+        return Trigger(lambda s: s.get("epoch", 1) > maximum,
+                       f"maxEpoch({maximum})")
+
+    @staticmethod
+    def max_iteration(maximum: int):
+        return Trigger(lambda s: s.get("neval", 1) > maximum,
+                       f"maxIteration({maximum})")
+
+    @staticmethod
+    def max_score(maximum: float):
+        return Trigger(lambda s: s.get("score", float("-inf")) > maximum,
+                       f"maxScore({maximum})")
+
+    @staticmethod
+    def min_loss(minimum: float):
+        return Trigger(lambda s: s.get("loss", float("inf")) < minimum,
+                       f"minLoss({minimum})")
+
+    @staticmethod
+    def and_(*triggers):
+        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+
+    @staticmethod
+    def or_(*triggers):
+        return Trigger(lambda s: any(t(s) for t in triggers), "or")

@@ -1,0 +1,167 @@
+"""Validation metrics.
+
+Reference: BigDL `optim/ValidationMethod.scala:34` — metric objects producing
+`ValidationResult`s that aggregate with `+`: `Top1Accuracy` (:170),
+`Top5Accuracy` (:218), `Loss` (:312), `MAE` (:332), `TreeNNAccuracy` (:118);
+legacy helpers in `optim/EvaluateMethods.scala`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["ValidationResult", "AccuracyResult", "LossResult",
+           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss", "MAE",
+           "HitRatio", "NDCG"]
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    """(correct, count) pair (ValidationMethod.scala:52)."""
+
+    def __init__(self, correct: float, count: int):
+        self.correct, self.count = correct, count
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = loss, count
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        l, n = self.result()
+        return f"Loss(loss: {self.loss}, count: {n}, average: {l})"
+
+
+class ValidationMethod:
+    """Metric over one (output, target) minibatch -> ValidationResult."""
+
+    name = "ValidationMethod"
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """argmax == label (ValidationMethod.scala:170). 0-based labels."""
+
+    name = "Top1Accuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        o = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if self.one_based:
+            t = t - 1
+        pred = np.argmax(o.reshape(t.shape[0], -1), axis=-1)
+        return AccuracyResult(float(np.sum(pred == t)), t.shape[0])
+
+
+class Top5Accuracy(ValidationMethod):
+    """label in top-5 (ValidationMethod.scala:218)."""
+
+    name = "Top5Accuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        o = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if self.one_based:
+            t = t - 1
+        o = o.reshape(t.shape[0], -1)
+        top5 = np.argsort(-o, axis=-1)[:, :5]
+        hit = np.any(top5 == t[:, None], axis=-1)
+        return AccuracyResult(float(np.sum(hit)), t.shape[0])
+
+
+class Loss(ValidationMethod):
+    """Criterion value as a metric (ValidationMethod.scala:312)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        if criterion is None:
+            from ..nn.criterion import ClassNLLCriterion
+            criterion = ClassNLLCriterion()
+        self.criterion = criterion
+
+    def __call__(self, output, target):
+        l = float(self.criterion.loss(jnp.asarray(output), jnp.asarray(target)))
+        n = int(np.asarray(target).shape[0])
+        return LossResult(l * n, n)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error between argmax-decoded output and target
+    (ValidationMethod.scala:332)."""
+
+    name = "MAE"
+
+    def __call__(self, output, target):
+        o = np.asarray(output)
+        t = np.asarray(target).reshape(-1)
+        pred = np.argmax(o.reshape(t.shape[0], -1), axis=-1).astype(np.float64)
+        return LossResult(float(np.sum(np.abs(pred - t))), t.shape[0])
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (later-BigDL parity; simple extra)."""
+
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+
+    def __call__(self, output, target):
+        o = np.asarray(output).reshape(-1)
+        t = np.asarray(target).reshape(-1)
+        pos = o[t > 0.5]
+        rank = np.sum(o[None, :] > pos[:, None], axis=-1) + 1
+        hit = np.sum(rank <= self.k)
+        return AccuracyResult(float(hit), pos.shape[0])
+
+
+class NDCG(ValidationMethod):
+    name = "NDCG"
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def __call__(self, output, target):
+        o = np.asarray(output).reshape(-1)
+        t = np.asarray(target).reshape(-1)
+        pos = o[t > 0.5]
+        rank = np.sum(o[None, :] > pos[:, None], axis=-1) + 1
+        gain = np.where(rank <= self.k, 1.0 / np.log2(rank + 1), 0.0)
+        return AccuracyResult(float(np.sum(gain)), pos.shape[0])

@@ -1,0 +1,8 @@
+"""bigdl_tpu.parallel — sharding strategies over the device mesh.
+
+The reference's only strategy is sync data-parallel SGD over the Spark block
+manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7).
+"""
+
+from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
+                       TensorParallel)

@@ -1,0 +1,117 @@
+"""Sharding strategies: how params/batches map onto the device mesh.
+
+Reference: BigDL's only inter-node strategy is synchronous data parallelism over
+the Spark block manager (parameters/AllReduceParameter.scala:53-60): every node
+holds a full replica, gradients reduce-scatter into 1/N slices, each node updates
+its slice, weights allgather lazily.  That algorithm IS data parallelism with a
+sharded optimizer — expressed here as sharding specs compiled into one XLA
+program, with collectives over ICI (SURVEY.md §5.8).
+
+Strategies:
+- DataParallel: params replicated, batch sharded on 'data'.  Matches the
+  reference exactly (grads all-reduce in the wire dtype = bf16, like
+  FP16CompressedTensor).
+- ShardedDataParallel: params + optimizer state sharded on 'data' (ZeRO-style —
+  the TPU-native form of the reference's "each node updates only its 1/N weight
+  slice", DistriOptimizer.scala:265-280).
+- TensorParallel (net-new vs reference, SURVEY.md §7): large Linear/conv layers
+  split over the 'model' axis by a rule table keyed on parameter path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
+           "TensorParallel"]
+
+
+class ShardingStrategy:
+    """Produces NamedShardings for params, optimizer state, and batches."""
+
+    def param_sharding(self, mesh: Mesh, params):
+        raise NotImplementedError
+
+    def batch_sharding(self, mesh: Mesh):
+        axes = [a for a in ("data",) if a in mesh.axis_names]
+        # batch dim sharded over the data axis; everything else replicated
+        return NamedSharding(mesh, P(tuple(axes) if axes else None))
+
+    def opt_state_sharding(self, mesh: Mesh, opt_state, param_shardings):
+        """Default: mirror the param sharding for momentum-like slots, replicate
+        scalars."""
+        def share(leaf):
+            if hasattr(leaf, "ndim") and leaf.ndim == 0:
+                return NamedSharding(mesh, P())
+            return None  # filled by matching params below
+        return None  # None = let jit infer from params/update structure
+
+
+class DataParallel(ShardingStrategy):
+    """Replicated params, data-sharded batch (the reference's strategy)."""
+
+    def param_sharding(self, mesh, params):
+        rep = NamedSharding(mesh, P())
+        return jax.tree.map(lambda _: rep, params)
+
+
+class ShardedDataParallel(ShardingStrategy):
+    """ZeRO-ish: 1-D shard each parameter over 'data' along its largest
+    divisible axis; small params stay replicated."""
+
+    def __init__(self, min_size: int = 2 ** 14):
+        self.min_size = min_size
+
+    def param_sharding(self, mesh, params):
+        n = mesh.shape.get("data", 1)
+
+        def spec(leaf):
+            if leaf.size < self.min_size:
+                return NamedSharding(mesh, P())
+            for ax in range(leaf.ndim - 1, -1, -1):
+                if leaf.shape[ax] % n == 0:
+                    parts = [None] * leaf.ndim
+                    parts[ax] = "data"
+                    return NamedSharding(mesh, P(*parts))
+            return NamedSharding(mesh, P())
+
+        return jax.tree.map(spec, params)
+
+
+class TensorParallel(ShardingStrategy):
+    """Megatron-style TP over the 'model' axis, rule-driven by parameter path.
+
+    rule(path, leaf) -> PartitionSpec or None (None = replicate).  The default
+    rule shards the LAST axis of 2-D+ weights whose size divides the axis —
+    column-parallel Linear; models can pass a custom rule for row/column
+    alternation.
+    """
+
+    def __init__(self, rule: Optional[Callable] = None):
+        self.rule = rule
+
+    def param_sharding(self, mesh, params):
+        n = mesh.shape.get("model", 1)
+
+        def default_rule(path, leaf):
+            if leaf.ndim >= 2 and leaf.shape[-1] % n == 0 and leaf.size >= 2 ** 16:
+                parts = [None] * leaf.ndim
+                parts[-1] = "model"
+                return P(*parts)
+            return P()
+
+        rule = self.rule or default_rule
+
+        def spec(path, leaf):
+            s = rule(path, leaf)
+            return NamedSharding(mesh, s if s is not None else P())
+
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    def batch_sharding(self, mesh):
+        axes = [a for a in ("data",) if a in mesh.axis_names]
+        return NamedSharding(mesh, P(tuple(axes) if axes else None))

@@ -1,0 +1,5 @@
+from .table import Table, T
+from .engine import Engine
+from .rng import RandomGenerator, RNG
+
+__all__ = ["Table", "T", "Engine", "RandomGenerator", "RNG"]

@@ -1,0 +1,109 @@
+"""Engine: device-topology discovery and execution configuration.
+
+Reference: BigDL `utils/Engine.scala:36` — `Engine.init` (:93) discovers cluster
+topology (node count x cores per node) from the Spark master URL
+(`parseExecutorAndCore`, :353-418) and builds two thread pools (`Engine.default`,
+`Engine.model`, :241-257) that all layers and the optimizer use.
+
+TPU-native re-design: topology discovery is `jax.devices()` / `jax.process_count()`;
+the "thread pools" collapse into XLA — a single compiled train step uses every core of
+every chip it is sharded over.  `Engine.init()` builds the global `jax.sharding.Mesh`
+that the rest of the framework (Optimizer, DataSet sharding, parallel strategies)
+consumes.  Node-count-as-a-parameter is preserved: like BigDL's
+`Engine.setNodeAndCore` trick that lets tests simulate an N-node cluster in one JVM
+(utils/Engine.scala:313, used by DistriOptimizerSpec), `Engine.init(mesh_shape=...)`
+can build any mesh over however many (possibly virtual CPU) devices exist.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["Engine"]
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class Engine:
+    """Process-wide singleton holding the device mesh (BigDL: utils/Engine.scala:36)."""
+
+    _mesh: Optional[Mesh] = None
+    _initialized = False
+
+    #: canonical mesh axis names, in order: data, pipeline(stage), tensor(model),
+    #: sequence(context), expert
+    DATA_AXIS = "data"
+    PIPE_AXIS = "pipe"
+    MODEL_AXIS = "model"
+    SEQ_AXIS = "seq"
+    EXPERT_AXIS = "expert"
+
+    @classmethod
+    def init(cls, mesh_shape: Optional[dict] = None,
+             devices: Optional[Sequence] = None) -> Mesh:
+        """Discover devices and build the global mesh.
+
+        mesh_shape: dict axis_name -> size, e.g. {"data": 4, "model": 2}.
+          Defaults to pure data parallelism over every visible device — the
+          reference's only inter-node strategy (SURVEY.md §2.5: sync data-parallel
+          SGD is BigDL's sole distribution mode, optim/DistriOptimizer.scala).
+        devices: explicit device list (tests pass virtual CPU devices here).
+        """
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if mesh_shape is None:
+            mesh_shape = {cls.DATA_AXIS: len(devs)}
+        sizes = list(mesh_shape.values())
+        total = int(np.prod(sizes))
+        if total != len(devs):
+            raise ValueError(
+                f"mesh_shape {mesh_shape} needs {total} devices, have {len(devs)}")
+        dev_array = np.array(devs).reshape(sizes)
+        cls._mesh = Mesh(dev_array, tuple(mesh_shape.keys()))
+        cls._initialized = True
+        logger.info("Engine.init: mesh %s over %d %s device(s)",
+                    dict(zip(cls._mesh.axis_names, cls._mesh.devices.shape)),
+                    len(devs), devs[0].platform)
+        return cls._mesh
+
+    @classmethod
+    def mesh(cls) -> Mesh:
+        if cls._mesh is None:
+            cls.init()
+        return cls._mesh
+
+    @classmethod
+    def set_mesh(cls, mesh: Mesh) -> None:
+        cls._mesh = mesh
+        cls._initialized = True
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._mesh = None
+        cls._initialized = False
+
+    # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
+
+    @classmethod
+    def node_number(cls) -> int:
+        """Number of host processes (BigDL: Engine.nodeNumber, utils/Engine.scala)."""
+        return jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        """Devices attached to this process (BigDL: Engine.coreNumber)."""
+        return jax.local_device_count()
+
+    @classmethod
+    def device_count(cls) -> int:
+        return len(cls.mesh().devices.reshape(-1))
+
+    @classmethod
+    def data_parallel_size(cls) -> int:
+        m = cls.mesh()
+        return m.shape[cls.DATA_AXIS] if cls.DATA_AXIS in m.axis_names else 1

@@ -1,0 +1,84 @@
+"""Checkpoint save/load.
+
+Reference: BigDL `utils/File.scala:25` — java-serialization save/load with
+HDFS/S3 support (saveToHdfs:106); checkpoint file contract `model.<neval>` /
+`optimMethod.<neval>` written by `optim/Optimizer.scala:284-322` and
+`DistriOptimizer.scala:394-416`, resumed via `getLatestFile`
+(DistriOptimizer.scala:828-845).
+
+TPU-native re-design: params/state pytrees are pulled to host numpy and written
+as a single .npz-in-pickle blob (portable, no JVM serialization); the
+`model.<neval>` / `optimMethod.<neval>` naming contract is preserved so
+resume-by-latest works identically.  Remote stores (HDFS/S3/GCS) are out of
+scope for this image (zero egress) — the API takes any local path.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File"]
+
+
+def _to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save(obj: Any, path: str, overwrite: bool = True) -> None:
+    """(File.scala:25 `save`)."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(_to_numpy(obj), f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load(path: str) -> Any:
+    """(File.scala `load`)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_checkpoint(path: str, neval: int, model_blob: Any,
+                    optim_blob: Any, overwrite: bool = True) -> Tuple[str, str]:
+    """Write model.<neval> + optimMethod.<neval>
+    (DistriOptimizer.scala:394-416)."""
+    os.makedirs(path, exist_ok=True)
+    mp = os.path.join(path, f"model.{neval}")
+    op = os.path.join(path, f"optimMethod.{neval}")
+    save(model_blob, mp, overwrite)
+    save(optim_blob, op, overwrite)
+    return mp, op
+
+
+def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
+    """Find the newest (model, optimMethod, neval) triple
+    (getLatestFile, DistriOptimizer.scala:828-845)."""
+    if not os.path.isdir(path):
+        return None
+    best = -1
+    for name in os.listdir(path):
+        m = re.fullmatch(r"model\.(\d+)", name)
+        if m:
+            n = int(m.group(1))
+            if n > best and os.path.exists(
+                    os.path.join(path, f"optimMethod.{n}")):
+                best = n
+    if best < 0:
+        return None
+    return (os.path.join(path, f"model.{best}"),
+            os.path.join(path, f"optimMethod.{best}"), best)
+
+
+class File:
+    """Namespace parity with the reference's `File` object."""
+
+    save = staticmethod(save)
+    load = staticmethod(load)

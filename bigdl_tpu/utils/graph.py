@@ -1,0 +1,98 @@
+"""DirectedGraph / Node: the DAG backbone of the Graph container and model import.
+
+Reference: BigDL `utils/DirectedGraph.scala:34,135` — `Node[T]` with edge ops
+(`->`: :155), `topologySort` (:52), `DFS` (:85), `BFS` (:108).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, List
+
+__all__ = ["Node", "DirectedGraph"]
+
+
+class Node:
+    """Graph node holding an `element` (DirectedGraph.scala:135)."""
+
+    def __init__(self, element: Any):
+        self.element = element
+        self.prev_nodes: List["Node"] = []
+        self.next_nodes: List["Node"] = []
+
+    def point_to(self, other: "Node") -> "Node":
+        """Add edge self -> other (reference's `->`, DirectedGraph.scala:155)."""
+        self.next_nodes.append(other)
+        other.prev_nodes.append(self)
+        return other
+
+    __gt__ = point_to  # a > b adds edge a->b
+
+    def __repr__(self):
+        return f"Node({self.element!r})"
+
+
+class DirectedGraph:
+    """DAG rooted at `source`; `reverse=True` walks prev edges
+    (DirectedGraph.scala:34)."""
+
+    def __init__(self, source: Node, reverse: bool = False):
+        self.source = source
+        self.reverse = reverse
+
+    def _next(self, node: Node):
+        return node.prev_nodes if self.reverse else node.next_nodes
+
+    def _prev(self, node: Node):
+        return node.next_nodes if self.reverse else node.prev_nodes
+
+    def bfs(self):
+        """Breadth-first traversal (DirectedGraph.scala:108)."""
+        seen, order, q = {id(self.source)}, [], deque([self.source])
+        while q:
+            n = q.popleft()
+            order.append(n)
+            for m in self._next(n):
+                if id(m) not in seen:
+                    seen.add(id(m))
+                    q.append(m)
+        return order
+
+    def dfs(self):
+        """Depth-first traversal (DirectedGraph.scala:85)."""
+        seen, order, stack = {id(self.source)}, [], [self.source]
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            for m in self._next(n):
+                if id(m) not in seen:
+                    seen.add(id(m))
+                    stack.append(m)
+        return order
+
+    def topology_sort(self):
+        """Kahn topological sort of nodes reachable from source
+        (DirectedGraph.scala:52); raises on cycles."""
+        reachable = self.bfs()
+        ids = {id(n) for n in reachable}
+        indeg = {id(n): sum(1 for p in self._prev(n) if id(p) in ids)
+                 for n in reachable}
+        q = deque(n for n in reachable if indeg[id(n)] == 0)
+        order = []
+        while q:
+            n = q.popleft()
+            order.append(n)
+            for m in self._next(n):
+                if id(m) in ids:
+                    indeg[id(m)] -= 1
+                    if indeg[id(m)] == 0:
+                        q.append(m)
+        if len(order) != len(reachable):
+            raise ValueError("graph contains a cycle")
+        return order
+
+    def size(self) -> int:
+        return len(self.bfs())
+
+    def edges(self) -> int:
+        return sum(len(self._next(n)) for n in self.bfs())

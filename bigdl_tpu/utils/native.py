@@ -1,0 +1,50 @@
+"""Loader for the native C++ runtime library (csrc/).
+
+Reference: BigDL's native layer is the BigDL-core JNI wrapper shipping
+`libjmkl.so` inside per-OS jars, loaded lazily on first use
+(tensor/Tensor.scala:688 comment; MKL.isMKLLoaded).  Here the math lives in
+XLA; the native library instead accelerates the host-side runtime: CRC32C
+(hardware SSE4.2 when available), record-file IO, and the prefetch pipeline.
+
+Pure-Python fallbacks exist for every entry point — the framework works
+without the compiled library, just slower on the host paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+__all__ = ["lib", "crc32c", "is_native_loaded"]
+
+_here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_candidates = [
+    os.path.join(_here, "lib", "libbigdl_tpu_native.so"),
+    os.path.join(os.path.dirname(_here), "csrc", "build",
+                 "libbigdl_tpu_native.so"),
+]
+
+lib = None
+for _p in _candidates:
+    if os.path.exists(_p):
+        try:
+            lib = ctypes.CDLL(_p)
+            break
+        except OSError:
+            lib = None
+
+crc32c = None
+if lib is not None:
+    try:
+        lib.bigdl_crc32c.restype = ctypes.c_uint32
+        lib.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+
+        def crc32c(data: bytes) -> int:  # noqa: F811
+            return lib.bigdl_crc32c(data, len(data))
+    except AttributeError:
+        crc32c = None
+
+
+def is_native_loaded() -> bool:
+    """(reference: MKL.isMKLLoaded)."""
+    return lib is not None

@@ -1,0 +1,126 @@
+"""BDRecord: the sharded record-file format replacing Hadoop SequenceFiles.
+
+Reference: BigDL reads training corpora from Spark-cached Hadoop SequenceFiles
+(`DataSet.SeqFileFolder`, dataset/DataSet.scala:319; ETL in
+models/utils/ImageNetSeqFileGenerator.scala).  On TPU hosts there is no HDFS;
+the equivalent is a dumb, seekable, shardable local record format.
+
+Format (little-endian), per record:
+    u64  length
+    u32  masked crc32c of the 8-byte length field
+    <length bytes>
+    u32  masked crc32c of the payload
+i.e. exactly the TFRecord framing (also used by the TensorBoard event writer,
+visualization/tensorboard), with the same CRC mask.  CRC32C is computed by the
+native C++ library (csrc/) when built, with a pure-Python fallback.
+
+Payloads are pickled objects (typically `Sample`s) via `write_records`, or raw
+bytes via the *_bytes variants.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import struct
+from typing import Any, Iterable, Iterator, List
+
+__all__ = ["write_records", "read_records", "write_record_bytes",
+           "read_record_bytes", "masked_crc32c"]
+
+
+def _crc32c_py(data: bytes) -> int:
+    """Pure-Python CRC32C (Castagnoli) — fallback when the native lib is
+    absent (reference vendors the same algorithm as netty/Crc32c.java)."""
+    global _TABLE
+    if _TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    from .native import crc32c as native_crc32c
+    if native_crc32c is not None:
+        return native_crc32c(data)
+    return _crc32c_py(data)
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord CRC mask (reference: RecordWriter.scala:44-57 /
+    netty/Crc32c.java)."""
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def write_record_bytes(f, payload: bytes) -> None:
+    header = struct.pack("<Q", len(payload))
+    f.write(header)
+    f.write(struct.pack("<I", masked_crc32c(header)))
+    f.write(payload)
+    f.write(struct.pack("<I", masked_crc32c(payload)))
+
+
+def read_record_bytes(f) -> bytes:
+    header = f.read(8)
+    if len(header) < 8:
+        raise EOFError
+    (length,) = struct.unpack("<Q", header)
+    (hcrc,) = struct.unpack("<I", f.read(4))
+    if hcrc != masked_crc32c(header):
+        raise IOError("corrupt record header (crc mismatch)")
+    payload = f.read(length)
+    (pcrc,) = struct.unpack("<I", f.read(4))
+    if pcrc != masked_crc32c(payload):
+        raise IOError("corrupt record payload (crc mismatch)")
+    return payload
+
+
+def write_records(path: str, records: Iterable[Any],
+                  shards: int = 1) -> List[str]:
+    """Write records round-robin over `shards` files: path-00000-of-00008 style
+    (the sharded layout Spark partitions played in the reference)."""
+    if shards == 1:
+        paths = [path]
+    else:
+        paths = [f"{path}-{i:05d}-of-{shards:05d}" for i in range(shards)]
+    files = [open(p + ".tmp", "wb") for p in paths]
+    try:
+        for i, rec in enumerate(records):
+            write_record_bytes(files[i % shards],
+                               pickle.dumps(rec, pickle.HIGHEST_PROTOCOL))
+    finally:
+        for fh in files:
+            fh.close()
+    for p in paths:
+        os.replace(p + ".tmp", p)
+    return paths
+
+
+def read_records(path: str) -> Iterator[Any]:
+    """Read one shard file, a glob pattern, or a `base` written with shards>1."""
+    paths = sorted(glob.glob(path)) or sorted(glob.glob(path + "-*-of-*"))
+    if not paths and os.path.exists(path):
+        paths = [path]
+    if not paths:
+        raise FileNotFoundError(path)
+    for p in paths:
+        with open(p, "rb") as f:
+            while True:
+                try:
+                    yield pickle.loads(read_record_bytes(f))
+                except EOFError:
+                    break

@@ -1,0 +1,54 @@
+"""Table: Torch-style heterogeneous container used for multi-input/output activities
+and optimizer state.
+
+Reference: BigDL `utils/Table.scala:34` (int-or-any keyed table, used as the `Activity`
+union's non-tensor half) and the `T()` constructor (`utils/Table.scala:299`).
+
+TPU-native re-design: a Table is just a Python dict registered as a JAX pytree, so it
+flows through `jax.jit` / `jax.grad` / shardings like any other container.  Integer
+keys (Torch's 1-based convention) are supported for parity, but idiomatic code should
+use lists/tuples, which JAX already treats as pytrees.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Table", "T"]
+
+
+class Table(dict):
+    """A dict that tolerates Torch-style `table[1]`, `table[2]` integer keys."""
+
+    def insert(self, value):
+        """Append with the next free 1-based integer key (Torch semantics)."""
+        i = 1
+        while i in self:
+            i += 1
+        self[i] = value
+        return self
+
+    def __repr__(self):
+        inner = ", ".join(f"{k!r}: {v!r}" for k, v in self.items())
+        return "T{" + inner + "}"
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t.keys(), key=lambda k: (str(type(k)), k))
+    return [t[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, values):
+    return Table(zip(keys, values))
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)
+
+
+def T(*args, **kwargs) -> Table:
+    """`T(a, b, c)` -> Table with 1-based integer keys; `T(k=v)` -> named entries."""
+    t = Table()
+    for i, a in enumerate(args):
+        t[i + 1] = a
+    t.update(kwargs)
+    return t

@@ -1,0 +1,28 @@
+"""Test environment: 8 virtual CPU devices so distributed machinery is exercised
+without TPU hardware — the TPU-native version of the reference's
+`Engine.setNodeAndCore(4, 4)` simulate-a-cluster-in-one-JVM trick
+(DistriOptimizerSpec.scala:33-41, SURVEY.md §4).
+
+Note: this image's sitecustomize imports jax at interpreter startup (axon TPU
+plugin), so env vars are too late — use jax.config.update instead, which works
+as long as no backend has been initialized yet.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # harmless if sitecustomize won
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine():
+    from bigdl_tpu.utils.engine import Engine
+    Engine.reset()
+    yield
+    Engine.reset()

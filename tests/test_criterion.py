@@ -1,0 +1,131 @@
+"""Criterion tests: golden values vs numpy and gradient sanity."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+
+
+def test_mse():
+    c = nn.MSECriterion()
+    o = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    t = jnp.zeros((2, 2))
+    np.testing.assert_allclose(float(c.forward(o, t)), (1 + 4 + 9 + 16) / 4)
+    g = c.backward(o, t)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(o) / 2)
+
+
+def test_abs_criterion():
+    c = nn.AbsCriterion()
+    o = jnp.asarray([1.0, -2.0])
+    np.testing.assert_allclose(float(c.forward(o, jnp.zeros(2))), 1.5)
+
+
+def test_classnll_and_crossentropy_agree():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 5)),
+                         dtype=jnp.float32)
+    labels = jnp.asarray([0, 2, 4, 1])
+    ce = nn.CrossEntropyCriterion()
+    nll = nn.ClassNLLCriterion()
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    np.testing.assert_allclose(float(ce.forward(logits, labels)),
+                               float(nll.forward(lsm, labels)), rtol=1e-6)
+    # golden: manual mean of -logp[label]
+    expect = -np.mean(np.asarray(lsm)[np.arange(4), np.asarray(labels)])
+    np.testing.assert_allclose(float(ce.forward(logits, labels)), expect,
+                               rtol=1e-6)
+
+
+def test_classnll_one_based_and_weights():
+    lsm = jax.nn.log_softmax(jnp.asarray([[1.0, 2.0, 3.0]]), axis=-1)
+    a = nn.ClassNLLCriterion(one_based=True).forward(lsm, jnp.asarray([3]))
+    b = nn.ClassNLLCriterion().forward(lsm, jnp.asarray([2]))
+    np.testing.assert_allclose(float(a), float(b))
+    w = jnp.asarray([1.0, 1.0, 2.0])
+    c = nn.ClassNLLCriterion(weights=w).forward(lsm, jnp.asarray([2]))
+    np.testing.assert_allclose(float(c), float(b))  # normalized by weight sum
+
+
+def test_bce():
+    c = nn.BCECriterion()
+    o = jnp.asarray([0.9, 0.1])
+    t = jnp.asarray([1.0, 0.0])
+    expect = -np.mean([np.log(0.9), np.log(0.9)])
+    np.testing.assert_allclose(float(c.forward(o, t)), expect, rtol=1e-5)
+
+
+def test_smooth_l1():
+    c = nn.SmoothL1Criterion()
+    o = jnp.asarray([0.5, 2.0])
+    t = jnp.zeros(2)
+    np.testing.assert_allclose(float(c.forward(o, t)),
+                               (0.5 * 0.25 + 1.5) / 2, rtol=1e-6)
+
+
+def test_margin_and_hinge():
+    c = nn.MarginCriterion()
+    o = jnp.asarray([0.5, 2.0])
+    t = jnp.asarray([1.0, 1.0])
+    np.testing.assert_allclose(float(c.forward(o, t)), 0.25)
+    h = nn.HingeEmbeddingCriterion(margin=1.0)
+    np.testing.assert_allclose(
+        float(h.forward(jnp.asarray([0.3]), jnp.asarray([-1.0]))), 0.7,
+        rtol=1e-6)
+
+
+def test_kldiv():
+    c = nn.DistKLDivCriterion()
+    target = jnp.asarray([[0.5, 0.5]])
+    logp = jnp.log(jnp.asarray([[0.5, 0.5]]))
+    np.testing.assert_allclose(float(c.forward(logp, target)), 0.0, atol=1e-6)
+
+
+def test_multi_and_parallel_criterion():
+    mc = nn.MultiCriterion().add(nn.MSECriterion()).add(nn.AbsCriterion(), 0.5)
+    o, t = jnp.asarray([2.0]), jnp.asarray([0.0])
+    np.testing.assert_allclose(float(mc.forward(o, t)), 4.0 + 0.5 * 2.0)
+    pc = (nn.ParallelCriterion()
+          .add(nn.MSECriterion())
+          .add(nn.AbsCriterion()))
+    np.testing.assert_allclose(
+        float(pc.forward([o, o], [t, t])), 4.0 + 2.0)
+
+
+def test_cosine_embedding():
+    c = nn.CosineEmbeddingCriterion()
+    x = jnp.asarray([[1.0, 0.0]])
+    l = c.forward([x, x], jnp.asarray([1.0]))
+    np.testing.assert_allclose(float(l), 0.0, atol=1e-6)
+
+
+def test_multimargin_and_multilabel():
+    o = jnp.asarray([[0.1, 0.2, 0.7]])
+    t = jnp.asarray([2])
+    l = nn.MultiMarginCriterion().forward(o, t)
+    expect = (max(0, 1 - 0.7 + 0.1) + max(0, 1 - 0.7 + 0.2)) / 3
+    np.testing.assert_allclose(float(l), expect, rtol=1e-5)
+    ml = nn.MultiLabelSoftMarginCriterion()
+    val = ml.forward(jnp.asarray([[0.0, 0.0]]), jnp.asarray([[1.0, 0.0]]))
+    np.testing.assert_allclose(float(val), np.log(2), rtol=1e-5)
+
+
+def test_softmax_with_criterion_spatial():
+    logits = jnp.asarray(np.random.default_rng(1).normal(size=(2, 4, 4, 3)),
+                         dtype=jnp.float32)
+    labels = jnp.asarray(np.random.default_rng(2).integers(0, 3, size=(2, 4, 4)))
+    l = nn.SoftmaxWithCriterion().forward(logits, labels)
+    assert np.isfinite(float(l))
+
+
+def test_time_distributed_criterion():
+    c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+    o = jnp.ones((2, 3, 4))
+    t = jnp.zeros((2, 3, 4))
+    np.testing.assert_allclose(float(c.forward(o, t)), 1.0, rtol=1e-6)
+
+
+def test_dice():
+    c = nn.DiceCoefficientCriterion()
+    o = jnp.asarray([[1.0, 1.0]])
+    np.testing.assert_allclose(float(c.forward(o, o)), 0.0, atol=1e-6)

@@ -1,0 +1,143 @@
+"""Data pipeline tests: transformers, batching, record IO, image ops."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (DataSet, Sample, MiniBatch, SampleToMiniBatch,
+                               FixedLength, PaddingParam)
+from bigdl_tpu.dataset.image import (LabeledImage, ImgCropper, ImgRdmCropper,
+                                     ImgNormalizer, HFlip, ColorJitter,
+                                     Lighting, ImgToSample, RdmResizedCrop,
+                                     _resize_bilinear)
+from bigdl_tpu.utils import recordio
+
+
+def samples(n=10):
+    return [Sample.from_ndarray(np.full((3,), i, np.float32), np.int32(i))
+            for i in range(n)]
+
+
+def test_sample_to_minibatch():
+    ds = DataSet.array(samples(10)).transform(SampleToMiniBatch(4))
+    batches = list(ds.data(train=False))
+    assert [b.size() for b in batches] == [4, 4, 2]
+    ds2 = DataSet.array(samples(10)).transform(
+        SampleToMiniBatch(4, drop_last=True))
+    assert [b.size() for b in list(ds2.data(train=False))] == [4, 4]
+    ds3 = DataSet.array(samples(10)).transform(
+        SampleToMiniBatch(4, pad_last=True))
+    batches = list(ds3.data(train=False))
+    assert [b.size() for b in batches] == [4, 4, 4]
+    assert batches[-1].valid == 2
+
+
+def test_minibatch_slice():
+    ds = DataSet.array(samples(8)).transform(SampleToMiniBatch(8))
+    b = next(iter(ds.data(train=False)))
+    sub = b.slice(2, 3)
+    assert sub.size() == 3
+    np.testing.assert_allclose(sub.get_input()[0], [2, 2, 2])
+
+
+def test_variable_length_padding():
+    recs = [Sample.from_ndarray(np.ones((l, 2), np.float32), np.int32(0))
+            for l in (3, 5, 2)]
+    ds = DataSet.array(recs).transform(
+        SampleToMiniBatch(3, feature_padding=PaddingParam(0.0)))
+    b = next(iter(ds.data(train=False)))
+    assert b.get_input().shape == (3, 5, 2)
+    ds2 = DataSet.array(recs).transform(
+        SampleToMiniBatch(3, feature_padding=FixedLength(8)))
+    b2 = next(iter(ds2.data(train=False)))
+    assert b2.get_input().shape == (3, 8, 2)
+
+
+def test_shuffle_deterministic():
+    ds = DataSet.array(samples(10), seed=42)
+    ds.shuffle()
+    order1 = [int(s.label) for s in ds.data(train=True)]
+    ds2 = DataSet.array(samples(10), seed=42)
+    ds2.shuffle()
+    order2 = [int(s.label) for s in ds2.data(train=True)]
+    assert order1 == order2 and order1 != list(range(10))
+
+
+def test_distributed_dataset_shards():
+    from bigdl_tpu.dataset import DistributedDataSet
+    all_seen = []
+    for pi in range(4):
+        ds = DistributedDataSet(samples(20), process_index=pi, process_count=4)
+        assert ds.size() == 20
+        local = [int(s.label) for s in ds.data(train=False)]
+        assert len(local) == 5
+        all_seen += local
+    assert sorted(all_seen) == list(range(20))
+
+
+def test_transformer_chaining():
+    imgs = [LabeledImage(np.ones((8, 8, 3), np.float32), float(i))
+            for i in range(4)]
+    chain = (ImgCropper(4, 4)
+             >> ImgNormalizer([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+             >> ImgToSample())
+    out = list(chain(iter(imgs)))
+    assert len(out) == 4
+    assert out[0].feature.shape == (4, 4, 3)
+    np.testing.assert_allclose(out[0].feature, 1.0)
+
+
+def test_image_augmentations_shapes():
+    imgs = [LabeledImage(np.random.default_rng(0).random((16, 12, 3))
+                         .astype(np.float32), 1.0)]
+    for t in (ImgRdmCropper(8, 8, padding=2), HFlip(1.0), ColorJitter(),
+              Lighting(), RdmResizedCrop(8, 8)):
+        out = list(t(iter([imgs[0]])))
+        assert out[0].data.shape[2] == 3
+
+
+def test_resize_bilinear_golden():
+    img = np.asarray([[0.0, 1.0], [2.0, 3.0]], np.float32)[:, :, None]
+    out = _resize_bilinear(img, 4, 4)
+    assert out.shape == (4, 4, 1)
+    np.testing.assert_allclose(out[0, 0, 0], 0.0)
+    np.testing.assert_allclose(out.mean(), img.mean(), atol=0.1)
+
+
+def test_recordio_roundtrip(tmp_path):
+    recs = samples(13)
+    path = str(tmp_path / "data.rec")
+    recordio.write_records(path, recs)
+    back = list(recordio.read_records(path))
+    assert len(back) == 13
+    np.testing.assert_allclose(back[5].feature, recs[5].feature)
+
+
+def test_recordio_sharded(tmp_path):
+    path = str(tmp_path / "shards")
+    paths = recordio.write_records(path, samples(10), shards=4)
+    assert len(paths) == 4
+    back = list(recordio.read_records(path))
+    assert sorted(int(s.label) for s in back) == list(range(10))
+
+
+def test_recordio_corruption_detected(tmp_path):
+    path = str(tmp_path / "data.rec")
+    recordio.write_records(path, samples(2))
+    raw = bytearray(open(path, "rb").read())
+    raw[20] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises((IOError, Exception)):
+        list(recordio.read_records(path))
+
+
+def test_crc32c_golden():
+    # known CRC32C test vector: "123456789" -> 0xE3069283
+    from bigdl_tpu.utils.recordio import _crc32c_py
+    assert _crc32c_py(b"123456789") == 0xE3069283
+
+
+def test_dataset_record_file_builder(tmp_path):
+    path = str(tmp_path / "ds.rec")
+    recordio.write_records(path, samples(6))
+    ds = DataSet.record_file(path)
+    assert ds.size() == 6

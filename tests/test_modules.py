@@ -1,0 +1,294 @@
+"""Layer unit tests: shapes, gradients, and golden values vs numpy references.
+
+Models the reference's three-tier strategy (SURVEY.md §4): the Torch7 oracle of
+`test/.../torch/` (122 specs) is replaced by numpy-computed golden values.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def rng():
+    return jax.random.key(0)
+
+
+def test_linear_forward_matches_numpy():
+    m = nn.Linear(4, 3).build(rng())
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4)),
+                    dtype=jnp.float32)
+    y = m.forward(x)
+    w, b = np.asarray(m.params["weight"]), np.asarray(m.params["bias"])
+    expect = np.asarray(x) @ w.T + b
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_linear_backward_accumulates():
+    m = nn.Linear(4, 3).build(rng())
+    x = jnp.ones((2, 4))
+    y = m.forward(x)
+    g = jnp.ones_like(y)
+    gx = m.backward(x, g)
+    assert gx.shape == x.shape
+    # accGradParameters semantics: second backward doubles the grads
+    g1 = np.asarray(m.grads["weight"]).copy()
+    m.backward(x, g)
+    np.testing.assert_allclose(np.asarray(m.grads["weight"]), 2 * g1, rtol=1e-6)
+    m.zero_grad_parameters()
+    assert float(jnp.sum(jnp.abs(m.grads["weight"]))) == 0.0
+
+
+def test_get_parameters_flat_contract():
+    m = nn.Sequential().add(nn.Linear(4, 3)).add(nn.ReLU()).add(nn.Linear(3, 2))
+    m.build(rng())
+    w, g = m.get_parameters()
+    assert w.ndim == 1 and w.shape == g.shape
+    assert w.shape[0] == 4 * 3 + 3 + 3 * 2 + 2
+    m.set_flat_parameters(jnp.zeros_like(w))
+    w2, _ = m.get_parameters()
+    assert float(jnp.sum(jnp.abs(w2))) == 0.0
+
+
+def test_spatial_convolution_shape_and_golden():
+    m = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1).build(rng())
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 8, 3)),
+                    dtype=jnp.float32)
+    y = m.forward(x)
+    assert y.shape == (2, 8, 8, 8)
+    # golden check of one output pixel against explicit correlation
+    w = np.asarray(m.params["weight"])  # (3,3,3,8)
+    b = np.asarray(m.params["bias"])
+    xp = np.pad(np.asarray(x), ((0, 0), (1, 1), (1, 1), (0, 0)))
+    patch = xp[0, 3:6, 4:7, :]  # output pixel (0, 3, 4): window starts at (3, 4)
+    expect = np.tensordot(patch, w, axes=([0, 1, 2], [0, 1, 2])) + b
+    np.testing.assert_allclose(np.asarray(y)[0, 3, 4], expect, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv_groups():
+    m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2).build(rng())
+    x = jnp.ones((1, 5, 5, 4))
+    assert m.forward(x).shape == (1, 3, 3, 8)
+
+
+def test_dilated_and_full_convolution():
+    m = nn.SpatialDilatedConvolution(3, 4, 3, 3, dilation_w=2, dilation_h=2)
+    y = m.build(rng()).forward(jnp.ones((1, 9, 9, 3)))
+    assert y.shape == (1, 5, 5, 4)
+    # transposed conv doubles spatial size with stride 2
+    d = nn.SpatialFullConvolution(3, 4, 4, 4, 2, 2, 1, 1).build(rng())
+    y2 = d.forward(jnp.ones((1, 8, 8, 3)))
+    assert y2.shape == (1, 16, 16, 4)
+
+
+def test_temporal_convolution():
+    m = nn.TemporalConvolution(16, 32, 5, 2).build(rng())
+    y = m.forward(jnp.ones((4, 21, 16)))
+    assert y.shape == (4, 9, 32)
+
+
+def test_max_pooling_golden():
+    m = nn.SpatialMaxPooling(2, 2, 2, 2)
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    y = m.build(rng()).forward(x)
+    np.testing.assert_allclose(
+        np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_avg_pooling():
+    m = nn.SpatialAveragePooling(2, 2, 2, 2)
+    x = jnp.ones((1, 4, 4, 2))
+    np.testing.assert_allclose(np.asarray(m.build(rng()).forward(x)),
+                               np.ones((1, 2, 2, 2)))
+
+
+def test_pool_ceil_mode():
+    m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    y = m.build(rng()).forward(jnp.ones((1, 6, 6, 1)))
+    assert y.shape == (1, 3, 3, 1)
+    m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
+    assert m2.build(rng()).forward(jnp.ones((1, 6, 6, 1))).shape == (1, 2, 2, 1)
+
+
+def test_batchnorm_train_and_eval():
+    m = nn.BatchNormalization(6).build(rng())
+    x = jnp.asarray(np.random.default_rng(2).normal(3.0, 2.0, size=(32, 6)),
+                    dtype=jnp.float32)
+    m.training()
+    y = m.forward(x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), np.zeros(6),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jnp.std(y, 0)), np.ones(6),
+                               atol=1e-2)
+    # running stats moved toward batch stats
+    assert float(jnp.sum(jnp.abs(m.state["running_mean"]))) > 0
+    m.evaluate()
+    y2 = m.forward(x)
+    assert y2.shape == x.shape
+
+
+def test_dropout_train_vs_eval():
+    m = nn.Dropout(0.5).build(rng())
+    x = jnp.ones((1000,))
+    m.training()
+    y = m.forward(x)
+    zeros = float(jnp.sum(y == 0))
+    assert 300 < zeros < 700
+    kept = np.asarray(y)[np.asarray(y) != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(x)), np.asarray(x))
+
+
+def test_lookup_table():
+    m = nn.LookupTable(10, 4).build(rng())
+    idx = jnp.asarray([[1, 2], [3, 4]])
+    y = m.forward(idx)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(y[0, 0]),
+                               np.asarray(m.params["weight"])[1])
+
+
+def test_activations_golden():
+    x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+    cases = {
+        nn.ReLU(): np.maximum(np.asarray(x), 0),
+        nn.ReLU6(): np.clip(np.asarray(x), 0, 6),
+        nn.Tanh(): np.tanh(np.asarray(x)),
+        nn.Sigmoid(): 1 / (1 + np.exp(-np.asarray(x))),
+        nn.ELU(): np.where(np.asarray(x) > 0, np.asarray(x),
+                           np.expm1(np.asarray(x))),
+        nn.LeakyReLU(0.1): np.where(np.asarray(x) >= 0, np.asarray(x),
+                                    0.1 * np.asarray(x)),
+        nn.HardTanh(): np.clip(np.asarray(x), -1, 1),
+        nn.SoftSign(): np.asarray(x) / (1 + np.abs(np.asarray(x))),
+        nn.TanhShrink(): np.asarray(x) - np.tanh(np.asarray(x)),
+    }
+    for mod, expect in cases.items():
+        got = np.asarray(mod.build(rng()).forward(x))
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6,
+                                   err_msg=type(mod).__name__)
+
+
+def test_softmax_logsoftmax():
+    x = jnp.asarray([[1.0, 2.0, 3.0]])
+    sm = np.asarray(nn.SoftMax().build(rng()).forward(x))
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    lsm = np.asarray(nn.LogSoftMax().build(rng()).forward(x))
+    np.testing.assert_allclose(np.exp(lsm), sm, rtol=1e-5)
+
+
+def test_containers_concat_table_ops():
+    ct = nn.ConcatTable().add(nn.Identity()).add(nn.MulConstant(2.0))
+    ct.build(rng())
+    x = jnp.ones((2, 3))
+    outs = ct.forward(x)
+    assert len(outs) == 2
+    add = nn.CAddTable().build(rng())
+    np.testing.assert_allclose(np.asarray(add.forward(outs)),
+                               3 * np.ones((2, 3)))
+    j = nn.JoinTable(1).build(rng())
+    assert j.forward(outs).shape == (2, 6)
+
+
+def test_concat_module():
+    c = nn.Concat(-1).add(nn.Linear(4, 2)).add(nn.Linear(4, 3))
+    y = c.build(rng()).forward(jnp.ones((5, 4)))
+    assert y.shape == (5, 5)
+
+
+def test_graph_dag():
+    inp = nn.Input()
+    h = nn.Linear(4, 8)(inp)
+    a = nn.ReLU()(h)
+    b = nn.Tanh()(h)
+    out = nn.CAddTable()([a, b])
+    g = nn.Graph(inp, out).build(rng())
+    y = g.forward(jnp.ones((2, 4)))
+    assert y.shape == (2, 8)
+    gx = g.backward(jnp.ones((2, 4)), jnp.ones_like(y))
+    assert gx.shape == (2, 4)
+
+
+def test_recurrent_lstm_gru():
+    for cell in (nn.LSTM(5, 7), nn.GRU(5, 7), nn.RnnCell(5, 7),
+                 nn.LSTMPeephole(5, 7)):
+        m = nn.Recurrent(cell).build(rng())
+        y = m.forward(jnp.ones((3, 11, 5)))
+        assert y.shape == (3, 11, 7), type(cell).__name__
+        gx = m.backward(jnp.ones((3, 11, 5)), jnp.ones_like(y))
+        assert gx.shape == (3, 11, 5)
+
+
+def test_bi_recurrent_and_time_distributed():
+    m = nn.BiRecurrent(nn.LSTM(5, 7), merge="concat").build(rng())
+    assert m.forward(jnp.ones((2, 6, 5))).shape == (2, 6, 14)
+    td = nn.TimeDistributed(nn.Linear(7, 3)).build(rng())
+    assert td.forward(jnp.ones((2, 6, 7))).shape == (2, 6, 3)
+
+
+def test_shape_ops():
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nn.Reshape((12,)).build(rng()).forward(x).shape == (2, 12)
+    assert nn.Transpose([(1, 2)]).build(rng()).forward(x).shape == (2, 4, 3)
+    assert nn.Squeeze().build(rng()).forward(jnp.ones((2, 1, 3))).shape == (2, 3)
+    assert nn.Unsqueeze(1).build(rng()).forward(x).shape == (2, 1, 3, 4)
+    assert nn.Select(1, 0).build(rng()).forward(x).shape == (2, 4)
+    assert nn.Narrow(1, 1, 2).build(rng()).forward(x).shape == (2, 2, 4)
+    assert nn.Reverse(1).build(rng()).forward(x).shape == x.shape
+    assert nn.Padding(1, 2).build(rng()).forward(x).shape == (2, 5, 4)
+    assert nn.SpatialZeroPadding(1).build(rng()).forward(
+        jnp.ones((1, 4, 4, 2))).shape == (1, 6, 6, 2)
+
+
+def test_spatial_crossmap_lrn():
+    m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0).build(rng())
+    x = jnp.ones((1, 2, 2, 8))
+    y = m.forward(x)
+    assert y.shape == x.shape
+    assert float(y[0, 0, 0, 4]) < 1.0  # normalized down
+
+
+def test_prelu_and_scale():
+    m = nn.PReLU().build(rng())
+    y = m.forward(jnp.asarray([-4.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(y), [-1.0, 4.0], rtol=1e-6)
+    s = nn.Scale((3,)).build(rng())
+    assert s.forward(jnp.ones((2, 3))).shape == (2, 3)
+
+
+def test_gradient_reversal():
+    m = nn.GradientReversal(0.5).build(rng())
+    x = jnp.ones((3,))
+    y = m.forward(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    gx = m.backward(x, jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(gx), -0.5 * np.ones(3))
+
+
+def test_gradient_check_small_mlp():
+    """Finite-difference gradient check (the reference's GradientChecker,
+    test/.../nn/ shape/gradient specs)."""
+    m = nn.Sequential().add(nn.Linear(3, 4)).add(nn.Tanh()).add(nn.Linear(4, 2))
+    m.build(rng())
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(5, 3)),
+                    dtype=jnp.float32)
+
+    def f(params):
+        y, _ = m.apply(params, m.state, x)
+        return jnp.sum(jnp.square(y))
+
+    g = jax.grad(f)(m.params)
+    eps = 1e-3
+    leaf = m.params[0]["weight"]
+    for idx in [(0, 0), (2, 1)]:
+        p_plus = jax.tree.map(lambda t: t, m.params)
+        p_plus[0]["weight"] = leaf.at[idx].add(eps)
+        p_minus = jax.tree.map(lambda t: t, m.params)
+        p_minus[0]["weight"] = leaf.at[idx].add(-eps)
+        fd = (f(p_plus) - f(p_minus)) / (2 * eps)
+        np.testing.assert_allclose(float(g[0]["weight"][idx]), float(fd),
+                                   rtol=1e-2, atol=1e-3)

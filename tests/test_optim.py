@@ -1,0 +1,120 @@
+"""Optim method + schedule + trigger unit tests.
+
+Models the reference's optimizer unit tier (SURVEY.md §4): simple reference
+implementations cross-checked against the real ones (RefLocalOptimizer idea) and
+LR-schedule math specs.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.optim import (SGD, Adam, Adagrad, Adadelta, Adamax, RMSprop,
+                             LBFGS, Trigger, Poly, Step, MultiStep, EpochStep,
+                             Default, Warmup, SequentialSchedule,
+                             Top1Accuracy, Top5Accuracy)
+
+
+def quadratic_min(method, steps=150, tol=1e-2):
+    """All methods must minimize f(x) = ||x - c||^2."""
+    c = jnp.asarray([1.0, -2.0, 3.0])
+    params = {"w": jnp.zeros(3)}
+    state = method.init_state(params)
+    for i in range(steps):
+        grads = {"w": 2 * (params["w"] - c)}
+        lr = method.get_learning_rate({"evalCounter": i, "epoch": 1})
+        params, state = method.update(grads, params, state, lr)
+    return float(jnp.max(jnp.abs(params["w"] - c)))
+
+
+@pytest.mark.parametrize("method,steps,tol", [
+    (SGD(learning_rate=0.1), 100, 1e-2),
+    (SGD(learning_rate=0.05, momentum=0.9), 200, 1e-2),
+    (SGD(learning_rate=0.05, momentum=0.9, nesterov=True, dampening=0.0),
+     200, 1e-2),
+    (SGD(learning_rate=0.1, weight_decay=1e-4), 150, 2e-2),
+    (Adam(learning_rate=0.1), 300, 1e-2),
+    (Adagrad(learning_rate=0.5), 400, 5e-2),
+    (Adadelta(epsilon=1e-2), 500, 5e-2),
+    (Adamax(learning_rate=0.2), 300, 2e-2),
+    (RMSprop(learning_rate=0.05), 400, 2e-2),
+    (LBFGS(learning_rate=0.5), 60, 1e-2),
+])
+def test_methods_minimize_quadratic(method, steps, tol):
+    assert quadratic_min(method, steps) < tol
+
+
+def test_sgd_matches_manual_momentum():
+    m = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+    params = {"w": jnp.asarray([1.0])}
+    state = m.init_state(params)
+    g = {"w": jnp.asarray([1.0])}
+    params, state = m.update(g, params, state, 0.1)
+    np.testing.assert_allclose(np.asarray(params["w"]), [0.9])
+    params, state = m.update(g, params, state, 0.1)
+    # v = 0.9*1 + 1 = 1.9; w = 0.9 - 0.1*1.9 = 0.71
+    np.testing.assert_allclose(np.asarray(params["w"]), [0.71], rtol=1e-6)
+
+
+def test_schedules_golden():
+    opt = SGD(learning_rate=0.1)
+    assert Default().get_lr(opt, {"evalCounter": 0}) == 0.1
+    opt2 = SGD(learning_rate=0.1, learning_rate_decay=0.1)
+    np.testing.assert_allclose(
+        Default().get_lr(opt2, {"evalCounter": 10}), 0.1 / 2)
+    np.testing.assert_allclose(
+        Poly(0.5, 100).get_lr(opt, {"evalCounter": 75}), 0.1 * 0.5)
+    np.testing.assert_allclose(
+        Step(10, 0.5).get_lr(opt, {"evalCounter": 25}), 0.1 * 0.25)
+    np.testing.assert_allclose(
+        MultiStep([10, 20], 0.1).get_lr(opt, {"evalCounter": 15}), 0.01)
+    np.testing.assert_allclose(
+        EpochStep(2, 0.1).get_lr(opt, {"epoch": 5}), 0.1 * 0.01)
+    w = Warmup(0.01, 5, Step(10, 0.5))
+    np.testing.assert_allclose(w.get_lr(opt, {"evalCounter": 3}), 0.13)
+    seq = SequentialSchedule().add(Poly(1.0, 10), 10).add(Default(), 100)
+    np.testing.assert_allclose(seq.get_lr(opt, {"evalCounter": 5}), 0.05)
+    np.testing.assert_allclose(seq.get_lr(opt, {"evalCounter": 50}), 0.1)
+
+
+def test_triggers():
+    assert Trigger.max_epoch(3)({"epoch": 4})
+    assert not Trigger.max_epoch(3)({"epoch": 3})
+    assert Trigger.several_iteration(5)({"neval": 10})
+    assert not Trigger.several_iteration(5)({"neval": 11})
+    t = Trigger.every_epoch()
+    assert not t({"epoch": 1, "_epoch_just_finished": False})
+    assert t({"epoch": 2, "_epoch_just_finished": True})
+    assert not t({"epoch": 2, "_epoch_just_finished": True})  # fires once
+    assert Trigger.min_loss(0.1)({"loss": 0.05})
+    assert Trigger.max_score(0.9)({"score": 0.95})
+
+
+def test_validation_methods():
+    out = np.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    tgt = np.asarray([1, 0, 0])
+    r = Top1Accuracy()(out, tgt)
+    acc, n = r.result()
+    assert n == 3
+    np.testing.assert_allclose(acc, 2 / 3)
+    r2 = r + Top1Accuracy()(out, np.asarray([1, 0, 1]))
+    np.testing.assert_allclose(r2.result()[0], 5 / 6)
+    out5 = np.tile(np.arange(10, dtype=np.float64), (2, 1))
+    assert Top5Accuracy()(out5, np.asarray([9, 5])).result()[0] == 1.0
+    assert Top5Accuracy()(out5, np.asarray([0, 4])).result()[0] == 0.0
+
+
+def test_lbfgs_rosenbrock_improves():
+    m = LBFGS(learning_rate=2e-3, history_size=10)
+
+    def f(w):
+        return (1 - w[0]) ** 2 + 100 * (w[1] - w[0] ** 2) ** 2
+
+    params = {"w": jnp.asarray([-1.0, 1.0])}
+    state = m.init_state(params)
+    f0 = float(f(params["w"]))
+    for _ in range(200):
+        grads = {"w": jax.grad(f)(params["w"])}
+        params, state = m.update(grads, params, state, 2e-3)
+    assert float(f(params["w"])) < f0 * 0.5
