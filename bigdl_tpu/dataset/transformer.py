@@ -70,7 +70,8 @@ def _stack_features(values, padding: PaddingParam = None):
     out = np.full((len(values), max_len) + shapes[0][1:], pad_val,
                   dtype=values[0].dtype)
     for i, v in enumerate(values):
-        out[i, :v.shape[0]] = v
+        n = min(v.shape[0], max_len)
+        out[i, :n] = v[:n]
     return out
 
 

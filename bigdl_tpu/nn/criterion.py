@@ -354,7 +354,10 @@ class MultiLabelMarginCriterion(Criterion):
         t_safe = jnp.maximum(t, 0)
         is_target = jnp.zeros_like(output, dtype=bool)
         batch_idx = jnp.arange(output.shape[0])[:, None]
-        is_target = is_target.at[batch_idx, t_safe].set(valid)
+        # .max, not .set: padding slots all scatter to index 0 and a False
+        # write must not clobber a genuine class-0 True (duplicate-index
+        # scatter order is unspecified)
+        is_target = is_target.at[batch_idx, t_safe].max(valid)
         tgt_scores = jnp.take_along_axis(output, t_safe, axis=1)  # (b, n)
         # hinge of every non-target against every valid target
         margins = 1.0 - tgt_scores[:, :, None] + output[:, None, :]  # (b, tgt, cls)

@@ -189,8 +189,9 @@ class SpatialSubtractiveNormalization(_GaussianBlur):
         super().__init__(kernel_size, n_input_plane)
 
     def _apply(self, params, x):
-        local_mean = self.blur(x) / x.shape[-1]
-        return x - jnp.mean(local_mean, axis=-1, keepdims=True)
+        # blur() is per-channel normalized; the mean over channels completes
+        # the cross-plane local mean (sum over planes / nInputPlane)
+        return x - jnp.mean(self.blur(x), axis=-1, keepdims=True)
 
 
 class SpatialDivisiveNormalization(_GaussianBlur):
@@ -203,7 +204,7 @@ class SpatialDivisiveNormalization(_GaussianBlur):
         self.threshold, self.thresval = threshold, thresval
 
     def _apply(self, params, x):
-        local_sq = self.blur(jnp.square(x)) / x.shape[-1]
+        local_sq = self.blur(jnp.square(x))
         std = jnp.sqrt(jnp.maximum(
             jnp.mean(local_sq, axis=-1, keepdims=True), 0.0))
         std = jnp.where(std < self.threshold, self.thresval, std)

@@ -73,7 +73,7 @@ class RnnCell(Cell):
         c = get_policy().compute_dtype
         pre = (x_t.astype(c) @ params["w_ih"].astype(c)
                + h.astype(c) @ params["w_hh"].astype(c) + params["bias"])
-        h_new = self.activation(pre)
+        h_new = self.activation(pre).astype(x_t.dtype)
         return h_new, h_new
 
 

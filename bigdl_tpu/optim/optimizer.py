@@ -265,6 +265,7 @@ class Optimizer:
         oblob = file_io.load(optim_path)
         self.optim_method.load_state_dict(oblob["method"])
         self._resume_state = oblob["driver_state"]
+        self._resume_opt_state = oblob.get("opt_state")
         self._compiled = None
 
     def _optimize_impl(self) -> Module:
@@ -280,7 +281,10 @@ class Optimizer:
 
         params = jax.device_put(model.params, param_sh)
         net_state = jax.device_put(model.state, NamedSharding(mesh, P()))
-        opt_state = optim.init_state(params)
+        resume_os = getattr(self, "_resume_opt_state", None)
+        opt_state = (jax.tree.map(jnp.asarray, resume_os)
+                     if resume_os is not None else optim.init_state(params))
+        self._resume_opt_state = None
 
         # driver state (reference: optimMethod.state Table). "neval" counts
         # iterations 1-based like the reference's driver; "evalCounter" is the
@@ -339,7 +343,7 @@ class Optimizer:
                 state["neval"] = neval + 1
                 state["evalCounter"] = state.get("evalCounter", 0) + 1
                 self._maybe_validate(params, net_state, state)
-                self._maybe_checkpoint(params, net_state, state)
+                self._maybe_checkpoint(params, net_state, state, opt_state)
             if pending_loss is not None:
                 state["loss"] = float(pending_loss)
                 pending_loss = None
@@ -351,7 +355,7 @@ class Optimizer:
             state["epoch"] += 1
             state["_epoch_just_finished"] = True
             self._maybe_validate(params, net_state, state)
-            self._maybe_checkpoint(params, net_state, state)
+            self._maybe_checkpoint(params, net_state, state, opt_state)
             state["_epoch_just_finished"] = False
 
         # sync the facade with the trained values
@@ -393,15 +397,19 @@ class Optimizer:
 
     _forward_fn = None
 
-    def _maybe_checkpoint(self, params, net_state, state):
+    def _maybe_checkpoint(self, params, net_state, state, opt_state=None):
         if (self.checkpoint_trigger is None or self.checkpoint_path is None or
                 not self.checkpoint_trigger(state)):
             return
         neval = state["neval"] - 1
+        # the opt_state pytree (momentum / Adam m,v,t slots) must be persisted
+        # too — the reference serializes the whole optimMethod incl. its state
+        # Table (optim/Optimizer.scala:284-322)
         file_io.save_checkpoint(
             self.checkpoint_path, neval,
             {"params": params, "state": net_state},
             {"method": self.optim_method.state_dict(),
+             "opt_state": jax.tree.map(np.asarray, opt_state),
              "driver_state": {k: v for k, v in state.items()
                               if not k.startswith("_")}},
             overwrite=self.is_overwrite)
