@@ -1,3 +1,17 @@
 """Model zoo (reference: BigDL models/ + example/, SURVEY.md §2.11)."""
 
+from .autoencoder import Autoencoder
+from .inception import (Inception_Layer_v1, Inception_v1,
+                        Inception_v1_NoAuxClassifier)
 from .lenet import LeNet5
+from .resnet import ResNet, ShortcutType
+from .rnn import PTBModel, SimpleRNN
+from .textclassifier import TextClassifier
+from .vgg import Vgg_16, Vgg_19, VggForCifar10
+
+__all__ = [
+    "Autoencoder", "Inception_Layer_v1", "Inception_v1",
+    "Inception_v1_NoAuxClassifier", "LeNet5", "PTBModel", "ResNet",
+    "ShortcutType", "SimpleRNN", "TextClassifier", "Vgg_16", "Vgg_19",
+    "VggForCifar10",
+]

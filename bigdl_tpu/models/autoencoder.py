@@ -1,0 +1,26 @@
+"""Fully-connected autoencoder on MNIST.
+
+Reference: `models/autoencoder/Autoencoder.scala:27-37`:
+Reshape(784) -> Linear(784, classNum) -> ReLU -> Linear(classNum, 784) -> Sigmoid,
+trained with MSECriterion against the flattened input
+(`models/autoencoder/Train.scala`).
+"""
+
+from __future__ import annotations
+
+from ..nn import Linear, ReLU, Reshape, Sequential, Sigmoid
+
+__all__ = ["Autoencoder"]
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def Autoencoder(class_num: int = 32):
+    return (Sequential()
+            .add(Reshape((FEATURE_SIZE,)))
+            .add(Linear(FEATURE_SIZE, class_num))
+            .add(ReLU())
+            .add(Linear(class_num, FEATURE_SIZE))
+            .add(Sigmoid()))

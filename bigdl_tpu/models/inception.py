@@ -1,0 +1,143 @@
+"""Inception-v1 (GoogLeNet) — the reference's "big" published ImageNet workload.
+
+Reference: `models/inception/Inception_v1.scala` — `Inception_Layer_v1` (:23)
+is a 4-branch Concat (1x1 / 3x3-reduce+3x3 / 5x5-reduce+5x5 / pool-proj);
+`Inception_v1_NoAuxClassifier` (:64) is the plain tower;
+`Inception_v1` (:103) adds the two auxiliary classifier heads and
+concatenates [main | aux2 | aux1] along the class dim (the reference trains it
+against a target replicated 3x, `models/inception/Train.scala`).
+
+NHWC layout; `dimension` on Concat is the channel axis (-1).
+"""
+
+from __future__ import annotations
+
+from ..nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Reshape,
+                  Sequential, SpatialAveragePooling, SpatialConvolution,
+                  SpatialCrossMapLRN, SpatialMaxPooling, Xavier, Zeros)
+
+__all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier"]
+
+
+def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    c = SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph)
+    c.set_init_method(Xavier(), Zeros())
+    return c.set_name(name)
+
+
+def Inception_Layer_v1(input_size: int, config, name_prefix: str = ""):
+    """config = ((n1x1,), (n3x3r, n3x3), (n5x5r, n5x5), (npool,)) — the
+    reference's nested Table (Inception_v1.scala:23-61)."""
+    concat = Concat(-1)
+    concat.add(Sequential()
+               .add(_conv(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"))
+               .add(ReLU()))
+    concat.add(Sequential()
+               .add(_conv(input_size, config[1][0], 1, 1,
+                          name=name_prefix + "3x3_reduce"))
+               .add(ReLU())
+               .add(_conv(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                          name=name_prefix + "3x3"))
+               .add(ReLU()))
+    concat.add(Sequential()
+               .add(_conv(input_size, config[2][0], 1, 1,
+                          name=name_prefix + "5x5_reduce"))
+               .add(ReLU())
+               .add(_conv(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+                          name=name_prefix + "5x5"))
+               .add(ReLU()))
+    concat.add(Sequential()
+               .add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+               .add(_conv(input_size, config[3][0], 1, 1,
+                          name=name_prefix + "pool_proj"))
+               .add(ReLU()))
+    return concat.set_name(name_prefix + "output")
+
+
+def _stem():
+    return [
+        _conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2"), ReLU(),
+        SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        SpatialCrossMapLRN(5, 0.0001, 0.75),
+        _conv(64, 64, 1, 1, name="conv2/3x3_reduce"), ReLU(),
+        _conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"), ReLU(),
+        SpatialCrossMapLRN(5, 0.0001, 0.75),
+        SpatialMaxPooling(3, 3, 2, 2).ceil(),
+    ]
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000):
+    model = Sequential()
+    for m in _stem():
+        model.add(m)
+    model.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+    model.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
+    model.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+    model.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+    model.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
+    model.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+    model.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1))
+    model.add(Dropout(0.4))
+    model.add(Reshape((1024,)))
+    fc = Linear(1024, class_num).set_name("loss3/classifier")
+    fc.set_init_method(Xavier(), Zeros())
+    model.add(fc)
+    model.add(LogSoftMax())
+    return model
+
+
+def _aux_head(n_in: int, class_num: int, prefix: str):
+    return (Sequential()
+            .add(SpatialAveragePooling(5, 5, 3, 3).ceil())
+            .add(_conv(n_in, 128, 1, 1, name=prefix + "conv"))
+            .add(ReLU())
+            .add(Reshape((128 * 4 * 4,)))
+            .add(Linear(128 * 4 * 4, 1024).set_name(prefix + "fc"))
+            .add(ReLU())
+            .add(Dropout(0.7))
+            .add(Linear(1024, class_num).set_name(prefix + "classifier"))
+            .add(LogSoftMax()))
+
+
+def Inception_v1(class_num: int = 1000):
+    """Full GoogLeNet with aux heads; output is [main | aux2 | aux1]
+    concatenated along the class axis (Inception_v1.scala:169-186)."""
+    feature1 = Sequential()
+    for m in _stem():
+        feature1.add(m)
+    feature1.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+    feature1.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    feature1.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
+
+    output1 = _aux_head(512, class_num, "loss1/")
+
+    feature2 = Sequential()
+    feature2.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+    feature2.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+    feature2.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
+
+    output2 = _aux_head(528, class_num, "loss2/")
+
+    output3 = Sequential()
+    output3.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
+    output3.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    output3.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+    output3.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1))
+    output3.add(Dropout(0.4))
+    output3.add(Reshape((1024,)))
+    fc = Linear(1024, class_num).set_name("loss3/classifier")
+    fc.set_init_method(Xavier(), Zeros())
+    output3.add(fc)
+    output3.add(LogSoftMax())
+
+    split2 = Concat(-1).add(output3).add(output2)
+    main_branch = Sequential().add(feature2).add(split2)
+    split1 = Concat(-1).add(main_branch).add(output1)
+    return Sequential().add(feature1).add(split1)

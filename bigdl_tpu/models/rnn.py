@@ -1,0 +1,41 @@
+"""SimpleRNN — character language model (the reference's sequence workload).
+
+Reference: `models/rnn/SimpleRNN.scala:29-31`:
+Recurrent(RnnCell(inputSize, hiddenSize, Tanh)) + TimeDistributed(Linear).
+Input: one-hot (batch, time, vocab); output (batch, time, vocab) log-probs via
+TimeDistributedCriterion(CrossEntropy).
+
+Also provides an LSTM language model (PTB-style, the BASELINE.md slot 5
+workload) — same shape, LSTM cell + LookupTable embedding front end.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import (LSTM, Linear, LogSoftMax, LookupTable, Recurrent, RnnCell,
+                  Sequential, TimeDistributed)
+
+__all__ = ["SimpleRNN", "PTBModel"]
+
+
+def SimpleRNN(input_size: int, hidden_size: int, output_size: int):
+    return (Sequential()
+            .add(Recurrent(RnnCell(input_size, hidden_size, jnp.tanh)))
+            .add(TimeDistributed(Linear(hidden_size, output_size))))
+
+
+def PTBModel(vocab_size: int = 10000, embed_size: int = 200,
+             hidden_size: int = 200, num_layers: int = 2,
+             dropout: float = 0.0):
+    """LSTM language model: embedding -> stacked LSTM -> tied-time Linear ->
+    LogSoftMax (net-new workload; reference has only the SimpleRNN char-LM,
+    BASELINE.md tracks a "PTB-style LSTM" config)."""
+    model = Sequential().add(LookupTable(vocab_size, embed_size))
+    in_size = embed_size
+    for _ in range(num_layers):
+        model.add(Recurrent(LSTM(in_size, hidden_size, p=dropout)))
+        in_size = hidden_size
+    model.add(TimeDistributed(Linear(hidden_size, vocab_size)))
+    model.add(LogSoftMax())
+    return model

@@ -1,0 +1,64 @@
+"""Text-classification CNN (the reference's GloVe+CNN example).
+
+Reference: `example/utils/TextClassifier.scala:171-197` `buildModel`:
+three conv(5)+ReLU+maxpool(5) stages over the sequence axis, then
+Linear(128,100) -> Linear(100, classNum) -> LogSoftMax.
+
+TPU-native re-design: the reference reshapes to NCHW and uses
+SpatialConvolution with 1-wide kernels; here the sequence is handled natively
+with TemporalConvolution (a single MXU gemm over unfolded frames) and a
+sequence max-pool, keeping the exact stage structure (128 filters, kernel 5,
+pool 5/5/35).  Input: (batch, seq_len=500, embed_dim) pre-embedded GloVe
+vectors, matching the reference's pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import (Linear, LogSoftMax, Max, ReLU, Reshape, Sequential,
+                  TemporalConvolution)
+from ..nn.module import Module
+
+__all__ = ["TextClassifier", "TemporalMaxPooling"]
+
+
+class TemporalMaxPooling(Module):
+    """Max-pool over the time axis of (batch, time, feat) (Torch's
+    nn.TemporalMaxPooling; the reference reaches the same effect with
+    SpatialMaxPooling over a 1-wide spatial layout,
+    example/utils/TextClassifier.scala:180)."""
+
+    def __init__(self, k_w: int, d_w: int = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def _apply(self, params, x):
+        b, t, f = x.shape
+        n_out = (t - self.k_w) // self.d_w + 1
+        idx = (jnp.arange(n_out)[:, None] * self.d_w
+               + jnp.arange(self.k_w)[None, :])      # (n_out, k_w)
+        windows = x[:, idx, :]                        # (b, n_out, k_w, f)
+        return jnp.max(windows, axis=2)
+
+
+def TextClassifier(class_num: int, embed_dim: int = 200,
+                   seq_len: int = 500):
+    model = Sequential()
+    model.add(TemporalConvolution(embed_dim, 128, 5))
+    model.add(ReLU())
+    model.add(TemporalMaxPooling(5, 5))
+    model.add(TemporalConvolution(128, 128, 5))
+    model.add(ReLU())
+    model.add(TemporalMaxPooling(5, 5))
+    model.add(TemporalConvolution(128, 128, 5))
+    model.add(ReLU())
+    # final stage pools the whole remaining sequence (reference pools 35/35
+    # which collapses seq 35 -> 1 at seq_len=500)
+    model.add(Max(dim=1))
+    model.add(Reshape((128,)))
+    model.add(Linear(128, 100))
+    model.add(Linear(100, class_num))
+    model.add(LogSoftMax())
+    return model

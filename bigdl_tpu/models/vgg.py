@@ -1,0 +1,82 @@
+"""VGG — CIFAR-10 variant with BN+dropout and classic VGG-16/19.
+
+Reference: `models/vgg/VggForCifar10.scala:23-70` (conv-BN-ReLU stacks with
+dropout, 512-unit classifier) and `models/vgg/Vgg_16.scala` / `Vgg_19.scala`
+(plain conv-ReLU stacks, 4096-unit classifier). NHWC layout.
+"""
+
+from __future__ import annotations
+
+from ..nn import (BatchNormalization, Dropout, Linear, LogSoftMax, ReLU,
+                  Reshape, Sequential, SpatialBatchNormalization,
+                  SpatialConvolution, SpatialMaxPooling)
+
+__all__ = ["VggForCifar10", "Vgg_16", "Vgg_19"]
+
+
+def VggForCifar10(class_num: int = 10):
+    model = Sequential()
+
+    def conv_bn_relu(n_in, n_out):
+        model.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(n_out, 1e-3))
+        model.add(ReLU())
+
+    for block, drop in (((3, 64, 64), 0.3), ((64, 128, 128), 0.4),
+                        ((128, 256, 256, 256), 0.4),
+                        ((256, 512, 512, 512), 0.4),
+                        ((512, 512, 512, 512), 0.4)):
+        chans = list(block)
+        for i in range(len(chans) - 1):
+            conv_bn_relu(chans[i], chans[i + 1])
+            if i < len(chans) - 2:
+                model.add(Dropout(drop))
+        model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    model.add(Reshape((512,)))
+    model.add(Dropout(0.5))
+    model.add(Linear(512, 512))
+    model.add(BatchNormalization(512))
+    model.add(ReLU())
+    model.add(Dropout(0.5))
+    model.add(Linear(512, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def _vgg_features(cfg):
+    model = Sequential()
+    n_in = 3
+    for v in cfg:
+        if v == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(SpatialConvolution(n_in, v, 3, 3, 1, 1, 1, 1))
+            model.add(ReLU())
+            n_in = v
+    return model
+
+
+def _vgg_classifier(model, class_num):
+    model.add(Reshape((512 * 7 * 7,)))
+    model.add(Linear(512 * 7 * 7, 4096))
+    model.add(ReLU())
+    model.add(Dropout(0.5))
+    model.add(Linear(4096, 4096))
+    model.add(ReLU())
+    model.add(Dropout(0.5))
+    model.add(Linear(4096, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    return _vgg_classifier(_vgg_features(cfg), class_num)
+
+
+def Vgg_19(class_num: int = 1000):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+           512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+    return _vgg_classifier(_vgg_features(cfg), class_num)

@@ -1,0 +1,97 @@
+"""Model-zoo tests (reference workload surface, SURVEY.md §2.11)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import models as M
+from bigdl_tpu import nn
+
+
+def _forward(model, shape, training=False):
+    model.build(jax.random.key(0))
+    x = jnp.ones(shape, jnp.float32)
+    out, _ = model.apply(model.params, model.state, x, training=training,
+                         rng=jax.random.key(1))
+    return out
+
+
+@pytest.mark.parametrize("depth", [20, 32])
+def test_resnet_cifar_shape(depth):
+    out = _forward(M.ResNet(depth, 10, "cifar10"), (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_imagenet_bottleneck_shape():
+    out = _forward(M.ResNet(50, 17, "imagenet"), (1, 224, 224, 3))
+    assert out.shape == (1, 17)
+
+
+def test_resnet_shortcut_type_a_pads_channels():
+    # type A shortcut (CIFAR default) must double channels with zeros, not conv
+    model = M.ResNet(20, 10, "cifar10", shortcut_type=M.ShortcutType.A)
+    out = _forward(model, (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+
+
+def test_inception_v1_shapes():
+    out = _forward(M.Inception_v1_NoAuxClassifier(11), (1, 224, 224, 3))
+    assert out.shape == (1, 11)
+    # log-softmax head: rows are log-probabilities
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
+
+
+def test_inception_v1_aux_concat():
+    # full v1 concatenates [main | aux2 | aux1] along classes (3x classNum)
+    out = _forward(M.Inception_v1(7), (1, 224, 224, 3))
+    assert out.shape == (1, 21)
+
+
+def test_vgg_cifar_shape():
+    out = _forward(M.VggForCifar10(10), (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+
+
+def test_autoencoder_roundtrip_shape():
+    out = _forward(M.Autoencoder(32), (2, 28, 28, 1))
+    assert out.shape == (2, 784)
+    # Sigmoid head keeps output in (0, 1)
+    assert float(out.min()) > 0.0 and float(out.max()) < 1.0
+
+
+def test_simplernn_shape():
+    out = _forward(M.SimpleRNN(40, 64, 40), (2, 25, 40))
+    assert out.shape == (2, 25, 40)
+
+
+def test_textclassifier_shape():
+    out = _forward(M.TextClassifier(20, embed_dim=50, seq_len=500),
+                   (2, 500, 50))
+    assert out.shape == (2, 20)
+
+
+def test_ptb_lstm_shape():
+    model = M.PTBModel(500, 32, 32, num_layers=2)
+    model.build(jax.random.key(0))
+    x = jnp.ones((2, 35), jnp.int32)
+    out, _ = model.apply(model.params, model.state, x, training=False, rng=None)
+    assert out.shape == (2, 35, 500)
+
+
+def test_resnet_trains_one_step():
+    """Gradients flow through the residual graph (ConcatTable/CAddTable)."""
+    model = M.ResNet(20, 10, "cifar10").build(jax.random.key(0))
+    crit = nn.CrossEntropyCriterion()
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 32, 3), jnp.float32)
+    y = jnp.asarray([0, 1, 2, 3], jnp.int32)
+
+    def loss_fn(p):
+        out, ns = model.apply(p, model.state, x, training=True,
+                              rng=jax.random.key(1))
+        return crit.loss(out, y)
+
+    loss, grads = jax.value_and_grad(loss_fn)(model.params)
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+    assert gnorm > 0.0
