@@ -49,3 +49,4 @@ from .criterion import (
     MultiMarginCriterion, ParallelCriterion, SmoothL1Criterion,
     SmoothL1CriterionWithWeights, SoftMarginCriterion, SoftmaxWithCriterion,
     TimeDistributedCriterion)
+from .attention import MultiHeadAttention

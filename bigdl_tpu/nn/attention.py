@@ -1,0 +1,81 @@
+"""Attention layers (net-new vs the 2017 reference; required for the rebuild's
+long-context capability, SURVEY.md §5.7/§7).
+
+MultiHeadAttention: fused qkv projection -> flash attention (Pallas kernel on
+TPU, ops/attention.py) -> output projection.  With `seq_parallel=True` the
+attention core runs as a ring over the mesh 'seq' axis (parallel/ring_attention)
+so sequences sharded across devices never gather.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common import get_policy
+from .initialization import compute_fans, default_weight_init
+from .module import Module
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(Module):
+    """Self-attention over [B, T, E] inputs."""
+
+    def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
+                 seq_parallel: bool = False, seq_axis: str = "seq",
+                 with_bias: bool = True):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.seq_parallel = seq_parallel
+        self.seq_axis = seq_axis
+        self.with_bias = with_bias
+
+    def _init(self, rng):
+        ks = jax.random.split(rng, 4)
+        e = self.embed_dim
+        winit = self.weight_initializer or default_weight_init
+        dt = get_policy().param_dtype
+
+        def w(k, shape):
+            fi, fo = compute_fans(shape)
+            return winit(k, shape, fi, fo, dt)
+
+        p = {"wq": w(ks[0], (e, e)), "wk": w(ks[1], (e, e)),
+             "wv": w(ks[2], (e, e)), "wo": w(ks[3], (e, e))}
+        if self.with_bias:
+            z = jnp.zeros((e,), dt)
+            p.update({"bq": z, "bk": z, "bv": z, "bo": z})
+        return p
+
+    def _proj(self, params, x, name):
+        c = get_policy().compute_dtype
+        y = jax.lax.dot_general(
+            x.astype(c), params["w" + name].astype(c),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(c)
+        if self.with_bias:
+            y = y + params["b" + name].astype(c)
+        return y
+
+    def _apply(self, params, x):
+        B, T, E = x.shape
+        H, D = self.num_heads, self.head_dim
+        split = lambda y: y.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        q, k, v = (split(self._proj(params, x, n)) for n in "qkv")
+        if self.seq_parallel:
+            from ..parallel.ring_attention import ring_attention
+            o = ring_attention(q, k, v, seq_axis=self.seq_axis,
+                               causal=self.causal)
+        else:
+            from ..ops.attention import flash_attention
+            o = flash_attention(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+        return self._proj(params, o, "o")

@@ -1,0 +1,10 @@
+"""bigdl_tpu.ops — TPU kernels (Pallas) with portable jnp fallbacks.
+
+This package plays the role of the reference's native math layer (BigDL-core
+MKL JNI wrapper, SURVEY.md §2.1): the hot ops that deserve hand scheduling.
+Everything else lowers through XLA from plain jnp code.
+"""
+
+from .attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

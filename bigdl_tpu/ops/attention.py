@@ -1,0 +1,182 @@
+"""Flash attention: a Pallas TPU kernel with a portable jnp fallback.
+
+The reference (2017 BigDL) predates attention; this op underpins the net-new
+long-context capabilities required of the rebuild (SURVEY.md §7 item 7 — SP /
+ring attention) and the MultiHeadAttention layer.  Design follows the standard
+online-softmax blockwise scheme: for each query block, stream key/value blocks
+through VMEM, keeping running (max, sum, accumulator) statistics so the full
+[Tq, Tk] score matrix never materializes in HBM.
+
+On TPU the kernel tiles onto the MXU with (block_q x d) @ (d x block_k)
+matmuls in f32 accumulation; on CPU (tests / virtual meshes) we use the exact
+jnp reference instead — same math, XLA-fused.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "mha_reference"]
+
+_NEG_INF = float("-inf")
+
+
+def mha_reference(q, k, v, *, causal: bool = False,
+                  sm_scale: Optional[float] = None,
+                  q_offset: int = 0, k_offset: int = 0):
+    """Exact attention in plain jnp. q,k,v: [B, H, T, D].
+
+    q_offset / k_offset give the global sequence positions of q[..,0,:] and
+    k[..,0,:] — used by ring attention where each device holds a rotating
+    key/value block.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST) * sm_scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[2])[:, None]
+        kj = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(kj > qi, _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with every position masked produce NaN from softmax(-inf row);
+    # zero them (they are meaningless and must not poison gradients)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int,
+                  kv_len: int):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)          # query-block index
+    j = pl.program_id(2)          # key-block index (innermost grid dim)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: key block strictly past the query block contributes nothing
+    run = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST) * sm_scale   # [bq, bk]
+        kj = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(kj > qi, _NEG_INF, s)
+        if kv_len % block_k:          # mask keys in the padded tail block
+            s = jnp.where(kj >= kv_len, _NEG_INF, s)
+
+        m_prev = m_scr[:]                            # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # exp(-inf - -inf) would be NaN; fully-masked blocks give m_new=-inf
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - m_new))
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows -> 0
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
+                  block_q: int, block_k: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+
+    # pad sequence lengths up to block multiples; padded keys are masked
+    # inside the kernel, padded query rows are sliced off the output
+    pq = (-Tq) % block_q
+    pk = (-Tk) % block_k
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    Tqp, Tkp = Tq + pq, Tk + pk
+
+    qr = q.reshape(B * H, Tqp, D)
+    kr = k.reshape(B * H, Tkp, D)
+    vr = v.reshape(B * H, Tkp, D)
+
+    grid = (B * H, Tqp // block_q, Tkp // block_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tqp, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tqp, D)[:, :, :Tq, :]
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Blockwise (flash) attention.  q,k,v: [B, H, T, D] -> [B, H, Tq, D].
+
+    use_pallas: None = auto (Pallas on TPU, jnp reference elsewhere).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if not use_pallas:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)

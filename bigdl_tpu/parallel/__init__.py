@@ -1,8 +1,18 @@
 """bigdl_tpu.parallel — sharding strategies over the device mesh.
 
 The reference's only strategy is sync data-parallel SGD over the Spark block
-manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7).
+manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7):
+- sharding: DataParallel / ShardedDataParallel (ZeRO) / TensorParallel specs
+- ring_attention: sequence/context parallelism (shard_map + ppermute ring)
+- ulysses_attention: all-to-all sequence parallelism
+- pipeline: GPipe-style microbatched stage parallelism
 """
 
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
                        TensorParallel)
+from .ring_attention import ring_attention, ulysses_attention
+from .pipeline import pipeline_apply, stack_stage_params
+
+__all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
+           "TensorParallel", "ring_attention", "ulysses_attention",
+           "pipeline_apply", "stack_stage_params"]

@@ -1,0 +1,129 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the 'pipe' axis.
+
+Net-new capability vs the reference (SURVEY.md §2.5: BigDL has no PP).
+TPU-native design: the model is a stack of N *structurally identical* stages
+(the standard SPMD-pipeline restriction — e.g. N transformer blocks, or N
+copies of any repeated block).  Stage parameters are stacked along a leading
+axis sharded over the mesh 'pipe' axis, so each device owns one stage.  One
+`shard_map`-wrapped function runs the classic GPipe schedule: M microbatches
+flow through N stages in M+N-1 ticks, activations hop stage-to-stage with
+`jax.lax.ppermute` over ICI.
+
+Because the whole schedule is pure jax (scan + ppermute), `jax.grad`
+differentiates straight through it — the backward pass is automatically the
+reverse pipeline (ppermute transposes to the reverse ring), with no manual
+1F1B bookkeeping.  Rematerialization: pass remat=True to checkpoint each
+stage application, trading FLOPs for activation memory (HBM).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage param pytrees (identical structure) along a new leading
+    stage axis — the axis that shards over 'pipe'."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def _pipe_local(stage_params, x, *, stage_fn, axis_name: str,
+                num_microbatches: int, remat: bool, vary_axes=()):
+    """Inside shard_map.  stage_params: this stage's params (leading stage axis
+    of size 1).  x: full local batch [B, ...] (replicated or data-sharded).
+    """
+    n = jax.lax.psum(1, axis_name)
+    stage_id = jax.lax.axis_index(axis_name)
+    my_params = jax.tree.map(lambda p: p[0], stage_params)
+
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(stage_fn)
+
+    m = num_microbatches
+    B = x.shape[0]
+    assert B % m == 0, f"batch {B} must divide into {m} microbatches"
+    micro = x.reshape(m, B // m, *x.shape[1:])
+    ticks = m + n - 1
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    from .ring_attention import _pvary
+    axes = (axis_name,) + tuple(a for a in vary_axes if a != axis_name)
+    state0 = _pvary(jnp.zeros_like(micro[0]), axes)
+    out_buf0 = _pvary(jnp.zeros_like(micro), axes)
+    micro = _pvary(micro, axes)
+
+    def tick(carry, t):
+        state, out_buf = carry
+        # stage 0 ingests microbatch t (while t < m); other stages use the
+        # activation that arrived from the left neighbor
+        feed = micro[jnp.minimum(t, m - 1)]
+        inp = jnp.where(stage_id == 0, feed, state)
+        y = fn(my_params, inp)
+        # last stage emits microbatch t-(n-1) at tick t
+        emit_idx = t - (n - 1)
+        valid = emit_idx >= 0
+        out_buf = jax.lax.cond(
+            valid,
+            lambda b: b.at[jnp.maximum(emit_idx, 0)].set(y),
+            lambda b: b,
+            out_buf)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, out_buf), None
+
+    (state, out_buf), _ = jax.lax.scan(
+        tick, (state0, out_buf0), jnp.arange(ticks))
+    # out_buf is only meaningful on the last stage; broadcast it ring-wise so
+    # every stage returns the same tensor (out_specs replicate over 'pipe')
+    out = _bcast_from(out_buf, axis_name, n - 1)
+    return out.reshape(B, *out.shape[2:])
+
+
+def _bcast_from(x, axis_name, src):
+    """Replicate the value held by `src` to every device on the axis."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
+                   mesh: Mesh, pipe_axis: str = "pipe",
+                   num_microbatches: int = 4,
+                   batch_axis: Optional[str] = "data",
+                   remat: bool = False):
+    """Run x through N pipelined stages.
+
+    stage_fn(params_one_stage, microbatch) -> microbatch_out (same shape).
+    stacked_params: pytree with leading stage axis == mesh.shape[pipe_axis]
+      (see stack_stage_params).
+    x: [B, ...]; num_microbatches must divide B.
+    """
+    n = mesh.shape[pipe_axis]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n:
+        raise ValueError(f"stacked_params leading axis {lead} != |{pipe_axis}|={n}")
+    batch = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
+        else None
+    pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    xspec = P(batch)
+    fn = shard_map(
+        partial(_pipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
+                num_microbatches=num_microbatches, remat=remat,
+                vary_axes=(batch,) if batch else ()),
+        mesh=mesh,
+        in_specs=(pspec, xspec),
+        out_specs=xspec)
+    return fn(stacked_params, x)

@@ -1,0 +1,240 @@
+"""Sequence parallelism (ring/Ulysses attention), pipeline parallelism, and
+the flash-attention op — on the 8-virtual-CPU-device mesh (conftest.py),
+mirroring the reference's simulate-a-cluster-in-one-process test strategy
+(DistriOptimizerSpec.scala:33-41)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from bigdl_tpu.ops.attention import flash_attention, mha_reference
+from bigdl_tpu.parallel import (ring_attention, ulysses_attention,
+                                pipeline_apply, stack_stage_params)
+
+
+def _qkv(B=2, H=4, T=32, D=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), jnp.float32) for k in ks)
+
+
+class TestFlashAttention:
+    def test_matches_reference_noncausal(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, use_pallas=True, interpret=True,
+                              block_q=16, block_k=16)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_matches_reference_causal(self):
+        q, k, v = _qkv(seed=1)
+        out = flash_attention(q, k, v, causal=True, use_pallas=True,
+                              interpret=True, block_q=16, block_k=16)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_fallback_path(self):
+        q, k, v = _qkv(seed=2)
+        out = flash_attention(q, k, v)  # auto: jnp path on CPU
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        n = 8
+        mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+        q, k, v = _qkv(B=2, H=2, T=4 * n, D=8, seed=3)
+        out = ring_attention(q, k, v, mesh=mesh, causal=causal,
+                             batch_axis=None)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_2d_mesh_data_and_seq(self):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "seq"))
+        q, k, v = _qkv(B=4, H=2, T=16, D=8, seed=4)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grad_flows(self):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        q, k, v = _qkv(B=1, H=2, T=8, D=8, seed=5)
+
+        def loss(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=True,
+                                          batch_axis=None) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+
+class TestUlyssesAttention:
+    def test_matches_full_attention(self):
+        n = 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+        q, k, v = _qkv(B=2, H=4, T=4 * n, D=8, seed=6)
+        out = ulysses_attention(q, k, v, mesh=mesh, causal=True,
+                                batch_axis=None)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_rejects_indivisible_heads(self):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+        q, k, v = _qkv(B=1, H=4, T=16, D=8)
+        with pytest.raises(ValueError):
+            ulysses_attention(q, k, v, mesh=mesh)
+
+
+class TestPipeline:
+    def _stages(self, n, F=16, seed=7):
+        keys = jax.random.split(jax.random.key(seed), n)
+        return [{"w": jax.random.normal(k, (F, F)) * 0.1,
+                 "b": jnp.zeros((F,))} for k in keys]
+
+    @staticmethod
+    def _stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def test_forward_matches_sequential(self):
+        n = 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("pipe",))
+        stages = self._stages(n)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.key(8), (8, 16))
+        y = pipeline_apply(self._stage_fn, stacked, x, mesh=mesh,
+                           num_microbatches=4, batch_axis=None)
+        y_ref = x
+        for p in stages:
+            y_ref = self._stage_fn(p, y_ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_grad_matches_sequential(self):
+        n = 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("pipe",))
+        stages = self._stages(n, seed=9)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.key(10), (8, 16))
+
+        def loss(sp):
+            y = pipeline_apply(self._stage_fn, sp, x, mesh=mesh,
+                               num_microbatches=4, batch_axis=None)
+            return jnp.mean(y ** 2)
+
+        def loss_ref(stages_list):
+            y = x
+            for p in stages_list:
+                y = self._stage_fn(p, y)
+            return jnp.mean(y ** 2)
+
+        g = jax.jit(jax.grad(loss))(stacked)
+        g_ref = jax.grad(loss_ref)(stages)
+        g_ref_stacked = stack_stage_params(g_ref)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+            g, g_ref_stacked)
+
+    def test_remat_same_result(self):
+        n = 2
+        mesh = Mesh(np.array(jax.devices()[:n]), ("pipe",))
+        stages = self._stages(n, seed=11)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.key(12), (4, 16))
+        y1 = pipeline_apply(self._stage_fn, stacked, x, mesh=mesh,
+                            num_microbatches=2, batch_axis=None, remat=True)
+        y2 = pipeline_apply(self._stage_fn, stacked, x, mesh=mesh,
+                            num_microbatches=2, batch_axis=None, remat=False)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+    def test_data_parallel_times_pipeline(self):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "pipe"))
+        stages = self._stages(4, seed=13)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.key(14), (8, 16))
+        y = pipeline_apply(self._stage_fn, stacked, x, mesh=mesh,
+                           num_microbatches=2)
+        y_ref = x
+        for p in stages:
+            y_ref = self._stage_fn(p, y_ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestDryrunExtras:
+    def test_run(self):
+        from bigdl_tpu.parallel import dryrun_extras
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        dryrun_extras.run(mesh)
+
+
+class TestMultiHeadAttention:
+    def test_forward_shapes_and_seq_parallel_parity(self):
+        from bigdl_tpu.nn import MultiHeadAttention
+        from bigdl_tpu.utils.engine import Engine
+        x = jax.random.normal(jax.random.key(20), (2, 16, 32))
+        mha = MultiHeadAttention(32, 4, causal=True).build(jax.random.key(21))
+        y, _ = mha.apply(mha.params, mha.state, x)
+        assert y.shape == (2, 16, 32)
+
+        Engine.init(mesh_shape={"seq": 4}, devices=jax.devices()[:4])
+        sp = MultiHeadAttention(32, 4, causal=True, seq_parallel=True)
+        sp.params, sp.state = mha.params, mha.state
+        with Engine.mesh():
+            y2, _ = sp.apply(sp.params, sp.state, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestFlashAttentionPadding:
+    def test_non_divisible_lengths(self):
+        # T=40 with block 16 exercises the pad+mask path
+        q, k, v = _qkv(B=2, H=2, T=40, D=16, seed=30)
+        for causal in (False, True):
+            out = flash_attention(q, k, v, causal=causal, use_pallas=True,
+                                  interpret=True, block_q=16, block_k=16)
+            ref = mha_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_cross_attention_lengths(self):
+        ks = jax.random.split(jax.random.key(31), 3)
+        q = jax.random.normal(ks[0], (1, 2, 24, 8), jnp.float32)
+        k = jax.random.normal(ks[1], (1, 2, 40, 8), jnp.float32)
+        v = jax.random.normal(ks[2], (1, 2, 40, 8), jnp.float32)
+        out = flash_attention(q, k, v, use_pallas=True, interpret=True,
+                              block_q=16, block_k=16)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestRingChunkedInner:
+    def test_ring_with_chunked_inner(self, monkeypatch):
+        # force tiny chunks so the scan path in _block_attn is exercised
+        import importlib
+        ra = importlib.import_module("bigdl_tpu.parallel.ring_attention")
+        monkeypatch.setattr(ra, "_CHUNK", 4)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        q, k, v = _qkv(B=1, H=2, T=32, D=8, seed=32)
+        out = ra.ring_attention(q, k, v, mesh=mesh, causal=True,
+                                batch_axis=None)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
