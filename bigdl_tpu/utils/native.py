@@ -2,9 +2,10 @@
 
 Reference: BigDL's native layer is the BigDL-core JNI wrapper shipping
 `libjmkl.so` inside per-OS jars, loaded lazily on first use
-(tensor/Tensor.scala:688 comment; MKL.isMKLLoaded).  Here the math lives in
-XLA; the native library instead accelerates the host-side runtime: CRC32C
-(hardware SSE4.2 when available), record-file IO, and the prefetch pipeline.
+(tensor/Tensor.scala:688 comment; MKL.isMKLLoaded, MKL.setNumThreads).  Here
+the device math lives in XLA; the native library instead accelerates the
+host-side runtime: CRC32C (hardware SSE4.2 when available), BDRecord file IO,
+bf16 wire conversion, and batch-assembly kernels.
 
 Pure-Python fallbacks exist for every entry point — the framework works
 without the compiled library, just slower on the host paths.
@@ -14,37 +15,198 @@ from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
+from typing import Optional
 
-__all__ = ["lib", "crc32c", "is_native_loaded"]
+import numpy as np
 
-_here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+__all__ = ["lib", "crc32c", "is_native_loaded", "build", "set_num_threads",
+           "get_num_threads", "f32_to_bf16", "bf16_to_f32",
+           "NativeRecordWriter", "NativeRecordReader"]
+
+_pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_csrc_dir = os.path.join(os.path.dirname(_pkg_dir), "csrc")
 _candidates = [
-    os.path.join(_here, "lib", "libbigdl_tpu_native.so"),
-    os.path.join(os.path.dirname(_here), "csrc", "build",
-                 "libbigdl_tpu_native.so"),
+    os.path.join(_pkg_dir, "lib", "libbigdl_tpu_native.so"),
+    os.path.join(_csrc_dir, "build", "libbigdl_tpu_native.so"),
 ]
 
-lib = None
-for _p in _candidates:
-    if os.path.exists(_p):
-        try:
-            lib = ctypes.CDLL(_p)
-            break
-        except OSError:
-            lib = None
-
+lib: Optional[ctypes.CDLL] = None
 crc32c = None
-if lib is not None:
-    try:
-        lib.bigdl_crc32c.restype = ctypes.c_uint32
-        lib.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
 
-        def crc32c(data: bytes) -> int:  # noqa: F811
-            return lib.bigdl_crc32c(data, len(data))
-    except AttributeError:
-        crc32c = None
+
+def _bind(cdll: ctypes.CDLL) -> None:
+    global crc32c
+    cdll.bigdl_crc32c.restype = ctypes.c_uint32
+    cdll.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.bigdl_masked_crc32c.restype = ctypes.c_uint32
+    cdll.bigdl_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.bigdl_record_writer_open.restype = ctypes.c_void_p
+    cdll.bigdl_record_writer_open.argtypes = [ctypes.c_char_p]
+    cdll.bigdl_record_writer_write.restype = ctypes.c_int
+    cdll.bigdl_record_writer_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    cdll.bigdl_record_writer_close.restype = ctypes.c_int
+    cdll.bigdl_record_writer_close.argtypes = [ctypes.c_void_p]
+    cdll.bigdl_record_reader_open.restype = ctypes.c_void_p
+    cdll.bigdl_record_reader_open.argtypes = [ctypes.c_char_p]
+    cdll.bigdl_record_reader_next.restype = ctypes.c_int64
+    cdll.bigdl_record_reader_next.argtypes = [ctypes.c_void_p]
+    cdll.bigdl_record_reader_data.restype = ctypes.c_void_p
+    cdll.bigdl_record_reader_data.argtypes = [ctypes.c_void_p]
+    cdll.bigdl_record_reader_close.restype = None
+    cdll.bigdl_record_reader_close.argtypes = [ctypes.c_void_p]
+    cdll.bigdl_set_num_threads.restype = None
+    cdll.bigdl_set_num_threads.argtypes = [ctypes.c_int]
+    cdll.bigdl_get_num_threads.restype = ctypes.c_int
+    cdll.bigdl_f32_to_bf16.restype = None
+    cdll.bigdl_f32_to_bf16.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    cdll.bigdl_bf16_to_f32.restype = None
+    cdll.bigdl_bf16_to_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+
+    def crc32c(data: bytes) -> int:  # noqa: F811
+        return cdll.bigdl_crc32c(data, len(data))
+
+
+def _try_load() -> None:
+    global lib
+    for _p in _candidates:
+        if os.path.exists(_p):
+            try:
+                cdll = ctypes.CDLL(_p)
+                _bind(cdll)
+                lib = cdll
+                return
+            except (OSError, AttributeError):
+                lib = None
+
+
+_try_load()
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile csrc/ with make and load the result.  Returns True if the
+    native library is loaded afterwards (reference analog: BigDL-core's
+    Maven native build producing libjmkl.so)."""
+    if lib is not None:
+        return True
+    if not os.path.isdir(_csrc_dir):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _csrc_dir, "-j"],
+            check=True,
+            stdout=subprocess.DEVNULL if quiet else None,
+            stderr=subprocess.DEVNULL if quiet else None)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    _try_load()
+    return lib is not None
 
 
 def is_native_loaded() -> bool:
     """(reference: MKL.isMKLLoaded)."""
     return lib is not None
+
+
+def set_num_threads(n: int) -> None:
+    """(reference: MKL.setNumThreads via Engine/ThreadPool.setMKLThread)."""
+    if lib is not None:
+        lib.bigdl_set_num_threads(n)
+
+
+def get_num_threads() -> int:
+    """(reference: MKL.getNumThreads)."""
+    return lib.bigdl_get_num_threads() if lib is not None else 1
+
+
+def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
+    """Round-to-nearest-even float32 -> bf16 (as uint16 payload).  Host-side
+    wire/checkpoint compression (reference: FP16CompressedTensor truncation,
+    parameters/FP16CompressedTensor.scala:271-279 — truncate-only; we round
+    like the TPU hardware does)."""
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    out = np.empty(arr.shape, dtype=np.uint16)
+    if lib is not None and arr.size:
+        lib.bigdl_f32_to_bf16(arr.ctypes.data, out.ctypes.data, arr.size)
+        return out
+    bits = arr.view(np.uint32)
+    lsb = (bits >> 16) & 1
+    rounded = ((bits + 0x7FFF + lsb) >> 16).astype(np.uint16)
+    is_nan = (bits & 0x7FFFFFFF) > 0x7F800000  # quiet NaNs, keep sign
+    out[...] = np.where(is_nan, ((bits >> 16) | 0x0040).astype(np.uint16),
+                        rounded)
+    return out
+
+
+def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
+    arr = np.ascontiguousarray(arr, dtype=np.uint16)
+    out = np.empty(arr.shape, dtype=np.float32)
+    if lib is not None and arr.size:
+        lib.bigdl_bf16_to_f32(arr.ctypes.data, out.ctypes.data, arr.size)
+        return out
+    out.view(np.uint32)[...] = arr.astype(np.uint32) << 16
+    return out
+
+
+class NativeRecordWriter:
+    """Streaming BDRecord writer over the native handle."""
+
+    def __init__(self, path: str):
+        if lib is None:
+            raise RuntimeError("native library not loaded")
+        self._h = lib.bigdl_record_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r} for writing")
+
+    def write(self, payload: bytes) -> None:
+        if lib.bigdl_record_writer_write(self._h, payload, len(payload)) != 0:
+            raise IOError("record write failed")
+
+    def close(self) -> None:
+        if self._h:
+            rc = lib.bigdl_record_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError("record writer close failed (flush error)")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeRecordReader:
+    """Streaming BDRecord reader; iterate to get payload bytes."""
+
+    def __init__(self, path: str):
+        if lib is None:
+            raise RuntimeError("native library not loaded")
+        self._h = lib.bigdl_record_reader_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r}")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        n = lib.bigdl_record_reader_next(self._h)
+        if n == -1:
+            raise StopIteration
+        if n < 0:
+            raise IOError("corrupt record (crc mismatch)")
+        return ctypes.string_at(lib.bigdl_record_reader_data(self._h), n)
+
+    def close(self) -> None:
+        if self._h:
+            lib.bigdl_record_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

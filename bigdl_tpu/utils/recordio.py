@@ -89,19 +89,68 @@ def read_record_bytes(f) -> bytes:
     return payload
 
 
+class _PyRecordWriter:
+    """Same write/close interface as native.NativeRecordWriter."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes) -> None:
+        write_record_bytes(self._f, payload)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _PyRecordReader:
+    """Same iterator interface as native.NativeRecordReader."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        try:
+            return read_record_bytes(self._f)
+        except EOFError:
+            raise StopIteration
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def write_records(path: str, records: Iterable[Any],
                   shards: int = 1) -> List[str]:
     """Write records round-robin over `shards` files: path-00000-of-00008 style
-    (the sharded layout Spark partitions played in the reference)."""
+    (the sharded layout Spark partitions played in the reference).  Uses the
+    native C++ writer (csrc/recordio.cc) when built."""
+    from . import native
+
     if shards == 1:
         paths = [path]
     else:
         paths = [f"{path}-{i:05d}-of-{shards:05d}" for i in range(shards)]
-    files = [open(p + ".tmp", "wb") for p in paths]
+    if native.is_native_loaded():
+        files = [native.NativeRecordWriter(p + ".tmp") for p in paths]
+    else:
+        files = [_PyRecordWriter(p + ".tmp") for p in paths]
     try:
         for i, rec in enumerate(records):
-            write_record_bytes(files[i % shards],
-                               pickle.dumps(rec, pickle.HIGHEST_PROTOCOL))
+            files[i % shards].write(pickle.dumps(rec, pickle.HIGHEST_PROTOCOL))
     finally:
         for fh in files:
             fh.close()
@@ -111,16 +160,18 @@ def write_records(path: str, records: Iterable[Any],
 
 
 def read_records(path: str) -> Iterator[Any]:
-    """Read one shard file, a glob pattern, or a `base` written with shards>1."""
+    """Read one shard file, a glob pattern, or a `base` written with shards>1.
+    Uses the native C++ reader (csrc/recordio.cc) when built."""
+    from . import native
+
     paths = sorted(glob.glob(path)) or sorted(glob.glob(path + "-*-of-*"))
     if not paths and os.path.exists(path):
         paths = [path]
     if not paths:
         raise FileNotFoundError(path)
+    opener = (native.NativeRecordReader if native.is_native_loaded()
+              else _PyRecordReader)
     for p in paths:
-        with open(p, "rb") as f:
-            while True:
-                try:
-                    yield pickle.loads(read_record_bytes(f))
-                except EOFError:
-                    break
+        with opener(p) as reader:
+            for payload in reader:
+                yield pickle.loads(payload)

@@ -1,0 +1,96 @@
+#include "crc32c.h"
+
+#include <cstring>
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <nmmintrin.h>
+#define BIGDL_HAVE_SSE42_INTRIN 1
+#endif
+
+namespace bigdl {
+namespace {
+
+// Sliced-by-8 software CRC32C. Tables generated at first use.
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    const uint32_t poly = 0x82f63b78u;
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xff];
+  }
+};
+
+const Tables& tables() {
+  static Tables tb;
+  return tb;
+}
+
+uint32_t Crc32cSoftware(const void* data, size_t len) {
+  const Tables& tb = tables();
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = 0xffffffffu;
+  while (len >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    word ^= crc;
+    crc = tb.t[7][word & 0xff] ^ tb.t[6][(word >> 8) & 0xff] ^
+          tb.t[5][(word >> 16) & 0xff] ^ tb.t[4][(word >> 24) & 0xff] ^
+          tb.t[3][(word >> 32) & 0xff] ^ tb.t[2][(word >> 40) & 0xff] ^
+          tb.t[1][(word >> 48) & 0xff] ^ tb.t[0][word >> 56];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = (crc >> 8) ^ tb.t[0][(crc ^ *p++) & 0xff];
+  return crc ^ 0xffffffffu;
+}
+
+#ifdef BIGDL_HAVE_SSE42_INTRIN
+__attribute__((target("sse4.2"))) uint32_t Crc32cHardware(const void* data,
+                                                          size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint64_t crc = 0xffffffffu;
+  while (len >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    crc = _mm_crc32_u64(crc, word);
+    p += 8;
+    len -= 8;
+  }
+  uint32_t crc32 = static_cast<uint32_t>(crc);
+  while (len--) crc32 = _mm_crc32_u8(crc32, *p++);
+  return crc32 ^ 0xffffffffu;
+}
+
+bool HaveSse42() { return __builtin_cpu_supports("sse4.2"); }
+#endif
+
+}  // namespace
+
+uint32_t Crc32c(const void* data, size_t len) {
+#ifdef BIGDL_HAVE_SSE42_INTRIN
+  static const bool hw = HaveSse42();
+  if (hw) return Crc32cHardware(data, len);
+#endif
+  return Crc32cSoftware(data, len);
+}
+
+}  // namespace bigdl
+
+extern "C" {
+
+uint32_t bigdl_crc32c(const char* data, size_t len) {
+  return bigdl::Crc32c(data, len);
+}
+
+uint32_t bigdl_masked_crc32c(const char* data, size_t len) {
+  return bigdl::MaskedCrc32c(data, len);
+}
+
+}  // extern "C"

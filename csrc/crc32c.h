@@ -1,0 +1,24 @@
+// CRC32C (Castagnoli) — the checksum framing BigDL vendored as
+// netty/Crc32c.java for TensorBoard record files (reference:
+// visualization/tensorboard/RecordWriter.scala:44-57).  Here it also frames
+// the BDRecord data files (bigdl_tpu/utils/recordio.py).
+#ifndef BIGDL_TPU_CRC32C_H_
+#define BIGDL_TPU_CRC32C_H_
+
+#include <cstddef>
+#include <cstdint>
+
+namespace bigdl {
+
+// One-shot CRC32C of `len` bytes. Uses SSE4.2 when the CPU supports it.
+uint32_t Crc32c(const void* data, size_t len);
+
+// TFRecord-style masked CRC.
+inline uint32_t MaskedCrc32c(const void* data, size_t len) {
+  uint32_t crc = Crc32c(data, len);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+}  // namespace bigdl
+
+#endif  // BIGDL_TPU_CRC32C_H_
