@@ -84,7 +84,12 @@ class BCECriterion(Criterion):
 class ClassNLLCriterion(Criterion):
     """Negative log-likelihood over log-probabilities (nn/ClassNLLCriterion.scala).
     Expects LogSoftMax output (batch, classes) and integer labels (batch,).
-    Optional per-class `weights`; mean is weight-normalized like the reference."""
+    Optional per-class `weights`; mean is weight-normalized like the reference.
+
+    Labels are 0-based by default (idiomatic JAX); pass ``one_based=True`` for
+    BigDL/Torch-style 1-based labels.  An out-of-range label yields NaN loss
+    (JAX gathers fill out-of-bounds with NaN) — the reference instead threw
+    `curTarget >= 1 && curTarget <= nClasses`; watch the logged loss."""
 
     def __init__(self, weights=None, size_average: bool = True,
                  one_based: bool = False):

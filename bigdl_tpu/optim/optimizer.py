@@ -340,6 +340,26 @@ class Optimizer:
                         self.train_summary.add_scalar("LearningRate", lr, neval)
                         self.train_summary.add_scalar(
                             "Throughput", n / max(dt, 1e-9), neval)
+                # per-parameter histograms when a "Parameters" trigger is set
+                # (reference: DistriOptimizer.saveSummary :426-456 — off by
+                # default because it pulls every weight to host)
+                if self.train_summary is not None:
+                    ptrig = getattr(self.train_summary,
+                                    "get_summary_trigger", lambda _n: None)(
+                                        "Parameters")
+                    if ptrig is not None and ptrig(state):
+                        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+                                params)[0]:
+                            name = jax.tree_util.keystr(kp).strip("'[]").replace(
+                                "']['", "/")
+                            # multi-host: leaves sharded across processes are
+                            # not host-fetchable directly
+                            if (hasattr(leaf, "is_fully_addressable")
+                                    and not leaf.is_fully_addressable):
+                                from jax.experimental import multihost_utils
+                                leaf = multihost_utils.process_allgather(leaf)
+                            self.train_summary.add_histogram(
+                                name, np.asarray(leaf), neval)
                 state["neval"] = neval + 1
                 state["evalCounter"] = state.get("evalCounter", 0) + 1
                 self._maybe_validate(params, net_state, state)

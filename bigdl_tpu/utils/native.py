@@ -65,6 +65,14 @@ def _bind(cdll: ctypes.CDLL) -> None:
     cdll.bigdl_bf16_to_f32.restype = None
     cdll.bigdl_bf16_to_f32.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    cdll.bigdl_gather_rows.restype = None
+    cdll.bigdl_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
+        ctypes.c_size_t]
+    cdll.bigdl_reduce_sum_f32.restype = None
+    cdll.bigdl_reduce_sum_f32.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.c_size_t]
 
     def crc32c(data: bytes) -> int:  # noqa: F811
         return cdll.bigdl_crc32c(data, len(data))
@@ -132,13 +140,8 @@ def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
     if lib is not None and arr.size:
         lib.bigdl_f32_to_bf16(arr.ctypes.data, out.ctypes.data, arr.size)
         return out
-    bits = arr.view(np.uint32)
-    lsb = (bits >> 16) & 1
-    rounded = ((bits + 0x7FFF + lsb) >> 16).astype(np.uint16)
-    is_nan = (bits & 0x7FFFFFFF) > 0x7F800000  # quiet NaNs, keep sign
-    out[...] = np.where(is_nan, ((bits >> 16) | 0x0040).astype(np.uint16),
-                        rounded)
-    return out
+    import ml_dtypes  # hard transitive dep of jax
+    return arr.astype(ml_dtypes.bfloat16).view(np.uint16)
 
 
 def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
@@ -147,7 +150,34 @@ def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
     if lib is not None and arr.size:
         lib.bigdl_bf16_to_f32(arr.ctypes.data, out.ctypes.data, arr.size)
         return out
-    out.view(np.uint32)[...] = arr.astype(np.uint32) << 16
+    import ml_dtypes
+    return arr.view(ml_dtypes.bfloat16).astype(np.float32)
+
+
+def gather_rows(rows) -> np.ndarray:
+    """Stack equal-shape contiguous arrays into one batch array using the
+    parallel native memcpy kernel (the batching half of
+    MTLabeledBGRImgToBatch); np.stack fallback."""
+    rows = [np.ascontiguousarray(r) for r in rows]
+    if lib is None or not rows:
+        return np.stack(rows) if rows else np.empty((0,))
+    out = np.empty((len(rows),) + rows[0].shape, dtype=rows[0].dtype)
+    ptrs = (ctypes.c_void_p * len(rows))(
+        *[r.ctypes.data for r in rows])
+    lib.bigdl_gather_rows(out.ctypes.data, ptrs, rows[0].nbytes, len(rows))
+    return out
+
+
+def reduce_sum_f32(bufs) -> np.ndarray:
+    """Elementwise sum of equal-shape float32 arrays via the parallel native
+    kernel (host-side analog of the reference's gradient-sum loop,
+    DistriOptimizer.scala:226-250); np.sum fallback."""
+    bufs = [np.ascontiguousarray(b, dtype=np.float32) for b in bufs]
+    if lib is None or not bufs:
+        return np.sum(bufs, axis=0, dtype=np.float32)
+    out = np.empty_like(bufs[0])
+    ptrs = (ctypes.c_void_p * len(bufs))(*[b.ctypes.data for b in bufs])
+    lib.bigdl_reduce_sum_f32(out.ctypes.data, ptrs, len(bufs), out.size)
     return out
 
 

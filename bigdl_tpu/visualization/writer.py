@@ -1,0 +1,112 @@
+"""TensorBoard event-file writers.
+
+Reference: visualization/tensorboard/{FileWriter,EventWriter,RecordWriter}.scala
+— a FileWriter owns an EventWriter (background thread draining a queue every
+`flushMillis`), which frames Event protos as TFRecords with masked CRC32C
+(RecordWriter.scala:44-57, netty/Crc32c.java).  Same structure here; the CRC
+comes from the native C++ library when built (csrc/crc32c.cc)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..utils.recordio import masked_crc32c
+from . import proto
+
+import struct
+
+__all__ = ["RecordWriter", "EventWriter", "FileWriter"]
+
+
+class RecordWriter:
+    """TFRecord framing of serialized Event protos onto an open file."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def write(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", masked_crc32c(payload)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+
+class EventWriter:
+    """Queue + background flusher thread (EventWriter.scala)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = "events.out.tfevents.%d.%s" % (
+            int(time.time()), socket.gethostname())
+        self.path = os.path.join(log_dir, fname)
+        self._file = open(self.path, "wb")
+        self._writer = RecordWriter(self._file)
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._flush_secs = flush_secs
+        # version record first, as TF does (EventWriter.scala init)
+        self._writer.write(proto.event_bytes(
+            time.time(), file_version="brain.Event:2"))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_event(self, event: bytes) -> None:
+        self._queue.put(event)
+
+    def _drain(self) -> bool:
+        """Write queued events; returns False once the poison pill is seen."""
+        alive = True
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return alive
+            if item is None:
+                alive = False
+            else:
+                self._writer.write(item)
+
+    def _run(self) -> None:
+        while self._drain():
+            self._writer.flush()
+            time.sleep(self._flush_secs)
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+        self._file.close()
+
+    def flush(self) -> None:
+        # synchronous flush: drain whatever is queued right now
+        deadline = time.time() + 30
+        while not self._queue.empty() and time.time() < deadline:
+            time.sleep(0.01)
+        self._file.flush()
+
+
+class FileWriter:
+    """Public writer facade (FileWriter.scala)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0):
+        self.log_dir = log_dir
+        self._events = EventWriter(log_dir, flush_secs)
+
+    def add_summary(self, summary: bytes, global_step: int = 0) -> "FileWriter":
+        self._events.add_event(
+            proto.event_bytes(time.time(), step=global_step, summary=summary))
+        return self
+
+    def flush(self) -> None:
+        self._events.flush()
+
+    def close(self) -> None:
+        self._events.close()

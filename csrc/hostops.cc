@@ -87,10 +87,13 @@ void bigdl_gather_rows(char* dst, const char* const* srcs, size_t row_bytes,
 
 // Parallel sum of k float buffers into dst (the gradient-aggregation loop of
 // DistriOptimizer.scala:226-250, kept for host-side reference optimizers).
+// dst is fully overwritten (initialized from srcs[0]).
 void bigdl_reduce_sum_f32(float* dst, const float* const* srcs, int k,
                           size_t n) {
+  if (k <= 0) return;
   ParallelFor(n, g_num_threads, [&](size_t b, size_t e) {
-    for (int j = 0; j < k; ++j) {
+    std::memcpy(dst + b, srcs[0] + b, (e - b) * sizeof(float));
+    for (int j = 1; j < k; ++j) {
       const float* s = srcs[j];
       for (size_t i = b; i < e; ++i) dst[i] += s[i];
     }

@@ -67,7 +67,9 @@ void* bigdl_record_reader_open(const char* path) {
 }
 
 // Returns payload length (>=0), -1 on clean EOF, -2 on corruption/short read.
-int64_t bigdl_record_reader_next(void* handle) {
+// Exceptions (e.g. bad_alloc on a bogus length from a truncated file) must
+// not unwind through the ctypes FFI frame, so the body is fenced.
+int64_t bigdl_record_reader_next(void* handle) try {
   Reader* r = static_cast<Reader*>(handle);
   char header[8];
   size_t got = fread(header, 1, 8, r->f);
@@ -84,6 +86,8 @@ int64_t bigdl_record_reader_next(void* handle) {
   if (!ReadAll(r->f, &pcrc, 4)) return -2;
   if (pcrc != bigdl::MaskedCrc32c(r->buf.data(), len)) return -2;
   return static_cast<int64_t>(len);
+} catch (...) {
+  return -2;
 }
 
 const char* bigdl_record_reader_data(void* handle) {

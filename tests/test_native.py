@@ -138,6 +138,37 @@ def test_bf16_special_values():
         enc_py, native.f32_to_bf16(np.concatenate([specials, snan])))
 
 
+def test_gather_rows():
+    rng = np.random.default_rng(2)
+    rows = [rng.standard_normal((3, 5)).astype(np.float32) for _ in range(9)]
+    np.testing.assert_array_equal(native.gather_rows(rows), np.stack(rows))
+    big = [rng.standard_normal(40000).astype(np.float32) for _ in range(4)]
+    np.testing.assert_array_equal(native.gather_rows(big), np.stack(big))
+
+
+def test_reduce_sum_f32():
+    rng = np.random.default_rng(3)
+    bufs = [rng.standard_normal(70001).astype(np.float32) for _ in range(5)]
+    got = native.reduce_sum_f32(bufs)
+    np.testing.assert_allclose(got, np.sum(bufs, axis=0), rtol=1e-5)
+    one = native.reduce_sum_f32(bufs[:1])
+    np.testing.assert_array_equal(one, bufs[0])
+
+
+def test_truncated_large_length_record(tmp_path):
+    # A header that claims an 8 GB payload but passes its own CRC must yield
+    # a catchable IOError, not a bad_alloc abort through the FFI.
+    import struct as _s
+    p = str(tmp_path / "trunc.bdr")
+    header = _s.pack("<Q", 8 << 30)
+    with open(p, "wb") as f:
+        f.write(header)
+        f.write(_s.pack("<I", recordio.masked_crc32c(header)))
+    with native.NativeRecordReader(p) as r:
+        with pytest.raises(IOError):
+            next(r)
+
+
 def test_num_threads_api():
     native.set_num_threads(3)
     assert native.get_num_threads() == 3

@@ -1,0 +1,120 @@
+"""Visualization subsystem tests: proto wire codec roundtrips, event-file
+framing, TrainSummary/ValidationSummary end-to-end through the Optimizer.
+
+Reference analog: visualization specs read event files back via
+FileReader.scala; our reader plays the same oracle role."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.visualization import (TrainSummary, ValidationSummary, proto,
+                                     read_scalar)
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 63 - 1):
+        buf = proto.encode_varint(v)
+        got, pos = proto.decode_varint(buf, 0)
+        assert got == v and pos == len(buf)
+
+
+def test_scalar_event_roundtrip():
+    summ = proto.scalar_summary("Loss", 1.5)
+    ev = proto.event_bytes(123.25, step=7, summary=summ)
+    parsed = proto.parse_event(ev)
+    assert parsed["wall_time"] == 123.25
+    assert parsed["step"] == 7
+    v = parsed["values"][0]
+    assert v["tag"] == "Loss"
+    assert v["simple_value"] == pytest.approx(1.5)
+
+
+def test_histogram_event_roundtrip():
+    x = np.concatenate([np.zeros(5), np.linspace(-3, 3, 100)])
+    ev = proto.event_bytes(1.0, step=2,
+                           summary=proto.histogram_summary("w", x))
+    h = proto.parse_event(ev)["values"][0]["histo"]
+    assert h["num"] == pytest.approx(105)
+    assert h["min"] == pytest.approx(-3)
+    assert h["max"] == pytest.approx(3)
+    assert h["sum"] == pytest.approx(float(x.sum()))
+    assert sum(h["bucket"]) == pytest.approx(105)
+    assert len(h["bucket_limit"]) == len(h["bucket"])
+
+
+def test_file_version_header(tmp_path):
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.add_scalar("Loss", 0.5, 1)
+    ts.close()
+    from bigdl_tpu.visualization.reader import list_events
+    events = list(list_events(ts.summary_dir))
+    assert events[0]["file_version"] == "brain.Event:2"
+
+
+def test_train_summary_scalar_readback(tmp_path):
+    ts = TrainSummary(str(tmp_path), "app")
+    for i in range(10):
+        ts.add_scalar("Loss", 1.0 / (i + 1), i)
+        ts.add_scalar("Throughput", 100.0 + i, i)
+    got = ts.read_scalar("Loss")
+    assert [s for s, _, _ in got] == list(range(10))
+    assert got[4][1] == pytest.approx(0.2)
+    assert len(ts.read_scalar("Throughput")) == 10
+    assert ts.summary_dir.endswith("app/train")
+    ts.close()
+
+
+def test_validation_summary_dir(tmp_path):
+    vs = ValidationSummary(str(tmp_path), "app")
+    vs.add_scalar("Top1Accuracy", 0.9, 3)
+    assert vs.read_scalar("Top1Accuracy")[0][:2] == (3, pytest.approx(0.9))
+    assert vs.summary_dir.endswith("app/validation")
+    vs.close()
+
+
+def test_summary_trigger_validation(tmp_path):
+    ts = TrainSummary(str(tmp_path), "app")
+    from bigdl_tpu.optim import Trigger
+    ts.set_summary_trigger("Parameters", Trigger.several_iteration(5))
+    assert ts.get_summary_trigger("Parameters") is not None
+    with pytest.raises(ValueError):
+        ts.set_summary_trigger("NotATag", Trigger.every_epoch())
+    ts.close()
+
+
+def test_optimizer_writes_summaries(tmp_path):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import Engine
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import (Adam, Optimizer, Top1Accuracy, Trigger)
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(8).astype(np.float32),
+                      np.float32(i % 2)) for i in range(64)]
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(16, drop_last=True))
+    model = (nn.Sequential().add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+    ts = TrainSummary(str(tmp_path), "job")
+    ts.set_summary_trigger("Parameters", Trigger.several_iteration(2))
+    vs = ValidationSummary(str(tmp_path), "job")
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(1e-2))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_train_summary(ts)
+           .set_validation(Trigger.every_epoch(), ds, [Top1Accuracy()])
+           .set_validation_summary(vs)
+           .set_log_interval(1))
+    opt.optimize()
+    loss = ts.read_scalar("Loss")
+    assert len(loss) >= 4
+    assert all(np.isfinite(v) for _, v, _ in loss)
+    assert len(ts.read_scalar("LearningRate")) == len(loss)
+    # histograms were written for every parameter leaf
+    from bigdl_tpu.visualization.reader import list_events
+    histo_tags = {v["tag"] for ev in list_events(ts.summary_dir)
+                  for v in ev["values"] if v["histo"] is not None}
+    assert histo_tags, "expected parameter histograms"
+    acc = vs.read_scalar("Top1Accuracy")
+    assert len(acc) == 2
+    ts.close()
+    vs.close()
