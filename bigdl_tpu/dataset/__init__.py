@@ -26,11 +26,17 @@ import numpy as np
 from .sample import Sample, MiniBatch, PaddingParam, FixedLength
 from .transformer import (Transformer, ChainedTransformer, SampleToMiniBatch,
                           Identity)
+from .text import (SentenceSplitter, SentenceTokenizer, SentenceBiPadding,
+                   Dictionary, LabeledSentence, TextToLabeledSentence,
+                   LabeledSentenceToSample)
 
 __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "TransformedDataSet", "DataSet", "Sample", "MiniBatch",
            "PaddingParam", "FixedLength", "Transformer", "ChainedTransformer",
-           "SampleToMiniBatch", "Identity"]
+           "SampleToMiniBatch", "Identity", "SentenceSplitter",
+           "SentenceTokenizer", "SentenceBiPadding", "Dictionary",
+           "LabeledSentence", "TextToLabeledSentence",
+           "LabeledSentenceToSample"]
 
 
 class AbstractDataSet:

@@ -17,9 +17,12 @@ from .linear import (Linear, Bilinear, CMul, CAdd, Mul, Add, MulConstant,
                      AddConstant)
 from .conv import (SpatialConvolution, SpatialDilatedConvolution,
                    SpatialFullConvolution, TemporalConvolution,
-                   VolumetricConvolution, SpatialShareConvolution)
+                   VolumetricConvolution, SpatialShareConvolution,
+                   SpatialConvolutionMap)
 from .pooling import (SpatialMaxPooling, SpatialAveragePooling,
                       VolumetricMaxPooling, RoiPooling)
+from .detection import Nms
+from .tree import TreeLSTM, BinaryTreeLSTM
 from .normalization import (BatchNormalization, SpatialBatchNormalization,
                             Normalize, SpatialCrossMapLRN,
                             SpatialWithinChannelLRN,

@@ -27,7 +27,8 @@ from .module import Module
 
 __all__ = ["SpatialConvolution", "SpatialDilatedConvolution",
            "SpatialFullConvolution", "TemporalConvolution",
-           "VolumetricConvolution", "SpatialShareConvolution"]
+           "VolumetricConvolution", "SpatialShareConvolution",
+           "SpatialConvolutionMap"]
 
 
 class SpatialConvolution(Module):
@@ -98,6 +99,72 @@ class SpatialShareConvolution(SpatialConvolution):
     """Reference nn/SpatialShareConvolution.scala exists only to share im2col
     buffers between layers — meaningless under XLA (the compiler owns buffers), so
     it is a pure alias kept for API parity."""
+
+
+class SpatialConvolutionMap(SpatialConvolution):
+    """Convolution with a sparse input->output map connection table
+    (reference: nn/SpatialConvolutionMap.scala; Torch's conn-table conv).
+
+    TPU re-design: rather than per-connection scalar loops, keep a dense HWIO
+    kernel and multiply by a static 0/1 connectivity mask — XLA folds the mask
+    into the conv weights and the MXU still sees one dense conv.  Gradients of
+    masked-out entries are zero, so they stay dead under training.
+
+    `conn_table`: int array (n_connections, 2) of (input_map, output_map)
+    pairs, 0-based.  Helpers `full/one_to_one/random` mirror the reference's
+    table constructors.  Plane counts default to table-max+1; pass
+    `n_input_plane`/`n_output_plane` explicitly when the table may not
+    mention the highest map (e.g. sparse `random` tables).
+    """
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 n_input_plane: int = None, n_output_plane: int = None):
+        table = jnp.asarray(conn_table, dtype=jnp.int32)
+        n_in = n_input_plane or int(table[:, 0].max()) + 1
+        n_out = n_output_plane or int(table[:, 1].max()) + 1
+        if int(table[:, 0].max()) >= n_in or int(table[:, 1].max()) >= n_out:
+            raise ValueError("connection table indexes beyond plane counts")
+        super().__init__(n_in, n_out, kernel_w, kernel_h, stride_w, stride_h,
+                         pad_w, pad_h, 1, True, with_bias,
+                         w_regularizer, b_regularizer)
+        mask = jnp.zeros((n_in, n_out))
+        mask = mask.at[table[:, 0], table[:, 1]].set(1.0)
+        self._mask = mask[None, None]  # (1, 1, cin, cout) broadcast over kh,kw
+
+    @staticmethod
+    def full(n_in: int, n_out: int):
+        """Fully-connected table (SpatialConvolutionMap.scala `full`)."""
+        import numpy as _np
+        return _np.stack(_np.meshgrid(_np.arange(n_in), _np.arange(n_out),
+                                      indexing="ij"), -1).reshape(-1, 2)
+
+    @staticmethod
+    def one_to_one(n_features: int):
+        """(SpatialConvolutionMap.scala `oneToOne`)."""
+        import numpy as _np
+        r = _np.arange(n_features)
+        return _np.stack([r, r], -1)
+
+    @staticmethod
+    def random(n_in: int, n_out: int, n_to: int, seed: int = 0):
+        """Each output map connects to `n_to` random input maps
+        (SpatialConvolutionMap.scala `random`)."""
+        import numpy as _np
+        rng = _np.random.default_rng(seed)
+        rows = []
+        for o in range(n_out):
+            for i in rng.choice(n_in, size=min(n_to, n_in), replace=False):
+                rows.append((int(i), o))
+        return _np.array(rows, dtype=_np.int32)
+
+    def _apply(self, params, x):
+        masked = {**params,
+                  "weight": params["weight"] * self._mask.astype(
+                      params["weight"].dtype)}
+        return super()._apply(masked, x)
 
 
 class SpatialDilatedConvolution(SpatialConvolution):

@@ -1,0 +1,78 @@
+"""Detection ops: non-maximum suppression.
+
+Reference: nn/Nms.scala — greedy NMS over scored boxes used beside
+`RoiPooling` in the Fast-R-CNN path.
+
+TPU-native re-design: the reference's data-dependent while-loop over
+surviving boxes becomes a fixed-trip `lax.fori_loop` (static shapes, jit- and
+vmap-safe): each iteration selects the highest-scoring live box, emits it,
+and suppresses boxes with IoU above threshold.  Suppressed slots are filled
+with -1, so the output is a static (max_output,) index array.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+
+__all__ = ["Nms", "nms"]
+
+
+def _iou_matrix(boxes):
+    """(n, 4) xyxy boxes -> (n, n) IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, scores, iou_threshold: float = 0.5,
+        max_output: int | None = None, score_threshold: float = -jnp.inf):
+    """Greedy NMS.  Returns (indices, num_valid): `indices` is a static
+    (max_output,) int32 array padded with -1."""
+    n = boxes.shape[0]
+    if max_output is None:
+        max_output = n
+    iou = _iou_matrix(boxes)
+    live = scores > score_threshold
+
+    def body(_, carry):
+        sel, count, live = carry
+        best = jnp.argmax(jnp.where(live, scores, -jnp.inf))
+        any_live = jnp.any(live)
+        sel = sel.at[count].set(jnp.where(any_live, best, -1))
+        count = count + any_live.astype(jnp.int32)
+        # kill the selected box and everything overlapping it
+        suppress = iou[best] > iou_threshold
+        live = live & ~suppress & (jnp.arange(n) != best)
+        live = live & any_live  # freeze once exhausted
+        return sel, count, live
+
+    sel0 = jnp.full((max_output,), -1, dtype=jnp.int32)
+    sel, count, _ = lax.fori_loop(0, max_output, body,
+                                  (sel0, jnp.int32(0), live))
+    return sel, count
+
+
+class Nms(Module):
+    """Module wrapper: input is a dict/tuple (boxes (n,4), scores (n,));
+    output is the padded index array (reference Nms.scala mutates an output
+    buffer of indices)."""
+
+    def __init__(self, iou_threshold: float = 0.5,
+                 max_output: int | None = None):
+        super().__init__()
+        self.iou_threshold = iou_threshold
+        self.max_output = max_output
+
+    def _apply(self, params, inp):
+        boxes, scores = inp
+        idx, _ = nms(boxes, scores, self.iou_threshold, self.max_output)
+        return idx

@@ -1,0 +1,140 @@
+"""Tree-structured LSTMs.
+
+Reference: nn/TreeLSTM.scala (base) and nn/BinaryTreeLSTM.scala — a
+constituency-tree LSTM (Tai et al. 2015) used by the treeLSTMSentiment
+example: leaves embed word vectors through a leaf module; internal nodes
+compose their two children's (h, c) states with gated composition.  The
+reference walks the tree object graph recursively per example.
+
+TPU-native re-design: trees are encoded as static-shape arrays in
+topological (children-before-parent) order, and the recursion becomes ONE
+`lax.scan` over node slots carrying an (n_nodes, hidden) state buffer —
+compiled once for a given tree size, vmap-batched over examples.  Encoding
+per example (pad nodes with -1 rows to a fixed n_nodes):
+
+    children: (n_nodes, 2) int32 — indices of left/right child node slots,
+              or -1 for leaves
+    leaf_ids: (n_nodes,) int32 — index into the input sequence for leaves,
+              -1 for internal nodes
+
+Input to BinaryTreeLSTM.apply: (inputs, children, leaf_ids) with
+inputs (batch, seq, in_dim), children (batch, n_nodes, 2),
+leaf_ids (batch, n_nodes).  Output: (batch, n_nodes, hidden) node hiddens
+(padded slots zero) — the reference likewise emits per-node hidden states.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import get_policy
+from .module import Module
+
+__all__ = ["TreeLSTM", "BinaryTreeLSTM"]
+
+
+def _uniform(rng, shape, stdv):
+    return jax.random.uniform(rng, shape, get_policy().param_dtype,
+                              -stdv, stdv)
+
+
+class TreeLSTM(Module):
+    """Base holding sizes (reference: nn/TreeLSTM.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Binary constituency TreeLSTM (reference: nn/BinaryTreeLSTM.scala).
+
+    Leaf:      c = W_leaf x,            h = o * tanh(c), o = sigm(O_leaf x)
+    Internal:  gates from [h_l, h_r]:   i, f_l, f_r, o, g
+               c = i*g + f_l*c_l + f_r*c_r,   h = o * tanh(c)
+    (the gate structure of the reference's composer module, built there out
+    of Linear/CAddTable graph nodes.)
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True):
+        super().__init__(input_size, hidden_size)
+        self.gate_output = gate_output
+
+    def _init(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        stdv = 1.0 / (self.hidden_size ** 0.5)
+        h = self.hidden_size
+        return {
+            "leaf_c": _uniform(k1, (self.input_size, h), stdv),
+            "leaf_o": _uniform(k2, (self.input_size, h), stdv),
+            # composer: [h_l, h_r] -> 5 gates (i, f_l, f_r, o, g)
+            "comp_w": _uniform(k3, (2 * h, 5 * h), stdv),
+            "comp_b": _uniform(k4, (5 * h,), stdv),
+        }
+
+    def _leaf(self, params, x):
+        cd = get_policy().compute_dtype
+        c = x.astype(cd) @ params["leaf_c"].astype(cd)
+        if self.gate_output:
+            o = jax.nn.sigmoid(x.astype(cd) @ params["leaf_o"].astype(cd))
+            h = o * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return h, c
+
+    def _compose(self, params, h_l, c_l, h_r, c_r):
+        cd = get_policy().compute_dtype
+        z = jnp.concatenate([h_l, h_r], axis=-1).astype(cd)
+        gates = z @ params["comp_w"].astype(cd) + params["comp_b"]
+        i, f_l, f_r, o, g = jnp.split(gates, 5, axis=-1)
+        i, f_l, f_r = (jax.nn.sigmoid(i), jax.nn.sigmoid(f_l),
+                       jax.nn.sigmoid(f_r))
+        c = i * jnp.tanh(g) + f_l * c_l + f_r * c_r
+        h = (jax.nn.sigmoid(o) if self.gate_output else 1.0) * jnp.tanh(c)
+        return h, c
+
+    def _run_tree(self, params, inputs, children, leaf_ids):
+        """One example: inputs (seq, in), children (n_nodes, 2),
+        leaf_ids (n_nodes,) -> (n_nodes, hidden)."""
+        n_nodes = children.shape[0]
+        hdim = self.hidden_size
+        h_buf = jnp.zeros((n_nodes, hdim), jnp.float32)
+        c_buf = jnp.zeros((n_nodes, hdim), jnp.float32)
+
+        def step(carry, node):
+            h_buf, c_buf = carry
+            idx, (l, r), leaf_id = node
+            is_leaf = l < 0
+            # leaf path: gather the word vector (index 0 when padded/internal)
+            x = inputs[jnp.maximum(leaf_id, 0)]
+            h_leaf, c_leaf = self._leaf(params, x)
+            # internal path: compose children (index 0 when leaf/padded)
+            h_int, c_int = self._compose(
+                params, h_buf[jnp.maximum(l, 0)], c_buf[jnp.maximum(l, 0)],
+                h_buf[jnp.maximum(r, 0)], c_buf[jnp.maximum(r, 0)])
+            valid = (leaf_id >= 0) | (l >= 0)
+            h = jnp.where(valid,
+                          jnp.where(is_leaf, h_leaf, h_int), 0.0)
+            c = jnp.where(valid,
+                          jnp.where(is_leaf, c_leaf, c_int), 0.0)
+            h_buf = lax.dynamic_update_slice(h_buf, h[None].astype(jnp.float32),
+                                             (idx, 0))
+            c_buf = lax.dynamic_update_slice(c_buf, c[None].astype(jnp.float32),
+                                             (idx, 0))
+            return (h_buf, c_buf), None
+
+        nodes = (jnp.arange(n_nodes), (children[:, 0], children[:, 1]),
+                 leaf_ids)
+        (h_buf, _), _ = lax.scan(step, (h_buf, c_buf), nodes)
+        return h_buf
+
+    def _apply(self, params, inp):
+        inputs, children, leaf_ids = inp
+        children = jnp.asarray(children, jnp.int32)
+        leaf_ids = jnp.asarray(leaf_ids, jnp.int32)
+        run = lambda x, ch, lf: self._run_tree(params, x, ch, lf)
+        return jax.vmap(run)(inputs, children, leaf_ids).astype(inputs.dtype)

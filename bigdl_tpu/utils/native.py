@@ -136,8 +136,8 @@ def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
     parameters/FP16CompressedTensor.scala:271-279 — truncate-only; we round
     like the TPU hardware does)."""
     arr = np.ascontiguousarray(arr, dtype=np.float32)
-    out = np.empty(arr.shape, dtype=np.uint16)
     if lib is not None and arr.size:
+        out = np.empty(arr.shape, dtype=np.uint16)
         lib.bigdl_f32_to_bf16(arr.ctypes.data, out.ctypes.data, arr.size)
         return out
     import ml_dtypes  # hard transitive dep of jax
@@ -146,8 +146,8 @@ def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
 
 def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
     arr = np.ascontiguousarray(arr, dtype=np.uint16)
-    out = np.empty(arr.shape, dtype=np.float32)
     if lib is not None and arr.size:
+        out = np.empty(arr.shape, dtype=np.float32)
         lib.bigdl_bf16_to_f32(arr.ctypes.data, out.ctypes.data, arr.size)
         return out
     import ml_dtypes
@@ -161,6 +161,9 @@ def gather_rows(rows) -> np.ndarray:
     rows = [np.ascontiguousarray(r) for r in rows]
     if lib is None or not rows:
         return np.stack(rows) if rows else np.empty((0,))
+    if any(r.shape != rows[0].shape or r.dtype != rows[0].dtype
+           for r in rows[1:]):  # native memcpy would read out of bounds
+        raise ValueError("gather_rows requires equal shapes and dtypes")
     out = np.empty((len(rows),) + rows[0].shape, dtype=rows[0].dtype)
     ptrs = (ctypes.c_void_p * len(rows))(
         *[r.ctypes.data for r in rows])
@@ -175,6 +178,8 @@ def reduce_sum_f32(bufs) -> np.ndarray:
     bufs = [np.ascontiguousarray(b, dtype=np.float32) for b in bufs]
     if lib is None or not bufs:
         return np.sum(bufs, axis=0, dtype=np.float32)
+    if any(b.shape != bufs[0].shape for b in bufs[1:]):
+        raise ValueError("reduce_sum_f32 requires equal shapes")
     out = np.empty_like(bufs[0])
     ptrs = (ctypes.c_void_p * len(bufs))(*[b.ctypes.data for b in bufs])
     lib.bigdl_reduce_sum_f32(out.ctypes.data, ptrs, len(bufs), out.size)

@@ -40,18 +40,31 @@ class RecordWriter:
         self._f.flush()
 
 
+_file_counter = [0]
+_counter_lock = threading.Lock()
+
+
 class EventWriter:
-    """Queue + background flusher thread (EventWriter.scala)."""
+    """Queue + background flusher thread (EventWriter.scala).  All record
+    writes happen under one lock, so `flush()` can drain synchronously
+    without racing the background thread."""
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
         os.makedirs(log_dir, exist_ok=True)
-        fname = "events.out.tfevents.%d.%s" % (
-            int(time.time()), socket.gethostname())
+        with _counter_lock:
+            _file_counter[0] += 1
+            uniq = _file_counter[0]
+        # pid + per-process counter keep same-second writers from
+        # truncating each other
+        fname = "events.out.tfevents.%d.%s.%d.%d" % (
+            int(time.time()), socket.gethostname(), os.getpid(), uniq)
         self.path = os.path.join(log_dir, fname)
         self._file = open(self.path, "wb")
         self._writer = RecordWriter(self._file)
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._flush_secs = flush_secs
+        self._write_lock = threading.Lock()
+        self._closed = False
         # version record first, as TF does (EventWriter.scala init)
         self._writer.write(proto.event_bytes(
             time.time(), file_version="brain.Event:2"))
@@ -69,28 +82,35 @@ class EventWriter:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 return alive
-            if item is None:
-                alive = False
-            else:
-                self._writer.write(item)
+            with self._write_lock:
+                if item is None:
+                    alive = False
+                elif not self._closed:
+                    self._writer.write(item)
 
     def _run(self) -> None:
         while self._drain():
-            self._writer.flush()
+            with self._write_lock:
+                self._writer.flush()
             time.sleep(self._flush_secs)
-        self._writer.flush()
+        with self._write_lock:
+            if not self._closed:
+                self._writer.flush()
 
     def close(self) -> None:
+        self.flush()
         self._queue.put(None)
         self._thread.join(timeout=30)
-        self._file.close()
+        with self._write_lock:
+            self._closed = True
+            self._file.close()
 
     def flush(self) -> None:
-        # synchronous flush: drain whatever is queued right now
-        deadline = time.time() + 30
-        while not self._queue.empty() and time.time() < deadline:
-            time.sleep(0.01)
-        self._file.flush()
+        # synchronous: drain the queue ourselves under the write lock
+        self._drain()
+        with self._write_lock:
+            if not self._closed:
+                self._writer.flush()
 
 
 class FileWriter:

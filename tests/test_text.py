@@ -1,0 +1,84 @@
+"""Text pipeline tests (reference analog: dataset/text specs and the
+models/rnn/Train.scala:49-96 pipeline)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset import (Dictionary, LabeledSentenceToSample,
+                               SentenceBiPadding, SentenceSplitter,
+                               SentenceTokenizer, TextToLabeledSentence)
+from bigdl_tpu.dataset.text import SENTENCE_END, SENTENCE_START
+
+
+def test_sentence_splitter():
+    docs = ["One sentence. Two sentences! Three? yes.", "  single  "]
+    out = list(SentenceSplitter()(iter(docs)))
+    assert out[0] == ["One sentence.", "Two sentences!", "Three?", "yes."]
+    assert out[1] == ["single"]
+
+
+def test_tokenizer():
+    toks = list(SentenceTokenizer()(iter(["Hello, world! It's fine."])))[0]
+    assert toks == ["hello", ",", "world", "!", "it's", "fine", "."]
+
+
+def test_bi_padding():
+    out = list(SentenceBiPadding()(iter([["a", "b"]])))[0]
+    assert out == [SENTENCE_START, "a", "b", SENTENCE_END]
+
+
+def test_dictionary_ranking_and_unk():
+    sents = [["a", "a", "a", "b", "b", "c"], ["a", "d"]]
+    d = Dictionary(sents, vocab_size=2)
+    assert d.vocab_size() == 3  # a, b + <unk>
+    assert d.get_index("a") == 0
+    assert d.get_index("b") == 1
+    unk = d.get_index("zzz")
+    assert unk == d.get_index("c") == d.word2index()[Dictionary.UNK]
+    assert d.get_word(0) == "a"
+
+
+def test_dictionary_save_load(tmp_path):
+    d = Dictionary([["x", "y", "x"]])
+    d.save(str(tmp_path))
+    d2 = Dictionary.load(str(tmp_path))
+    assert d2.word2index() == d.word2index()
+    assert d2.index2word() == d.index2word()
+
+
+def test_text_to_labeled_sentence():
+    d = Dictionary([["a", "b", "c"]])
+    ls = list(TextToLabeledSentence(d)(iter([["a", "b", "c"]])))[0]
+    np.testing.assert_array_equal(ls.data, d.encode(["a", "b"]))
+    np.testing.assert_array_equal(ls.label, d.encode(["b", "c"]))
+    # too-short sentences are dropped
+    assert list(TextToLabeledSentence(d)(iter([["a"]]))) == []
+
+
+def test_labeled_sentence_to_sample_onehot_and_padding():
+    d = Dictionary([["a", "b", "c"]])
+    # `>>` == reference's `->` chaining (Transformer.scala:49)
+    chain = TextToLabeledSentence(d) >> LabeledSentenceToSample(
+        vocab_length=d.vocab_size(), fixed_data_length=5,
+        fixed_label_length=5)
+    s = list(chain(iter([["a", "b", "c"]])))[0]
+    assert s.feature.shape == (5, d.vocab_size())
+    assert s.feature[0, d.get_index("a")] == 1.0
+    assert s.feature[3].sum() == 0.0  # padded rows are zero
+    assert s.label.shape == (5,)
+
+
+def test_full_char_rnn_pipeline_composes():
+    corpus = ["the cat sat. the dog sat. the cat ran."]
+    sentences = [s for doc in SentenceSplitter()(iter(corpus)) for s in doc]
+    tokens = list(SentenceTokenizer()(iter(sentences)))
+    tokens = list(SentenceBiPadding()(iter(tokens)))
+    d = Dictionary(tokens, vocab_size=10)
+    chain = (TextToLabeledSentence(d) >>
+             LabeledSentenceToSample(fixed_data_length=8,
+                                     fixed_label_length=8))
+    samples = list(chain(iter(tokens)))
+    assert len(samples) == 3
+    for s in samples:
+        assert s.feature.shape == (8,)
+        assert s.label.shape == (8,)
+        assert s.feature.dtype == np.int32

@@ -1,0 +1,169 @@
+"""Tests for Nms, SpatialConvolutionMap, TreeLSTM/BinaryTreeLSTM
+(reference analogs: nn/Nms.scala, nn/SpatialConvolutionMap.scala,
+nn/BinaryTreeLSTM.scala + the treeLSTMSentiment example)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.detection import nms
+
+
+def _ref_nms(boxes, scores, thr):
+    """Plain numpy greedy NMS oracle."""
+    order = np.argsort(-scores)
+    keep, suppressed = [], np.zeros(len(boxes), bool)
+    areas = np.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        np.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            ix1, iy1 = np.maximum(boxes[i, :2], boxes[j, :2])
+            ix2, iy2 = np.minimum(boxes[i, 2:], boxes[j, 2:])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            union = areas[i] + areas[j] - inter
+            if union > 0 and inter / union > thr:
+                suppressed[j] = True
+    return keep
+
+
+def test_nms_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 10, (30, 2))
+    wh = rng.uniform(1, 5, (30, 2))
+    boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    scores = rng.uniform(0, 1, 30).astype(np.float32)
+    idx, count = jax.jit(nms)(jnp.asarray(boxes), jnp.asarray(scores), 0.5)
+    got = [int(i) for i in np.asarray(idx) if i >= 0]
+    assert got == _ref_nms(boxes, scores, 0.5)
+    assert int(count) == len(got)
+
+
+def test_nms_max_output_and_padding():
+    boxes = jnp.array([[0, 0, 1, 1], [10, 10, 11, 11], [20, 20, 21, 21]],
+                      jnp.float32)
+    scores = jnp.array([0.9, 0.8, 0.7])
+    idx, count = nms(boxes, scores, 0.5, max_output=2)
+    assert list(np.asarray(idx)) == [0, 1]
+    assert int(count) == 2
+    idx, count = nms(boxes, scores, 0.5, max_output=5)
+    assert list(np.asarray(idx)) == [0, 1, 2, -1, -1]
+    assert int(count) == 3
+
+
+def test_nms_module():
+    m = nn.Nms(iou_threshold=0.5, max_output=4)
+    params, state = m.init(jax.random.key(0))
+    boxes = jnp.array([[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 6, 6]],
+                      jnp.float32)
+    scores = jnp.array([0.5, 0.9, 0.3])
+    out, _ = m.apply(params, state, (boxes, scores))
+    assert list(np.asarray(out)) == [1, 2, -1, -1]
+
+
+def test_spatial_convolution_map_masks_connections():
+    table = nn.SpatialConvolutionMap.one_to_one(3)
+    m = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    params, state = m.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (2, 8, 8, 3)), jnp.float32)
+    out, _ = m.apply(params, state, x)
+    assert out.shape == (2, 8, 8, 3)
+    # channel k of the output must depend only on channel k of the input
+    x2 = x.at[..., 1].set(0.0)
+    out2, _ = m.apply(params, state, x2)
+    np.testing.assert_allclose(out[..., 0], out2[..., 0], atol=1e-6)
+    np.testing.assert_allclose(out[..., 2], out2[..., 2], atol=1e-6)
+    assert not np.allclose(out[..., 1], out2[..., 1])
+
+
+def test_spatial_convolution_map_explicit_planes():
+    # a sparse random table may never mention the highest input map;
+    # explicit plane counts must win over table inference
+    table = np.array([[0, 0], [1, 1]], np.int32)
+    m = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1,
+                                 n_input_plane=5, n_output_plane=4)
+    params, state = m.init(jax.random.key(0))
+    x = jnp.zeros((1, 6, 6, 5), jnp.float32)
+    out, _ = m.apply(params, state, x)
+    assert out.shape == (1, 6, 6, 4)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        nn.SpatialConvolutionMap(table, 3, 3, n_input_plane=1)
+
+
+def test_spatial_convolution_map_full_equals_dense():
+    table = nn.SpatialConvolutionMap.full(2, 4)
+    m = nn.SpatialConvolutionMap(table, 3, 3)
+    dense = nn.SpatialConvolution(2, 4, 3, 3)
+    params, state = m.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(2).standard_normal(
+        (1, 6, 6, 2)), jnp.float32)
+    out_m, _ = m.apply(params, state, x)
+    out_d, _ = dense.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _encode_tree():
+    """( (the cat) (sat) ) — 3 leaves, 2 internal nodes, topo order."""
+    # slots: 0=leaf0, 1=leaf1, 2=internal(0,1), 3=leaf2, 4=internal(2,3)
+    children = np.array([[-1, -1], [-1, -1], [0, 1], [-1, -1], [2, 3]],
+                        np.int32)
+    leaf_ids = np.array([0, 1, -1, 2, -1], np.int32)
+    return children, leaf_ids
+
+
+def test_binary_tree_lstm_shapes_and_validity():
+    m = nn.BinaryTreeLSTM(input_size=8, hidden_size=6)
+    params, state = m.init(jax.random.key(0))
+    children, leaf_ids = _encode_tree()
+    rng = np.random.default_rng(3)
+    inputs = jnp.asarray(rng.standard_normal((2, 3, 8)), jnp.float32)
+    batch = (inputs,
+             jnp.asarray(np.stack([children, children])),
+             jnp.asarray(np.stack([leaf_ids, leaf_ids])))
+    out, _ = jax.jit(lambda p, s, b: m.apply(p, s, b))(params, state, batch)
+    assert out.shape == (2, 5, 6)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # root state must differ between the two (different-input) examples
+    assert not np.allclose(out[0, 4], out[1, 4])
+
+
+def test_binary_tree_lstm_padding_is_zero():
+    m = nn.BinaryTreeLSTM(input_size=4, hidden_size=3)
+    params, state = m.init(jax.random.key(1))
+    # tree with 2 leaves + 1 internal, padded to 5 slots
+    children = np.array([[-1, -1], [-1, -1], [0, 1], [-1, -1], [-1, -1]],
+                        np.int32)
+    leaf_ids = np.array([0, 1, -1, -1, -1], np.int32)
+    inputs = jnp.ones((1, 2, 4), jnp.float32)
+    out, _ = m.apply(params, state,
+                     (inputs, jnp.asarray(children[None]),
+                      jnp.asarray(leaf_ids[None])))
+    np.testing.assert_array_equal(np.asarray(out[0, 3]), 0)
+    np.testing.assert_array_equal(np.asarray(out[0, 4]), 0)
+    assert not np.allclose(np.asarray(out[0, 2]), 0)
+
+
+def test_binary_tree_lstm_gradients_flow():
+    m = nn.BinaryTreeLSTM(input_size=4, hidden_size=3)
+    params, state = m.init(jax.random.key(2))
+    children, leaf_ids = _encode_tree()
+    inputs = jnp.asarray(np.random.default_rng(4).standard_normal((1, 3, 4)),
+                         jnp.float32)
+    batch = (inputs, jnp.asarray(children[None]),
+             jnp.asarray(leaf_ids[None]))
+
+    def loss(p):
+        out, _ = m.apply(p, state, batch)
+        return jnp.sum(out[0, 4] ** 2)
+
+    grads = jax.grad(loss)(params)
+    for name in ("leaf_c", "comp_w", "comp_b"):
+        assert np.any(np.asarray(grads[name]) != 0), name
