@@ -159,10 +159,14 @@ class LabeledSentenceToSample(Transformer):
 
     def __init__(self, vocab_length: Optional[int] = None,
                  fixed_data_length: Optional[int] = None,
-                 fixed_label_length: Optional[int] = None):
+                 fixed_label_length: Optional[int] = None,
+                 label_pad_value: float = -1.0):
         self.vocab_length = vocab_length
         self.fixed_data_length = fixed_data_length
         self.fixed_label_length = fixed_label_length
+        # -1 = the criterion-side ignore index (ClassNLLCriterion masks
+        # negative labels); 0 would be a real class under 0-based labels
+        self.label_pad_value = label_pad_value
 
     def _pad(self, arr: np.ndarray, length: Optional[int], pad_value):
         if length is None or len(arr) == length:
@@ -184,7 +188,9 @@ class LabeledSentenceToSample(Transformer):
                 data = self._pad(ls.data.astype(np.int32),
                                  self.fixed_data_length, 0)
             # labels stay 0-based indices (see ClassNLLCriterion docstring —
-            # the reference used 1-based Torch labels)
+            # the reference used 1-based Torch labels, where pad 0 was
+            # naturally out of range; here padding is -1, which the
+            # criterion ignores)
             label = self._pad(ls.label.astype(np.float32),
-                              self.fixed_label_length, 0.0)
+                              self.fixed_label_length, self.label_pad_value)
             yield Sample(data, label)

@@ -1,0 +1,16 @@
+"""Model interop: Caffe, TensorFlow, Torch7, and the native format.
+
+Reference: BigDL's `Module.load/loadTorch/loadCaffe/loadTF` entry points
+(nn/Module.scala:41-73) over utils/caffe/, utils/tf/, utils/TorchFile.scala.
+The native format here is the pickle-based save/load in utils/file_io.py
+(the reference's was JVM serialization, utils/File.scala)."""
+
+from .caffe import CaffeLoader, CaffePersister, load_caffe, save_caffe
+from .tensorflow import TensorflowLoader, TensorflowSaver, load_tf, save_tf
+from .torchfile import (load_t7, save_t7, T7Reader, T7Writer,
+                        load_torch_module, save_torch_module)
+
+__all__ = ["CaffeLoader", "CaffePersister", "load_caffe", "save_caffe",
+           "TensorflowLoader", "TensorflowSaver", "load_tf", "save_tf",
+           "load_t7", "save_t7", "T7Reader", "T7Writer",
+           "load_torch_module", "save_torch_module"]

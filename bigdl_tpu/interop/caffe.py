@@ -1,0 +1,383 @@
+"""Caffe model interop: load .caffemodel files into bigdl_tpu modules and
+persist modules back out.
+
+Reference: utils/caffe/CaffeLoader.scala:56 (loadBinary :93, copyParameters
+:239, layer mapping in Converter/LayerConverter/V1LayerConverter.scala) and
+utils/caffe/CaffePersister.scala, all driven by the protoc-generated
+caffe/Caffe.java.  Rebuild: the generic wire codec (utils/pbwire.py) plus
+the public caffe.proto field numbers below; layers map to TPU-native nn
+modules and weights are transposed into our NHWC/HWIO layouts.
+
+caffe.proto field numbers used (public schema):
+    NetParameter: name=1, input=3, layers(V1)=2, layer(V2)=100
+    LayerParameter: name=1, type=2 (string), bottom=3, top=4, blobs=7,
+        pooling_param=103, convolution_param=106, dropout_param=108,
+        inner_product_param=117, lrn_param=118
+    V1LayerParameter: bottom=2, top=3, name=4, type=5 (enum), blobs=6,
+        pooling_param=19, convolution_param=12, dropout_param=23? (unused),
+        inner_product_param=17, lrn_param=18
+    BlobProto: shape=7 (BlobShape.dim=1), data=5 (packed float),
+        num=1 channels=2 height=3 width=4 (legacy 4-D)
+    ConvolutionParameter: num_output=1 bias_term=2 pad=3 kernel_size=4
+        group=5 stride=6 pad_h=9 pad_w=10 kernel_h=11 kernel_w=12
+        stride_h=13 stride_w=14 dilation=18
+    PoolingParameter: pool=1 (0 MAX, 1 AVE) kernel_size=2 stride=3 pad=4
+        kernel_h=5 kernel_w=6 stride_h=7 stride_w=8 pad_h=9 pad_w=10
+        global_pooling=12
+    InnerProductParameter: num_output=1 bias_term=2
+    LRNParameter: local_size=1 alpha=2 beta=3 norm_region=4 k=5
+    DropoutParameter: dropout_ratio=1
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import pbwire
+from ..utils.pbwire import Fields
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CaffeLoader", "CaffePersister", "load_caffe", "save_caffe"]
+
+# V1LayerParameter.LayerType enum -> V2 string type (public caffe.proto)
+_V1_TYPES = {
+    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout",
+    14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU",
+    20: "Softmax", 21: "SoftmaxWithLoss", 22: "Split", 23: "TanH",
+    19: "Sigmoid", 8: "Flatten", 33: "Slice", 25: "Eltwise",
+}
+
+
+class _Layer:
+    """Parsed layer description, schema-neutral between V1 and V2."""
+
+    def __init__(self, name: str, type_: str, bottoms: List[str],
+                 tops: List[str], blobs: List[np.ndarray],
+                 blob_shapes: List[Tuple[int, ...]], params: Dict[int, Fields]):
+        self.name = name
+        self.type = type_
+        self.bottoms = bottoms
+        self.tops = tops
+        self.blobs = blobs
+        self.blob_shapes = blob_shapes
+        self.params = params
+
+
+def _parse_blob(f: Fields) -> Tuple[np.ndarray, Tuple[int, ...]]:
+    data = np.array(f.floats(5), dtype=np.float32)
+    if f.has(7):
+        shape = tuple(f.sub(7).ints(1))
+    else:  # legacy num/channels/height/width
+        shape = tuple(f.int(i, 1) for i in (1, 2, 3, 4))
+        while len(shape) > 1 and shape[0] == 1:
+            shape = shape[1:]
+    if data.size and int(np.prod(shape)) == data.size:
+        data = data.reshape(shape)
+    return data, shape
+
+
+def _parse_layers(buf: bytes) -> Tuple[str, List[_Layer]]:
+    net = Fields(buf)
+    layers: List[_Layer] = []
+    for lf in net.subs(100):  # V2
+        blobs = [_parse_blob(b) for b in lf.subs(7)]
+        layers.append(_Layer(
+            lf.str(1), lf.str(2), lf.strs(3), lf.strs(4),
+            [b for b, _ in blobs], [s for _, s in blobs],
+            {n: lf.sub(n) for n in (103, 106, 108, 117, 118) if lf.has(n)}))
+    for lf in net.subs(2):  # V1
+        blobs = [_parse_blob(b) for b in lf.subs(6)]
+        layers.append(_Layer(
+            lf.str(4), _V1_TYPES.get(lf.int(5), f"V1_{lf.int(5)}"),
+            lf.strs(2), lf.strs(3),
+            [b for b, _ in blobs], [s for _, s in blobs],
+            {103: lf.sub(19), 106: lf.sub(12), 117: lf.sub(17),
+             118: lf.sub(18)}))
+    return net.str(1), layers
+
+
+def _conv_args(p: Fields):
+    kh = p.int(11) or (p.ints(4)[0] if p.ints(4) else 1)
+    kw = p.int(12) or (p.ints(4)[-1] if p.ints(4) else 1)
+    sh = p.int(13) or (p.ints(6)[0] if p.ints(6) else 1)
+    sw = p.int(14) or (p.ints(6)[-1] if p.ints(6) else 1)
+    ph = p.int(9) or (p.ints(3)[0] if p.ints(3) else 0)
+    pw = p.int(10) or (p.ints(3)[-1] if p.ints(3) else 0)
+    return kh, kw, sh, sw, ph, pw, p.int(1), p.int(5, 1), p.int(2, 1)
+
+
+class CaffeLoader:
+    """Build a bigdl_tpu Graph from a binary .caffemodel
+    (reference: CaffeLoader.loadBinary + Converter.toBigDL)."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self.net_name, self.layers = _parse_layers(f.read())
+
+    def build(self):
+        """Returns (module, params_tree): a Graph wired by bottom/top names
+        with weights copied in (conv blobs OIHW -> HWIO, NCHW -> NHWC)."""
+        from .. import nn
+        from ..nn.graph import Graph, Input
+
+        tensors: Dict[str, object] = {}
+        inputs = []
+        params: Dict[str, Dict] = {}
+        modules: Dict[str, object] = {}
+        ordered: List[str] = []
+
+        def get_bottom(name):
+            if name not in tensors:
+                node = Input()
+                tensors[name] = node
+                inputs.append(node)
+            return tensors[name]
+
+        for ly in self.layers:
+            t = ly.type
+            mod = None
+            p: Optional[Dict] = None
+            if t in ("Data", "Input", "Split"):
+                continue
+            elif t == "Convolution":
+                kh, kw, sh, sw, ph, pw, n_out, group, bias = _conv_args(
+                    ly.params.get(106, Fields(b"")))
+                w = ly.blobs[0]  # (out, in/g, kh, kw)
+                n_in = w.shape[1] * group
+                mod = nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh,
+                                            pw, ph, group,
+                                            with_bias=bool(bias))
+                p = {"weight": np.transpose(w, (2, 3, 1, 0))}
+                if bias and len(ly.blobs) > 1:
+                    p["bias"] = ly.blobs[1].reshape(-1)
+            elif t == "InnerProduct":
+                ip = ly.params.get(117, Fields(b""))
+                w = ly.blobs[0]
+                w = w.reshape(ip.int(1), -1)
+                mod = nn.Linear(w.shape[1], w.shape[0],
+                                with_bias=bool(ip.int(2, 1)))
+                p = {"weight": w}
+                if ip.int(2, 1) and len(ly.blobs) > 1:
+                    p["bias"] = ly.blobs[1].reshape(-1)
+            elif t == "Pooling":
+                pp = ly.params.get(103, Fields(b""))
+                kh = pp.int(5) or pp.int(2, 1)
+                kw = pp.int(6) or pp.int(2, 1)
+                sh = pp.int(7) or pp.int(3, 1)
+                sw = pp.int(8) or pp.int(3, 1)
+                ph = pp.int(9) or pp.int(4, 0)
+                pw = pp.int(10) or pp.int(4, 0)
+                if pp.int(1, 0) == 0:
+                    mod = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph).ceil()
+                else:
+                    mod = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
+                                                   ceil_mode=True)
+            elif t == "ReLU":
+                mod = nn.ReLU()
+            elif t == "TanH":
+                mod = nn.Tanh()
+            elif t == "Sigmoid":
+                mod = nn.Sigmoid()
+            elif t in ("Softmax", "SoftmaxWithLoss"):
+                mod = nn.SoftMax()
+            elif t == "Dropout":
+                ratio = ly.params.get(108, Fields(b"")).float(1, 0.5)
+                mod = nn.Dropout(ratio)
+            elif t == "LRN":
+                lp = ly.params.get(118, Fields(b""))
+                mod = nn.SpatialCrossMapLRN(lp.int(1, 5), lp.float(2, 1.0),
+                                            lp.float(3, 0.75),
+                                            lp.float(5, 1.0))
+            elif t == "Flatten":
+                mod = nn.InferReshape((0, -1))
+            elif t == "Concat":
+                mod = nn.JoinTable(-1)
+            elif t == "Eltwise":
+                mod = nn.CAddTable()
+            else:
+                logger.warning("caffe layer type %s (%s) unsupported; "
+                               "treating as identity", t, ly.name)
+                mod = nn.Identity()
+
+            bottoms = [get_bottom(b) for b in ly.bottoms]
+            if len(bottoms) == 1:
+                node = mod(bottoms[0])
+            else:
+                node = mod(bottoms)
+            for top in ly.tops:
+                tensors[top] = node
+            modules[ly.name] = mod
+            ordered.append(ly.name)
+            if p is not None:
+                params[ly.name] = p
+
+        # output = top of the last layer
+        last_top = tensors[self.layers[-1].tops[0]] if self.layers else None
+        graph = Graph(inputs if len(inputs) > 1 else inputs[0], last_top)
+        import jax
+        init_params, state = graph.init(jax.random.key(0))
+        # graph params are keyed positionally; map by module identity
+        init_params = self._copy_params(graph, init_params, modules, params)
+        graph.attach(init_params, state)
+        return graph, init_params
+
+    @staticmethod
+    def _copy_params(graph, init_params, modules, params):
+        """Overwrite initialized leaves with loaded blobs
+        (reference: CaffeLoader.copyParameters — match by name, fail loud
+        unless the user opts out)."""
+        name_by_module = {id(m): n for n, m in modules.items()}
+        for i, m in enumerate(graph.modules):
+            lname = name_by_module.get(id(m))
+            if lname and lname in params:
+                loaded = params[lname]
+                tgt = init_params[i]
+                for k, v in loaded.items():
+                    want = np.asarray(tgt[k]).shape
+                    if v.shape != want:
+                        raise ValueError(
+                            f"caffe layer {lname} param {k}: shape "
+                            f"{v.shape} vs model {want}")
+                    tgt[k] = v.astype(np.asarray(tgt[k]).dtype)
+        return init_params
+
+
+def load_caffe(path: str):
+    """(reference: Module.loadCaffe, nn/Module.scala:50)."""
+    return CaffeLoader(path).build()
+
+
+class CaffePersister:
+    """Write a Sequential/Graph of supported layers back to a binary
+    NetParameter (reference: utils/caffe/CaffePersister.scala)."""
+
+    @staticmethod
+    def _blob(arr: np.ndarray) -> bytes:
+        shape_msg = b"".join(pbwire.field_varint(1, int(d))
+                             for d in arr.shape)
+        return (pbwire.field_bytes(7, shape_msg) +
+                pbwire.field_packed_floats(5, arr.ravel()))
+
+    @classmethod
+    def save(cls, model, params, path: str, net_name: str = "bigdl_tpu"):
+        from .. import nn
+
+        chunks = [pbwire.field_string(1, net_name)]
+        flat = cls._flatten(model, params)
+        prev_top = "data"
+        for i, (mod, p) in enumerate(flat):
+            name = f"{type(mod).__name__.lower()}_{i}"
+            body = pbwire.field_string(1, name)
+            bottoms = [prev_top]
+            top = name
+            blobs = []
+            if isinstance(mod, nn.SpatialConvolution):
+                type_s = "Convolution"
+                w = np.transpose(np.asarray(p["weight"], np.float32),
+                                 (3, 2, 0, 1))
+                blobs.append(w)
+                if "bias" in p:
+                    blobs.append(np.asarray(p["bias"], np.float32))
+                kh, kw = mod.kernel
+                sh, sw = mod.stride
+                ph, pw = mod.pad
+                if ph == -1 or pw == -1:
+                    # SAME sentinel: caffe has only explicit pads; exact
+                    # only for stride-1 odd kernels
+                    if (sh, sw) == (1, 1) and kh % 2 == 1 and kw % 2 == 1:
+                        ph, pw = kh // 2, kw // 2
+                    else:
+                        raise ValueError(
+                            "CaffePersister: SAME padding (pad=-1) with "
+                            f"stride {mod.stride} kernel {mod.kernel} has "
+                            "no exact caffe equivalent")
+                conv = (pbwire.field_varint(1, mod.n_output_plane) +
+                        pbwire.field_varint(2, int("bias" in p)) +
+                        pbwire.field_varint(5, mod.n_group) +
+                        pbwire.field_varint(9, ph) +
+                        pbwire.field_varint(10, pw) +
+                        pbwire.field_varint(11, kh) +
+                        pbwire.field_varint(12, kw) +
+                        pbwire.field_varint(13, sh) +
+                        pbwire.field_varint(14, sw))
+                body += pbwire.field_bytes(106, conv)
+            elif isinstance(mod, nn.Linear):
+                type_s = "InnerProduct"
+                blobs.append(np.asarray(p["weight"], np.float32))
+                if "bias" in p:
+                    blobs.append(np.asarray(p["bias"], np.float32))
+                body += pbwire.field_bytes(
+                    117, pbwire.field_varint(1, mod.output_size) +
+                    pbwire.field_varint(2, int("bias" in p)))
+            elif isinstance(mod, nn.SpatialMaxPooling) or \
+                    isinstance(mod, nn.SpatialAveragePooling):
+                type_s = "Pooling"
+                is_max = isinstance(mod, nn.SpatialMaxPooling)
+                kh, kw = mod.kernel
+                sh, sw = mod.stride
+                ph, pw = mod.pad
+                pool = (pbwire.field_varint(1, 0 if is_max else 1) +
+                        pbwire.field_varint(5, kh) +
+                        pbwire.field_varint(6, kw) +
+                        pbwire.field_varint(7, sh) +
+                        pbwire.field_varint(8, sw) +
+                        pbwire.field_varint(9, ph) +
+                        pbwire.field_varint(10, pw))
+                body += pbwire.field_bytes(103, pool)
+            elif isinstance(mod, nn.ReLU):
+                type_s = "ReLU"
+            elif isinstance(mod, nn.Tanh):
+                type_s = "TanH"
+            elif isinstance(mod, nn.Sigmoid):
+                type_s = "Sigmoid"
+            elif isinstance(mod, (nn.SoftMax, nn.LogSoftMax)):
+                type_s = "Softmax"
+            elif isinstance(mod, nn.Dropout):
+                type_s = "Dropout"
+                body += pbwire.field_bytes(
+                    108, pbwire.field_float(1, mod.p))
+            elif isinstance(mod, nn.SpatialCrossMapLRN):
+                type_s = "LRN"
+                lrn = (pbwire.field_varint(1, mod.size) +
+                       pbwire.field_float(2, mod.alpha) +
+                       pbwire.field_float(3, mod.beta) +
+                       pbwire.field_float(5, mod.k))
+                body += pbwire.field_bytes(118, lrn)
+            elif isinstance(mod, (nn.Reshape, nn.InferReshape, nn.View)):
+                type_s = "Flatten"
+            else:
+                raise ValueError(
+                    f"CaffePersister: unsupported layer {type(mod).__name__}"
+                    " (reference also persisted a fixed layer set)")
+            body += pbwire.field_string(2, type_s)
+            for b in bottoms:
+                body += pbwire.field_string(3, b)
+            body += pbwire.field_string(4, top)
+            for b in blobs:
+                body += pbwire.field_bytes(7, cls._blob(b))
+            chunks.append(pbwire.field_bytes(100, body))
+            prev_top = top
+        with open(path, "wb") as f:
+            f.write(b"".join(chunks))
+        return path
+
+    @staticmethod
+    def _flatten(model, params):
+        from ..nn.containers import Sequential
+        from ..nn.graph import Graph
+
+        if isinstance(model, (Sequential, Graph)):
+            mods = model.modules
+            from ..nn.graph import _InputModule
+            return [(m, params[i]) for i, m in enumerate(mods)
+                    if not isinstance(m, _InputModule)]
+        return [(model, params)]
+
+
+def save_caffe(model, params, path: str):
+    """(reference: Module.saveCaffe via CaffePersister)."""
+    return CaffePersister.save(model, params, path)

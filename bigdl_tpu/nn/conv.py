@@ -76,11 +76,16 @@ class SpatialConvolution(Module):
     def _conv(self, x, w, lhs_dilation=None, rhs_dilation=None, padding=None):
         c = get_policy().compute_dtype
         pad_h, pad_w = self.pad
+        if padding is None:
+            # pad=-1 means SAME, as in the reference (SpatialConvolution
+            # doc: "If padW/padH are -1, they will be computed such that
+            # output has the same size as input")
+            padding = ("SAME" if pad_h == -1 or pad_w == -1
+                       else [(pad_h, pad_h), (pad_w, pad_w)])
         y = lax.conv_general_dilated(
             x.astype(c), w.astype(c),
             window_strides=self.stride,
-            padding=padding if padding is not None
-                    else [(pad_h, pad_h), (pad_w, pad_w)],
+            padding=padding,
             lhs_dilation=lhs_dilation,
             rhs_dilation=rhs_dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),

@@ -87,8 +87,11 @@ class ClassNLLCriterion(Criterion):
     Optional per-class `weights`; mean is weight-normalized like the reference.
 
     Labels are 0-based by default (idiomatic JAX); pass ``one_based=True`` for
-    BigDL/Torch-style 1-based labels.  An out-of-range label yields NaN loss
-    (JAX gathers fill out-of-bounds with NaN) — the reference instead threw
+    BigDL/Torch-style 1-based labels.  Negative labels are treated as padding
+    and excluded from the loss (the standard ignore-index; the reference's
+    1-based labels made 0 the natural pad sentinel — 0-based labels need an
+    explicit one).  An out-of-range-high label yields NaN loss (JAX gathers
+    fill out-of-bounds with NaN) — the reference instead threw
     `curTarget >= 1 && curTarget <= nClasses`; watch the logged loss."""
 
     def __init__(self, weights=None, size_average: bool = True,
@@ -102,12 +105,18 @@ class ClassNLLCriterion(Criterion):
         t = target.astype(jnp.int32).reshape(-1)
         if self.one_based:
             t = t - 1
-        picked = jnp.take_along_axis(output, t[:, None], axis=1)[:, 0]
+        valid = t >= 0
+        picked = jnp.take_along_axis(output, jnp.maximum(t, 0)[:, None],
+                                     axis=1)[:, 0]
         if self.weights is not None:
-            w = jnp.take(self.weights, t)
+            w = jnp.take(self.weights, jnp.maximum(t, 0)) * valid
             total = -jnp.sum(w * picked)
-            return total / jnp.sum(w) if self.size_average else total
-        return _reduce(-picked, self.size_average)
+            return (total / jnp.maximum(jnp.sum(w), 1e-12)
+                    if self.size_average else total)
+        masked = jnp.where(valid, -picked, 0.0)
+        if self.size_average:
+            return jnp.sum(masked) / jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(masked)
 
 
 class CrossEntropyCriterion(Criterion):

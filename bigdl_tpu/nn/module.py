@@ -229,6 +229,54 @@ class Module:
         """name -> params dict (BigDL: getParametersTable, used by summaries)."""
         return {self.name: self.params}
 
+    # -- native-format persistence ------------------------------------
+    # (reference: Module.save/Module.load, nn/Module.scala:41 over JVM
+    # serialization in utils/File.scala; here: pickle of the module with
+    # weights detached — the same strip trick ModelBroadcast uses,
+    # models/utils/ModelBroadcast.scala:66)
+
+    def save(self, path: str, overwrite: bool = True):
+        import numpy as _np
+
+        from ..utils import file_io
+        to_np = lambda t: jax.tree.map(_np.asarray, t) if t is not None \
+            else None
+        detached = (self.params, self.state, self.grads, self.output,
+                    self.grad_input)
+        self.params = self.state = self.grads = None
+        self.output = self.grad_input = None
+        try:
+            blob = {"format": "bigdl_tpu-module-v1", "module": self,
+                    "params": to_np(detached[0]), "state": to_np(detached[1])}
+            file_io.save(blob, path, overwrite=overwrite)
+        finally:
+            (self.params, self.state, self.grads, self.output,
+             self.grad_input) = detached
+        return self
+
+    @staticmethod
+    def load(path: str) -> "Module":
+        from ..utils import file_io
+        blob = file_io.load(path)
+        if not (isinstance(blob, dict) and
+                blob.get("format") == "bigdl_tpu-module-v1"):
+            raise ValueError(f"{path!r} is not a bigdl_tpu module file")
+        m = blob["module"]
+        m.attach(blob["params"], blob["state"])
+        return m
+
+    def attach(self, params, state=None):
+        """Install externally-produced params (checkpoint/interop load) into
+        the stateful facade, keeping grads consistent with build()."""
+        self.params = params
+        if state is not None:
+            self.state = state
+        elif self.state is None:
+            _, self.state = self.init(jax.random.key(0))
+        self.grads = (_tree_zeros_like(params)
+                      if params is not None else None)
+        return self
+
     # -- modes ---------------------------------------------------------
 
     def training(self):

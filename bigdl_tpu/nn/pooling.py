@@ -24,7 +24,12 @@ __all__ = ["SpatialMaxPooling", "SpatialAveragePooling", "VolumetricMaxPooling",
 
 def _pool_pads(size, kernel, stride, pad, ceil_mode):
     """Per-dim (lo, hi) padding; hi is extended so the window count matches
-    Torch's ceil/floor formula (SpatialMaxPooling.scala out-size logic)."""
+    Torch's ceil/floor formula (SpatialMaxPooling.scala out-size logic).
+    pad=-1 means TF-style SAME (mirrors SpatialConvolution's convention)."""
+    if pad == -1:
+        out = -(-size // stride)  # ceil(size / stride)
+        total = max((out - 1) * stride + kernel - size, 0)
+        return out, (total // 2, total - total // 2)
     if ceil_mode:
         out = int(np.ceil((size + 2 * pad - kernel) / stride)) + 1
         # Torch: last window must start inside the (padded) input

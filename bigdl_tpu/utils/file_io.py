@@ -27,7 +27,10 @@ __all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File"]
 
 
 def _to_numpy(tree):
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    # only coerce device arrays — other leaves (strings, modules, None)
+    # must survive pickling untouched
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
 
 
 def save(obj: Any, path: str, overwrite: bool = True) -> None:

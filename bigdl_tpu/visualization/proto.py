@@ -1,11 +1,8 @@
-"""Minimal protobuf wire-format codec + the TensorBoard Event/Summary
-messages, hand-encoded.
+"""TensorBoard Event/Summary messages, hand-encoded over the generic
+protobuf wire codec (utils/pbwire.py).
 
-Reference: BigDL ships protoc-generated Java for the TensorFlow `Summary`/
-`Event` protos and builds messages in visualization/Summary.scala:95-172.
-Rebuild: TensorBoard only needs a handful of fields, so we encode the wire
-format directly (varint/fixed64/length-delimited) with no protobuf runtime —
-the same no-dependency spirit as the vendored netty/Crc32c.java.
+Reference: BigDL ships protoc-generated Java for these protos and builds
+messages in visualization/Summary.scala:95-172.
 
 Field numbers (public tensorflow/core/util/event.proto and
 tensorflow/core/framework/summary.proto):
@@ -19,61 +16,22 @@ tensorflow/core/framework/summary.proto):
 
 from __future__ import annotations
 
-import struct
-from typing import Dict, Iterator, List, Sequence, Tuple
+from typing import Dict, List
 
 import numpy as np
+
+from ..utils.pbwire import (Fields, decode_varint, encode_varint,
+                            field_bytes, field_double, field_float,
+                            field_packed_doubles, field_string, field_varint)
 
 __all__ = ["encode_varint", "decode_varint", "scalar_summary",
            "histogram_summary", "event_bytes", "parse_event"]
 
 
-# ---------------------------------------------------------------- encoding
-
-def encode_varint(value: int) -> bytes:
-    out = bytearray()
-    value &= (1 << 64) - 1  # two's-complement for negative int64
-    while True:
-        b = value & 0x7F
-        value >>= 7
-        if value:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _tag(field: int, wire: int) -> bytes:
-    return encode_varint((field << 3) | wire)
-
-
-def _field_varint(field: int, value: int) -> bytes:
-    return _tag(field, 0) + encode_varint(value)
-
-
-def _field_double(field: int, value: float) -> bytes:
-    return _tag(field, 1) + struct.pack("<d", value)
-
-
-def _field_float(field: int, value: float) -> bytes:
-    return _tag(field, 5) + struct.pack("<f", value)
-
-
-def _field_bytes(field: int, value: bytes) -> bytes:
-    return _tag(field, 2) + encode_varint(len(value)) + value
-
-
-def _field_packed_doubles(field: int, values: Sequence[float]) -> bytes:
-    payload = struct.pack(f"<{len(values)}d", *values)
-    return _field_bytes(field, payload)
-
-
-# ---------------------------------------------------- summaries and events
-
 def scalar_summary(tag: str, value: float) -> bytes:
     """Summary{value {tag, simple_value}} (Summary.scala:95-104)."""
-    v = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
-    return _field_bytes(1, v)
+    v = field_string(1, tag) + field_float(2, float(value))
+    return field_bytes(1, v)
 
 
 # TensorBoard's standard exponential bucket boundaries: +/- 1e-12 * 1.1^k
@@ -99,106 +57,47 @@ def histogram_summary(tag: str, values: np.ndarray) -> bytes:
         x = np.zeros(1)
     counts, _ = np.histogram(x, bins=_EDGES)
     last = int(np.nonzero(counts)[0].max()) if counts.any() else 0
-    histo = (_field_double(1, float(x.min())) +
-             _field_double(2, float(x.max())) +
-             _field_double(3, float(x.size)) +
-             _field_double(4, float(x.sum())) +
-             _field_double(5, float(np.square(x).sum())) +
-             _field_packed_doubles(6, _BUCKETS[:last + 1]) +
-             _field_packed_doubles(7, counts[:last + 1].tolist()))
-    v = _field_bytes(1, tag.encode()) + _field_bytes(5, histo)
-    return _field_bytes(1, v)
+    histo = (field_double(1, float(x.min())) +
+             field_double(2, float(x.max())) +
+             field_double(3, float(x.size)) +
+             field_double(4, float(x.sum())) +
+             field_double(5, float(np.square(x).sum())) +
+             field_packed_doubles(6, _BUCKETS[:last + 1]) +
+             field_packed_doubles(7, counts[:last + 1].tolist()))
+    v = field_string(1, tag) + field_bytes(5, histo)
+    return field_bytes(1, v)
 
 
 def event_bytes(wall_time: float, step: int = 0,
                 summary: bytes | None = None,
                 file_version: str | None = None) -> bytes:
-    out = _field_double(1, wall_time)
+    out = field_double(1, wall_time)
     if step:
-        out += _field_varint(2, step)
+        out += field_varint(2, step)
     if file_version is not None:
-        out += _field_bytes(3, file_version.encode())
+        out += field_string(3, file_version)
     if summary is not None:
-        out += _field_bytes(5, summary)
+        out += field_bytes(5, summary)
     return out
-
-
-# ---------------------------------------------------------------- decoding
-
-def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-
-
-def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
-    pos = 0
-    while pos < len(buf):
-        key, pos = decode_varint(buf, pos)
-        field, wire = key >> 3, key & 7
-        if wire == 0:
-            val, pos = decode_varint(buf, pos)
-        elif wire == 1:
-            val = struct.unpack_from("<d", buf, pos)[0]
-            pos += 8
-        elif wire == 2:
-            n, pos = decode_varint(buf, pos)
-            val = buf[pos:pos + n]
-            pos += n
-        elif wire == 5:
-            val = struct.unpack_from("<f", buf, pos)[0]
-            pos += 4
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
-        yield field, wire, val
 
 
 def parse_event(buf: bytes) -> Dict:
     """Decode an Event record into {wall_time, step, file_version,
     values: [{tag, simple_value | histo}]} — the read-back path used by
     FileReader (reference: visualization/tensorboard/FileReader.scala)."""
-    ev = {"wall_time": 0.0, "step": 0, "file_version": None, "values": []}
-    for field, _wire, val in _iter_fields(buf):
-        if field == 1:
-            ev["wall_time"] = val
-        elif field == 2:
-            ev["step"] = val
-        elif field == 3:
-            ev["file_version"] = bytes(val).decode()
-        elif field == 5:
-            for f2, _w2, v2 in _iter_fields(bytes(val)):
-                if f2 != 1:
-                    continue
-                value = {"tag": None, "simple_value": None, "histo": None}
-                for f3, _w3, v3 in _iter_fields(bytes(v2)):
-                    if f3 == 1:
-                        value["tag"] = bytes(v3).decode()
-                    elif f3 == 2:
-                        value["simple_value"] = v3
-                    elif f3 == 5:
-                        value["histo"] = _parse_histo(bytes(v3))
-                ev["values"].append(value)
+    f = Fields(buf)
+    ev = {"wall_time": f.float(1), "step": f.int(2),
+          "file_version": f.str(3) or None, "values": []}
+    if f.has(5):
+        for v in f.sub(5).subs(1):
+            value = {"tag": v.str(1) or None,
+                     "simple_value": v.float(2) if v.has(2) else None,
+                     "histo": _parse_histo(v.sub(5)) if v.has(5) else None}
+            ev["values"].append(value)
     return ev
 
 
-def _parse_histo(buf: bytes) -> Dict:
-    h = {"min": 0.0, "max": 0.0, "num": 0.0, "sum": 0.0, "sum_squares": 0.0,
-         "bucket_limit": [], "bucket": []}
-    names = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
-    for field, wire, val in _iter_fields(buf):
-        if field in names:
-            h[names[field]] = val
-        elif field in (6, 7):
-            key = "bucket_limit" if field == 6 else "bucket"
-            if wire == 2:  # packed
-                n = len(val) // 8
-                h[key] = list(struct.unpack(f"<{n}d", val))
-            else:
-                h[key].append(val)
-    return h
+def _parse_histo(f: Fields) -> Dict:
+    return {"min": f.float(1), "max": f.float(2), "num": f.float(3),
+            "sum": f.float(4), "sum_squares": f.float(5),
+            "bucket_limit": f.doubles(6), "bucket": f.doubles(7)}

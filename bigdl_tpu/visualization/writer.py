@@ -61,10 +61,13 @@ class EventWriter:
         self.path = os.path.join(log_dir, fname)
         self._file = open(self.path, "wb")
         self._writer = RecordWriter(self._file)
-        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._queue: "queue.Queue[bytes]" = queue.Queue()
         self._flush_secs = flush_secs
         self._write_lock = threading.Lock()
         self._closed = False
+        # out-of-band shutdown flag: an in-band queue sentinel could be
+        # consumed by a concurrent flush() and leak the thread
+        self._stop = threading.Event()
         # version record first, as TF does (EventWriter.scala init)
         self._writer.write(proto.event_bytes(
             time.time(), file_version="brain.Event:2"))
@@ -74,33 +77,29 @@ class EventWriter:
     def add_event(self, event: bytes) -> None:
         self._queue.put(event)
 
-    def _drain(self) -> bool:
-        """Write queued events; returns False once the poison pill is seen."""
-        alive = True
+    def _drain(self) -> None:
+        """Write everything currently queued, then flush the file."""
         while True:
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
-                return alive
+                break
             with self._write_lock:
-                if item is None:
-                    alive = False
-                elif not self._closed:
+                if not self._closed:
                     self._writer.write(item)
-
-    def _run(self) -> None:
-        while self._drain():
-            with self._write_lock:
-                self._writer.flush()
-            time.sleep(self._flush_secs)
         with self._write_lock:
             if not self._closed:
                 self._writer.flush()
 
+    def _run(self) -> None:
+        while not self._stop.wait(self._flush_secs):
+            self._drain()
+        self._drain()
+
     def close(self) -> None:
-        self.flush()
-        self._queue.put(None)
+        self._stop.set()
         self._thread.join(timeout=30)
+        self._drain()
         with self._write_lock:
             self._closed = True
             self._file.close()
@@ -108,9 +107,6 @@ class EventWriter:
     def flush(self) -> None:
         # synchronous: drain the queue ourselves under the write lock
         self._drain()
-        with self._write_lock:
-            if not self._closed:
-                self._writer.flush()
 
 
 class FileWriter:

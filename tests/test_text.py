@@ -67,6 +67,18 @@ def test_labeled_sentence_to_sample_onehot_and_padding():
     assert s.label.shape == (5,)
 
 
+def test_label_padding_is_masked_by_criterion():
+    # padded label positions (-1) must not contribute to the loss
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import ClassNLLCriterion
+    logp = jnp.log(jnp.array([[0.7, 0.3], [0.2, 0.8], [0.5, 0.5]]))
+    full = ClassNLLCriterion()(logp, jnp.array([0.0, 1.0, 0.0]))
+    padded = ClassNLLCriterion()(logp, jnp.array([0.0, 1.0, -1.0]))
+    expected = -(np.log(0.7) + np.log(0.8)) / 2
+    np.testing.assert_allclose(float(padded), expected, rtol=1e-6)
+    assert float(full) != float(padded)
+
+
 def test_full_char_rnn_pipeline_composes():
     corpus = ["the cat sat. the dog sat. the cat ran."]
     sentences = [s for doc in SentenceSplitter()(iter(corpus)) for s in doc]
