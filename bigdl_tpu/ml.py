@@ -1,0 +1,128 @@
+"""ML-pipeline estimators: fit/transform adapters over the Optimizer.
+
+Reference: org/apache/spark/ml/DLEstimator.scala:53 and DLClassifier.scala —
+Spark ML `Estimator`s that train a BigDL module from a DataFrame
+(feature/label columns -> MiniBatch RDD -> optimizer fit) and return a
+`DLModel` transformer whose `transform` appends a prediction column.
+
+TPU re-design: there is no Spark; the host data structures are numpy
+arrays / pandas DataFrames, and the API follows the scikit-learn
+fit/predict protocol (the ecosystem's pipeline convention, as Spark ML was
+the reference's).  `DLEstimator.fit(X, y)` -> `DLModel` with
+`.transform(X)` / `.predict(X)`; `DLClassifier` adds argmax + accuracy
+`score`."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from .dataset import DataSet, Sample, SampleToMiniBatch
+from .nn.criterion import Criterion
+from .nn.module import Module
+from .optim.method import OptimMethod
+from .optim.optimizer import Optimizer
+from .optim.trigger import Trigger
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel"]
+
+
+def _as_2d(X) -> np.ndarray:
+    X = np.asarray(X, dtype=np.float32)
+    return X
+
+
+class DLEstimator:
+    """(reference: DLEstimator.scala:53).  Configure like the Optimizer
+    facade, then `fit(X, y) -> DLModel`."""
+
+    def __init__(self, model: Module, criterion: Criterion,
+                 feature_size: Optional[Sequence[int]] = None,
+                 label_size: Optional[Sequence[int]] = None,
+                 batch_size: int = 32, max_epoch: int = 10,
+                 optim_method: Optional[OptimMethod] = None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size) if feature_size else None
+        self.label_size = tuple(label_size) if label_size else None
+        self.batch_size = batch_size
+        self.max_epoch = max_epoch
+        self.optim_method = optim_method
+
+    def fit(self, X, y) -> "DLModel":
+        X = _as_2d(X)
+        y = np.asarray(y, dtype=np.float32)
+        samples = []
+        for i in range(len(X)):
+            f = X[i].reshape(self.feature_size) if self.feature_size else X[i]
+            lbl = (y[i].reshape(self.label_size) if self.label_size
+                   else y[i])
+            samples.append(Sample(f, lbl))
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(self.batch_size, drop_last=False))
+        opt = Optimizer(self.model, ds, self.criterion) \
+            .set_end_when(Trigger.max_epoch(self.max_epoch))
+        if self.optim_method is not None:
+            opt.set_optim_method(self.optim_method)
+        trained = opt.optimize()
+        return self._make_model(trained)
+
+    def _make_model(self, trained: Module) -> "DLModel":
+        return DLModel(trained, self.feature_size,
+                       batch_size=self.batch_size)
+
+
+class DLModel:
+    """Fitted transformer (reference: DLModel/DLTransformerBase)."""
+
+    def __init__(self, model: Module, feature_size=None, batch_size=128):
+        self.model = model
+        self.feature_size = tuple(feature_size) if feature_size else None
+        self.batch_size = batch_size
+        self._fwd = None
+
+    def _forward_batch(self, xb: np.ndarray) -> np.ndarray:
+        if self._fwd is None:
+            m = self.model
+
+            @jax.jit
+            def fwd(params, state, x):
+                out, _ = m.apply(params, state, x, training=False)
+                return out
+
+            self._fwd = fwd
+        return np.asarray(self._fwd(self.model.params, self.model.state,
+                                    np.asarray(xb, np.float32)))
+
+    def transform(self, X) -> np.ndarray:
+        """Returns the raw model outputs row-aligned with X (the reference
+        appends a prediction column to the DataFrame)."""
+        X = _as_2d(X)
+        outs = []
+        for i in range(0, len(X), self.batch_size):
+            xb = X[i:i + self.batch_size]
+            if self.feature_size:
+                xb = xb.reshape((-1,) + self.feature_size)
+            outs.append(self._forward_batch(xb))
+        return np.concatenate(outs, axis=0)
+
+    predict = transform
+
+
+class DLClassifier(DLEstimator):
+    """(reference: DLClassifier.scala — argmax transform)."""
+
+    def _make_model(self, trained: Module) -> "DLClassifierModel":
+        return DLClassifierModel(trained, self.feature_size,
+                                 batch_size=self.batch_size)
+
+
+class DLClassifierModel(DLModel):
+    def predict(self, X) -> np.ndarray:
+        """Class indices (0-based; the reference emitted 1-based ml labels)."""
+        return np.argmax(self.transform(X), axis=-1)
+
+    def score(self, X, y) -> float:
+        return float(np.mean(self.predict(X) == np.asarray(y)))

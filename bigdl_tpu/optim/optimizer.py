@@ -120,6 +120,15 @@ class Optimizer:
         self.metrics = Metrics()
         self._compiled = None
         self._mesh = None
+        # straggler mitigation (reference: Optimizer.setDropModuleProperty,
+        # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
+        self.drop_percentage = 0.0
+        self.max_drop_percentage = 0.0
+        self.threshold_batch_size = 100
+        self.warmup_iterations = 20
+        self._iter_times = []
+        self._drop_threshold = None
+        self._dropped_in_window = 0
 
     # ------------------------------------------------------------------
     # fluent config (reference: optim/Optimizer.scala:98-255)
@@ -172,6 +181,72 @@ class Optimizer:
     def set_strategy(self, strategy: ShardingStrategy):
         self.strategy = strategy
         return self
+
+    def set_drop_module_property(self, drop_percentage: float,
+                                 max_drop_percentage: float,
+                                 batch_size: int = 100,
+                                 warmup_iteration: int = 20):
+        """Straggler mitigation (reference: Optimizer.setDropModuleProperty,
+        optim/Optimizer.scala:255).
+
+        TPU re-design: the reference dropped slow per-core model replicas
+        inside one node; under SPMD there are no replica threads — the
+        straggler source is the host-side input pipeline.  So the unit of
+        dropping is the ITERATION: wall-times of the last `batch_size`
+        iterations feed a kth-largest threshold (k = window *
+        drop_percentage, utils/Util.scala kthLargest), and an iteration
+        whose host data-wait exceeds the threshold is skipped before the
+        device step, bounded by max_drop_percentage of the window."""
+        if not 0 <= drop_percentage <= max_drop_percentage <= 1:
+            raise ValueError("need 0 <= drop <= maxDrop <= 1")
+        if batch_size < 2 or warmup_iteration < 0:
+            raise ValueError("need batch_size >= 2 and warmup >= 0")
+        self.drop_percentage = drop_percentage
+        self.max_drop_percentage = max_drop_percentage
+        self.threshold_batch_size = batch_size
+        self.warmup_iterations = warmup_iteration
+        return self
+
+    def _straggler_check(self, data_wait: float, neval: int) -> bool:
+        """Record this iteration's host data-wait; True -> drop it."""
+        if self.drop_percentage <= 0:
+            return False
+        from ..utils.util import kth_largest
+        window = self._iter_times
+        # threshold comes from the PRIOR window, as the reference recomputes
+        # it from past sub-model timings every computeThresholdbatchSize
+        # iterations (DistriOptimizer.scala:302-330) — including the current
+        # sample would make the window max undroppable by construction
+        if neval > self.warmup_iterations and \
+                len(window) >= max(self.threshold_batch_size // 2, 1):
+            k = max(int(len(window) * self.drop_percentage), 1)
+            self._drop_threshold = kth_largest(window, k)
+        else:
+            self._drop_threshold = None
+        window.append(data_wait)
+        if len(window) > self.threshold_batch_size:
+            del window[:len(window) - self.threshold_batch_size]
+        # drop budget resets once per threshold window, like the reference's
+        # periodic threshold recompute — not on every trim, which would
+        # unbound the budget in steady state
+        self._iters_in_budget_window = \
+            getattr(self, "_iters_in_budget_window", 0) + 1
+        if self._iters_in_budget_window >= self.threshold_batch_size:
+            self._iters_in_budget_window = 0
+            self._dropped_in_window = 0
+        if self._drop_threshold is None:
+            return False
+        if data_wait <= self._drop_threshold:
+            return False
+        if (self._dropped_in_window + 1) / self.threshold_batch_size > \
+                self.max_drop_percentage:
+            return False  # drop budget exhausted; train through it
+        self._dropped_in_window += 1
+        self.metrics.add("dropped iterations", 1.0)
+        logger.info("straggler: dropping iteration %d (data wait %.3fs > "
+                    "threshold %.3fs)", neval, data_wait,
+                    self._drop_threshold)
+        return True
 
     def set_log_interval(self, n: int):
         self.log_interval = n
@@ -304,9 +379,16 @@ class Optimizer:
             self.dataset.shuffle()
             epoch_start = time.perf_counter()
             epoch_records = 0
-            for batch in self.dataset.data(train=True):
-                if self.end_trigger(state):
+            data_iter = iter(self.dataset.data(train=True))
+            while True:
+                data_t0 = time.perf_counter()
+                batch = next(data_iter, None)
+                if batch is None or self.end_trigger(state):
                     break
+                data_wait = time.perf_counter() - data_t0
+                self.metrics.add("get batch time average", data_wait)
+                if self._straggler_check(data_wait, state["neval"]):
+                    continue
                 iter_start = time.perf_counter()
                 lr = float(optim.get_learning_rate(state))
                 inp, tgt = _put_batch(

@@ -1,0 +1,2 @@
+"""Command-line tools (reference: the models/utils CLIs —
+ImageNetSeqFileGenerator, DistriOptimizerPerf/LocalOptimizerPerf)."""

@@ -1,0 +1,125 @@
+"""Throughput micro-benchmark CLI.
+
+Reference: models/utils/DistriOptimizerPerf.scala (:91-95 — inception_v1/v2,
+vgg16/19 at batch x 3 x 224 x 224, synthetic data, no loading) and
+LocalOptimizerPerf.scala.  Same role here: time the compiled train step on
+synthetic batches per model, print records/s.
+
+Usage:
+    python -m bigdl_tpu.tools.perf --model inception_v1 --batch-size 32 \
+        [--iters 20] [--warmup 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MODELS = {}
+
+
+def _register(name):
+    def deco(fn):
+        _MODELS[name] = fn
+        return fn
+    return deco
+
+
+@_register("inception_v1")
+def _inception_v1():
+    from ..models.inception import Inception_v1_NoAuxClassifier
+    return Inception_v1_NoAuxClassifier(1000), (224, 224, 3), 1000
+
+
+@_register("vgg16")
+def _vgg16():
+    from ..models.vgg import Vgg_16
+    return Vgg_16(1000), (224, 224, 3), 1000
+
+
+@_register("vgg19")
+def _vgg19():
+    from ..models.vgg import Vgg_19
+    return Vgg_19(1000), (224, 224, 3), 1000
+
+
+@_register("resnet50")
+def _resnet50():
+    from ..models.resnet import ResNet
+    return ResNet(depth=50, class_num=1000,
+                  dataset="imagenet"), (224, 224, 3), 1000
+
+
+@_register("lenet")
+def _lenet():
+    from ..models.lenet import LeNet5
+    return LeNet5(10), (28, 28, 1), 10
+
+
+def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
+    from ..nn import CrossEntropyCriterion
+    from ..optim import SGD, Optimizer, Trigger
+    from ..utils.engine import Engine
+
+    Engine.reset()
+    Engine.init()
+    mesh = Engine.mesh()
+    model, input_hw, classes = _MODELS[model_name]()
+    model.build(jax.random.key(0))
+    opt = Optimizer(model, dataset=None, criterion=CrossEntropyCriterion(),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(learning_rate=0.01, momentum=0.9))
+    step, param_sh, data_sh = opt._build_step(mesh)
+
+    params = jax.device_put(model.params, param_sh)
+    net_state = model.state
+    opt_state = opt.optim_method.init_state(params)
+    inp = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (batch_size,) + input_hw), jnp.float32)
+    tgt = jnp.asarray(np.random.default_rng(1).integers(
+        0, classes, batch_size), jnp.float32)
+    rng = jax.random.key(1)
+
+    def one():
+        nonlocal params, net_state, opt_state
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, inp, tgt, jnp.float32(0.01), rng)
+        return loss
+
+    t0 = time.perf_counter()
+    one().block_until_ready()
+    compile_s = time.perf_counter() - t0
+    for _ in range(warmup):
+        one()
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = one()
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return {"model": model_name, "batch_size": batch_size,
+            "step_seconds": dt, "records_per_second": batch_size / dt,
+            "compile_seconds": compile_s,
+            "device": str(jax.devices()[0])}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="train-step throughput bench "
+                                 "(reference: DistriOptimizerPerf)")
+    ap.add_argument("--model", default="inception_v1",
+                    choices=sorted(_MODELS))
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.model, args.batch_size, args.iters,
+                         args.warmup)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Offline ETL: image-folder tree -> sharded BDRecord files.
+
+Reference: models/utils/ImageNetSeqFileGenerator.scala — the CLI that turns
+the raw ImageNet folder layout into the Hadoop SequenceFiles BigDL trains
+from.  Here the target is the BDRecord format (utils/recordio.py; TFRecord
+framing, native C++ reader), sharded so each TPU host process reads its own
+subset of shards.
+
+Usage:
+    python -m bigdl_tpu.tools.record_generator \
+        --folder /data/imagenet/train --output /data/bdr/train \
+        --shards 64 [--scale 256] [--parallel 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def convert(folder: str, output: str, shards: int = 8, scale: int = -1,
+            parallel: int = os.cpu_count() or 1, quiet: bool = False):
+    from ..dataset.image import _decode_image, _resize_shorter
+    from ..utils.recordio import write_records
+
+    classes = sorted(d for d in os.listdir(folder)
+                     if os.path.isdir(os.path.join(folder, d)))
+    if not classes:
+        raise ValueError(f"no class directories under {folder!r}")
+    jobs = []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(folder, cls)
+        for fname in sorted(os.listdir(cdir)):
+            jobs.append((os.path.join(cdir, fname), float(label)))
+
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+
+    def prepare(job):
+        path, label = job
+        img = _decode_image(path)
+        if scale > 0:
+            img = _resize_shorter(img, scale)
+        return {"data": np.asarray(img, np.uint8), "label": label}
+
+    n = 0
+
+    def records():
+        nonlocal n
+        with ThreadPoolExecutor(max_workers=parallel) as pool:
+            for rec in pool.map(prepare, jobs, chunksize=16):
+                n += 1
+                if not quiet and n % 1000 == 0:
+                    print(f"{n}/{len(jobs)} records")
+                yield rec
+
+    # decode in the thread pool; sharded framing/atomic-rename is
+    # write_records' job (utils/recordio.py)
+    paths = write_records(output, records(), shards=shards)
+    if not quiet:
+        print(f"wrote {n} records over {shards} shards -> {output}-*")
+    return paths, n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="image folder -> sharded BDRecord files")
+    ap.add_argument("--folder", required=True,
+                    help="directory-per-class image tree")
+    ap.add_argument("--output", required=True, help="output shard base path")
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--scale", type=int, default=-1,
+                    help="resize shorter side to this (like LocalImgReader)")
+    ap.add_argument("--parallel", type=int, default=os.cpu_count() or 1)
+    args = ap.parse_args(argv)
+    convert(args.folder, args.output, args.shards, args.scale, args.parallel)
+
+
+if __name__ == "__main__":
+    main()

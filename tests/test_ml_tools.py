@@ -1,0 +1,185 @@
+"""Tests for the ML estimators, straggler mitigation, kth_largest, and the
+ETL/perf tools (reference analogs: DLEstimator/DLClassifier ML-pipeline
+specs, the straggler-drop path of DistriOptimizerSpec, Util.kthLargest,
+ImageNetSeqFileGenerator)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ml import DLClassifier, DLEstimator
+from bigdl_tpu.optim import Adam
+from bigdl_tpu.utils import kth_largest
+
+
+def test_kth_largest_matches_sort():
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal(101).tolist()
+    ranked = sorted(vals, reverse=True)
+    for k in (1, 2, 50, 101):
+        assert kth_largest(vals, k) == ranked[k - 1]
+    with pytest.raises(ValueError):
+        kth_largest(vals, 0)
+    with pytest.raises(ValueError):
+        kth_largest(vals, 102)
+
+
+def _toy_classification(n=192, d=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, d)) * 3
+    y = np.arange(n) % classes
+    X = centers[y] + rng.standard_normal((n, d)) * 0.3
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def test_dl_classifier_fit_predict_score():
+    X, y = _toy_classification()
+    model = (nn.Sequential().add(nn.Linear(10, 16)).add(nn.ReLU())
+             .add(nn.Linear(16, 3)))
+    est = DLClassifier(model, nn.CrossEntropyCriterion(), batch_size=32,
+                       max_epoch=8, optim_method=Adam(1e-2))
+    fitted = est.fit(X, y)
+    preds = fitted.predict(X)
+    assert preds.shape == (len(X),)
+    assert fitted.score(X, y) > 0.95
+    # transform returns raw outputs
+    assert fitted.transform(X).shape == (len(X), 3)
+
+
+def test_dl_estimator_regression():
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((128, 5)).astype(np.float32)
+    w = rng.standard_normal((5, 1)).astype(np.float32)
+    y = X @ w
+    est = DLEstimator(nn.Linear(5, 1), nn.MSECriterion(),
+                      label_size=(1,), batch_size=32, max_epoch=30,
+                      optim_method=Adam(1e-2))
+    fitted = est.fit(X, y)
+    pred = fitted.transform(X)
+    assert pred.shape == (128, 1)
+    assert float(np.mean((pred - y) ** 2)) < 0.05
+
+
+def test_feature_size_reshaping():
+    X, y = _toy_classification(d=16)
+    model = (nn.Sequential().add(nn.Reshape((16,))).add(nn.Linear(16, 3)))
+    est = DLClassifier(model, nn.CrossEntropyCriterion(),
+                       feature_size=(4, 4), batch_size=32, max_epoch=5,
+                       optim_method=Adam(1e-2))
+    fitted = est.fit(X, y)
+    assert fitted.predict(X).shape == (len(X),)
+
+
+def test_straggler_drop_property_validation():
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), np.float32(0))] * 8)
+    opt = Optimizer(nn.Linear(4, 2), ds.transform(SampleToMiniBatch(4)),
+                    nn.CrossEntropyCriterion())
+    with pytest.raises(ValueError):
+        opt.set_drop_module_property(0.5, 0.2)
+    opt.set_drop_module_property(0.1, 0.3, batch_size=10,
+                                 warmup_iteration=2)
+    assert opt.drop_percentage == 0.1
+
+
+def test_straggler_check_drops_slow_iterations():
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), np.float32(0))] * 8)
+    opt = Optimizer(nn.Linear(4, 2), ds.transform(SampleToMiniBatch(4)),
+                    nn.CrossEntropyCriterion())
+    opt.set_drop_module_property(0.05, 0.5, batch_size=20,
+                                 warmup_iteration=5)
+    # feed a window of fast iterations, then a straggler
+    dropped = []
+    for i in range(30):
+        dropped.append(opt._straggler_check(0.01, i + 1))
+    assert not any(dropped)  # uniform times: nothing above threshold budget
+    assert opt._straggler_check(1.0, 31) is True  # clear straggler
+    got = opt.metrics.get("dropped iterations")
+    assert got[0] == 1.0
+
+
+def test_straggler_drop_budget_respected():
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), np.float32(0))] * 8)
+    opt = Optimizer(nn.Linear(4, 2), ds.transform(SampleToMiniBatch(4)),
+                    nn.CrossEntropyCriterion())
+    opt.set_drop_module_property(0.05, 0.1, batch_size=20,
+                                 warmup_iteration=0)
+    for i in range(20):
+        opt._straggler_check(0.01, i + 1)
+    n_dropped = sum(opt._straggler_check(5.0, 21 + i) for i in range(10))
+    # max_drop_percentage=0.1 over a 20-wide window caps drops at 2
+    assert n_dropped <= 2
+
+
+def test_straggler_ramping_waits_capped():
+    # regression: a monotonically slowing pipeline must not get every
+    # iteration dropped — the budget caps drops per threshold window
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), np.float32(0))] * 8)
+    opt = Optimizer(nn.Linear(4, 2), ds.transform(SampleToMiniBatch(4)),
+                    nn.CrossEntropyCriterion())
+    opt.set_drop_module_property(0.05, 0.1, batch_size=20,
+                                 warmup_iteration=0)
+    wait = 0.01
+    for i in range(20):
+        opt._straggler_check(wait, i + 1)
+    dropped = 0
+    for i in range(30):
+        wait *= 2.0
+        dropped += opt._straggler_check(wait, 21 + i)
+    # 0.1 * 20 = 2 drops allowed per 20-iteration budget window; 30 iters
+    # span at most 2 windows
+    assert dropped <= 4
+
+
+def test_straggler_batch_size_validation():
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), np.float32(0))] * 8)
+    opt = Optimizer(nn.Linear(4, 2), ds.transform(SampleToMiniBatch(4)),
+                    nn.CrossEntropyCriterion())
+    with pytest.raises(ValueError):
+        opt.set_drop_module_property(0.1, 0.2, batch_size=1)
+    with pytest.raises(ValueError):
+        opt.set_drop_module_property(0.1, 0.2, warmup_iteration=-1)
+
+
+def test_record_generator_end_to_end(tmp_path):
+    from bigdl_tpu.tools.record_generator import convert
+    from bigdl_tpu.utils.recordio import read_records
+    # build a tiny 2-class image tree (PPM — decodable without PIL)
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / "imgs" / cls)
+        for i in range(3):
+            arr = np.full((4, 5, 3), 10 * i, np.uint8)
+            _write_ppm(str(tmp_path / "imgs" / cls / f"{i}.ppm"), arr)
+    out = str(tmp_path / "out" / "train.bdr")
+    paths, n = convert(str(tmp_path / "imgs"), out, shards=2, quiet=True)
+    assert n == 6 and len(paths) == 2
+    recs = list(read_records(out + "-*-of-*"))
+    assert len(recs) == 6
+    labels = sorted(r["label"] for r in recs)
+    assert labels == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+    assert recs[0]["data"].shape == (4, 5, 3)
+
+
+def _write_ppm(path, arr):
+    h, w, _ = arr.shape
+    with open(path, "wb") as f:
+        f.write(b"P6\n%d %d\n255\n" % (w, h))
+        f.write(arr.tobytes())
+
+
+def test_perf_tool_lenet():
+    from bigdl_tpu.tools.perf import run
+    out = run("lenet", batch_size=8, iters=2, warmup=1)
+    assert out["records_per_second"] > 0
+    assert out["model"] == "lenet"
