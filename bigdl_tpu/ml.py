@@ -60,8 +60,11 @@ class DLEstimator:
             lbl = (y[i].reshape(self.label_size) if self.label_size
                    else y[i])
             samples.append(Sample(f, lbl))
+        # pad_last keeps the trailing partial batch at the compiled step's
+        # static shape (drop_last=False would retrace / break mesh-divisible
+        # sharding; see Optimizer's own batch path)
         ds = DataSet.array(samples).transform(
-            SampleToMiniBatch(self.batch_size, drop_last=False))
+            SampleToMiniBatch(self.batch_size, pad_last=True))
         opt = Optimizer(self.model, ds, self.criterion) \
             .set_end_when(Trigger.max_epoch(self.max_epoch))
         if self.optim_method is not None:

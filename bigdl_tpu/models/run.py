@@ -1,0 +1,195 @@
+"""Unified Train/Test CLI for the model zoo.
+
+Reference: each model ships a scopt-CLI `Train`/`Test` main launched via
+spark-submit (models/lenet/Train.scala:35, models/inception/Train.scala:31,
+models/rnn/Train.scala, …).  TPU re-design: one argparse CLI; data comes
+from BDRecord shards (tools/record_generator.py output), an .npy pair, or
+--synthetic for smoke runs; no cluster submission step — the process IS the
+driver (single-controller JAX).
+
+Train:
+    python -m bigdl_tpu.models.run train --model lenet \
+        --data /data/mnist/train.bdr --batch-size 128 --max-epoch 5 \
+        [--checkpoint /ckpt] [--summary-dir /tb] [--validate /data/val.bdr]
+Test:
+    python -m bigdl_tpu.models.run test --model lenet \
+        --snapshot /ckpt/model.100 --data /data/mnist/val.bdr
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _build_model(name: str, class_num: int):
+    """-> (model, input_hw, criterion_name).  Models ending in LogSoftMax
+    (like the reference zoo) pair with ClassNLL; logits models with
+    CrossEntropy (see models/resnet Train.scala pairing note)."""
+    if name == "lenet":
+        from .lenet import LeNet5
+        return LeNet5(class_num), (28, 28, 1), "nll"
+    if name == "vgg":
+        from .vgg import VggForCifar10
+        return VggForCifar10(class_num), (32, 32, 3), "nll"
+    if name == "vgg16":
+        from .vgg import Vgg_16
+        return Vgg_16(class_num), (224, 224, 3), "nll"
+    if name == "vgg19":
+        from .vgg import Vgg_19
+        return Vgg_19(class_num), (224, 224, 3), "nll"
+    if name == "resnet":
+        from .resnet import ResNet
+        return ResNet(depth=20, class_num=class_num,
+                      dataset="cifar10"), (32, 32, 3), "xent"
+    if name == "resnet50":
+        from .resnet import ResNet
+        return ResNet(depth=50, class_num=class_num,
+                      dataset="imagenet"), (224, 224, 3), "xent"
+    if name == "inception":
+        from .inception import Inception_v1_NoAuxClassifier
+        return (Inception_v1_NoAuxClassifier(class_num), (224, 224, 3),
+                "nll")
+    if name == "autoencoder":
+        from .autoencoder import Autoencoder
+        return Autoencoder(32), (28, 28, 1), "mse"
+    raise ValueError(f"unknown model {name!r}")
+
+
+def _load_samples(path: str, input_hw):
+    """BDRecord shards of {'data','label'} dicts or Samples -> [Sample]."""
+    from ..dataset import Sample
+    from ..utils.recordio import read_records
+    samples = []
+    for rec in read_records(path):
+        if isinstance(rec, Sample):
+            samples.append(rec)
+        else:
+            raw = np.asarray(rec["data"])
+            # dtype-driven rescale: record_generator stores uint8 pixels;
+            # float records are taken as already-normalized
+            if raw.dtype == np.uint8:
+                data = raw.astype(np.float32) / 255.0
+            else:
+                data = raw.astype(np.float32)
+            samples.append(Sample(data, np.float32(rec["label"])))
+    if not samples:
+        raise ValueError(f"no records in {path!r}")
+    return samples
+
+
+def _synthetic(input_hw, class_num: int, n: int = 512, seed: int = 0):
+    """Separable synthetic data: class prototypes are FIXED (seed 0) so
+    train (seed 0) and validation (seed 1) describe the same classes; only
+    the noise differs."""
+    from ..dataset import Sample
+    protos = np.random.default_rng(0).standard_normal(
+        (class_num,) + input_hw)
+    rng = np.random.default_rng(seed)
+    return [Sample((protos[i % class_num] +
+                    rng.standard_normal(input_hw) * 0.1).astype(np.float32),
+                   np.float32(i % class_num)) for i in range(n)]
+
+
+def train(args) -> None:
+    from .. import Engine
+    from .. import nn
+    from ..dataset import DataSet, SampleToMiniBatch
+    from ..optim import (SGD, Adam, Optimizer, Top1Accuracy, Trigger)
+    from ..visualization import TrainSummary, ValidationSummary
+
+    Engine.init()
+    model, input_hw, crit = _build_model(args.model, args.class_num)
+    samples = (_synthetic(input_hw, args.class_num) if args.synthetic
+               else _load_samples(args.data, input_hw))
+    if crit == "mse":  # autoencoder: reconstruct the input
+        from ..dataset import Sample
+        samples = [Sample(s.feature, s.feature) for s in samples]
+        criterion = nn.MSECriterion()
+    elif crit == "nll":
+        criterion = nn.ClassNLLCriterion()
+    else:
+        criterion = nn.CrossEntropyCriterion()
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(args.batch_size, drop_last=True))
+    method = (Adam(args.learning_rate) if args.optim == "adam"
+              else SGD(args.learning_rate, momentum=0.9))
+    opt = (Optimizer(model, ds, criterion)
+           .set_optim_method(method)
+           .set_end_when(Trigger.max_epoch(args.max_epoch)))
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        opt.set_train_summary(TrainSummary(args.summary_dir, args.app_name))
+    if crit != "mse" and (args.validate or args.synthetic):
+        vsamples = (_synthetic(input_hw, args.class_num, n=128, seed=1)
+                    if args.synthetic else
+                    _load_samples(args.validate, input_hw))
+        vds = DataSet.array(vsamples)
+        opt.set_validation(Trigger.every_epoch(), vds, [Top1Accuracy()],
+                           batch_size=args.batch_size)
+        if args.summary_dir:
+            opt.set_validation_summary(
+                ValidationSummary(args.summary_dir, args.app_name))
+    trained = opt.optimize()
+    if args.model_save:
+        trained.save(args.model_save)
+        logger.info("model saved -> %s", args.model_save)
+
+
+def test(args) -> None:
+    from .. import Engine, nn
+    from ..dataset import DataSet
+    from ..optim import Evaluator, Top1Accuracy, Top5Accuracy
+
+    Engine.init()
+    model = nn.Module.load(args.snapshot)
+    _, input_hw, _crit = _build_model(args.model, args.class_num)
+    samples = (_synthetic(input_hw, args.class_num, n=256, seed=1)
+               if args.synthetic else _load_samples(args.data, input_hw))
+    results = Evaluator(model).test(
+        DataSet.array(samples), [Top1Accuracy(), Top5Accuracy()],
+        batch_size=args.batch_size)
+    for method, res in results:
+        print(f"{method.name}: {res}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="model zoo Train/Test CLI")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for cmd in ("train", "test"):
+        p = sub.add_parser(cmd)
+        p.add_argument("--model", required=True)
+        p.add_argument("--data", help="BDRecord path/glob")
+        p.add_argument("--synthetic", action="store_true",
+                       help="synthetic data smoke run")
+        p.add_argument("--batch-size", type=int, default=128)
+        p.add_argument("--class-num", type=int, default=10)
+        if cmd == "train":
+            p.add_argument("--max-epoch", type=int, default=5)
+            p.add_argument("--learning-rate", type=float, default=0.01)
+            p.add_argument("--optim", choices=("sgd", "adam"),
+                           default="sgd")
+            p.add_argument("--checkpoint")
+            p.add_argument("--summary-dir")
+            p.add_argument("--app-name", default="bigdl_tpu")
+            p.add_argument("--validate", help="validation BDRecord path")
+            p.add_argument("--model-save", help="save trained model here")
+        else:
+            p.add_argument("--snapshot", required=True,
+                           help="model file written by Module.save")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    if not args.synthetic and not args.data:
+        ap.error("need --data or --synthetic")
+    (train if args.cmd == "train" else test)(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -312,14 +312,23 @@ class Optimizer:
     # ------------------------------------------------------------------
 
     def optimize(self) -> Module:
+        from ..utils import config
         retries = 0
-        max_retries = 5  # reference: bigdl.failure.retryTimes (:751)
+        max_retries = config.retry_times()  # bigdl.failure.retryTimes (:751)
+        window = config.retry_time_interval()
+        last_failure = None
         while True:
             try:
                 return self._optimize_impl()
             except KeyboardInterrupt:
                 raise
             except Exception:
+                now = time.monotonic()
+                # reference: the retry counter resets once failures are
+                # farther apart than retryTimeInterval (:752)
+                if last_failure is not None and now - last_failure > window:
+                    retries = 0
+                last_failure = now
                 retries += 1
                 if retries > max_retries or self.checkpoint_path is None:
                     raise

@@ -20,58 +20,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_MODELS = {}
-
-
-def _register(name):
-    def deco(fn):
-        _MODELS[name] = fn
-        return fn
-    return deco
-
-
-@_register("inception_v1")
-def _inception_v1():
-    from ..models.inception import Inception_v1_NoAuxClassifier
-    return Inception_v1_NoAuxClassifier(1000), (224, 224, 3), 1000
-
-
-@_register("vgg16")
-def _vgg16():
-    from ..models.vgg import Vgg_16
-    return Vgg_16(1000), (224, 224, 3), 1000
-
-
-@_register("vgg19")
-def _vgg19():
-    from ..models.vgg import Vgg_19
-    return Vgg_19(1000), (224, 224, 3), 1000
-
-
-@_register("resnet50")
-def _resnet50():
-    from ..models.resnet import ResNet
-    return ResNet(depth=50, class_num=1000,
-                  dataset="imagenet"), (224, 224, 3), 1000
-
-
-@_register("lenet")
-def _lenet():
-    from ..models.lenet import LeNet5
-    return LeNet5(10), (28, 28, 1), 10
+# zoo names, resolved through models/run._build_model so the benched step
+# uses the SAME model/criterion pairing as real training (LogSoftMax heads
+# pair with ClassNLL, logits heads with CrossEntropy)
+_MODELS = {"inception_v1": ("inception", 1000), "vgg16": ("vgg16", 1000),
+           "vgg19": ("vgg19", 1000), "resnet50": ("resnet50", 1000),
+           "lenet": ("lenet", 10)}
 
 
 def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
-    from ..nn import CrossEntropyCriterion
+    from ..models.run import _build_model
+    from ..nn import (ClassNLLCriterion, CrossEntropyCriterion,
+                      MSECriterion)
     from ..optim import SGD, Optimizer, Trigger
     from ..utils.engine import Engine
 
     Engine.reset()
     Engine.init()
     mesh = Engine.mesh()
-    model, input_hw, classes = _MODELS[model_name]()
+    zoo_name, classes = _MODELS[model_name]
+    model, input_hw, crit = _build_model(zoo_name, classes)
+    criterion = {"nll": ClassNLLCriterion(), "mse": MSECriterion(),
+                 "xent": CrossEntropyCriterion()}[crit]
     model.build(jax.random.key(0))
-    opt = Optimizer(model, dataset=None, criterion=CrossEntropyCriterion(),
+    opt = Optimizer(model, dataset=None, criterion=criterion,
                     end_trigger=Trigger.max_iteration(1))
     opt.set_optim_method(SGD(learning_rate=0.01, momentum=0.9))
     step, param_sh, data_sh = opt._build_step(mesh)

@@ -40,10 +40,12 @@ def convert(folder: str, output: str, shards: int = 8, scale: int = -1,
 
     def prepare(job):
         path, label = job
-        img = _decode_image(path)
+        img = _decode_image(path)  # float32 in [0, 1]
         if scale > 0:
             img = _resize_shorter(img, scale)
-        return {"data": np.asarray(img, np.uint8), "label": label}
+        # store compact uint8 pixels; loaders rescale by dtype
+        data = np.clip(np.round(img * 255.0), 0, 255).astype(np.uint8)
+        return {"data": data, "label": label}
 
     n = 0
 

@@ -1,0 +1,70 @@
+"""Process-level configuration tiers.
+
+Reference: BigDL's three config tiers (SURVEY.md §5.6) — JVM system
+properties `bigdl.*` (utils/Engine.scala:113-152, DistriOptimizer.scala:751),
+the bundled spark-bigdl.conf, and per-app CLIs.  TPU re-design: the system
+properties become `BIGDL_TPU_*` environment variables (the process-level
+knob JAX programs use); the spark conf tier has no equivalent (no Spark);
+CLIs live in models/run.py and tools/.
+
+| env var                   | reference property               | default |
+|---------------------------|----------------------------------|---------|
+| BIGDL_TPU_SEED            | (RandomGenerator default seed)   | 0       |
+| BIGDL_TPU_RETRY_TIMES     | bigdl.failure.retryTimes         | 5       |
+| BIGDL_TPU_RETRY_INTERVAL  | bigdl.failure.retryTimeInterval  | 120     |
+| BIGDL_TPU_NUM_THREADS     | bigdl.coreNumber / MKL threads   | ncpu    |
+| BIGDL_TPU_LOG_FILE        | bigdl.utils.LoggerFilter.logFile | bigdl_tpu.log |
+| BIGDL_TPU_DISABLE_LOGGER_FILTER | bigdl.utils.LoggerFilter.disable | 0 |
+| BIGDL_TPU_CHECK_SINGLETON | bigdl.check.singleton            | 0       |
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_int", "get_float", "get_bool", "get_str",
+           "retry_times", "retry_time_interval", "num_threads", "seed"]
+
+
+def get_str(name: str, default: str) -> str:
+    return os.environ.get(f"BIGDL_TPU_{name}", default)
+
+
+def get_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(f"BIGDL_TPU_{name}", default))
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(f"BIGDL_TPU_{name}", default))
+    except ValueError:
+        return default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(f"BIGDL_TPU_{name}")
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def retry_times() -> int:
+    """(reference: bigdl.failure.retryTimes, DistriOptimizer.scala:751)."""
+    return get_int("RETRY_TIMES", 5)
+
+
+def retry_time_interval() -> float:
+    """Sliding window (seconds) that resets the retry counter
+    (reference: bigdl.failure.retryTimeInterval, DistriOptimizer.scala:752)."""
+    return get_float("RETRY_INTERVAL", 120.0)
+
+
+def num_threads() -> int:
+    return get_int("NUM_THREADS", os.cpu_count() or 1)
+
+
+def seed() -> int:
+    return get_int("SEED", 0)

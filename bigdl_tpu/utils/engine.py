@@ -66,6 +66,10 @@ class Engine:
         dev_array = np.array(devs).reshape(sizes)
         cls._mesh = Mesh(dev_array, tuple(mesh_shape.keys()))
         cls._initialized = True
+        # host-kernel thread count (reference: Engine.init pins MKL threads
+        # via MKL.setNumThreads, utils/Engine.scala:241-257)
+        from . import config, native
+        native.set_num_threads(config.num_threads())
         logger.info("Engine.init: mesh %s over %d %s device(s)",
                     dict(zip(cls._mesh.axis_names, cls._mesh.devices.shape)),
                     len(devs), devs[0].platform)

@@ -1,0 +1,51 @@
+"""LoggerFilter: route framework/dependency log noise to a file.
+
+Reference: utils/LoggerFilter.scala:34 — redirects Spark/akka/breeze INFO
+chatter to `bigdl.log` so the driver console shows only BigDL's own
+progress lines; controlled by `bigdl.utils.LoggerFilter.{disable,logFile,
+enableSparkLog}` properties.  TPU re-design: the noisy dependencies are
+jax/absl/etc.; control via BIGDL_TPU_DISABLE_LOGGER_FILTER and
+BIGDL_TPU_LOG_FILE (utils/config.py)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable, Optional
+
+from . import config
+
+__all__ = ["redirect"]
+
+_NOISY = ("jax", "jax._src", "absl", "orbax", "flax")
+
+# one handler per log path for the process — repeat redirect() calls reuse
+# it instead of leaking file descriptors
+_handlers: dict = {}
+
+
+def redirect(loggers: Optional[Iterable[str]] = None,
+             log_file: Optional[str] = None) -> Optional[str]:
+    """Send the given loggers' records (default: jax/absl and friends) to
+    BIGDL_TPU_LOG_FILE instead of the console.  Returns the log path, or
+    None when disabled (reference: LoggerFilter.redirectSparkInfoLogs)."""
+    if config.get_bool("DISABLE_LOGGER_FILTER"):
+        return None
+    path = log_file or config.get_str("LOG_FILE",
+                                      os.path.abspath("bigdl_tpu.log"))
+    handler = _handlers.get(path)
+    if handler is None:
+        handler = logging.FileHandler(path)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+        _handlers[path] = handler
+    for name in (loggers or _NOISY):
+        lg = logging.getLogger(name)
+        # handlers are cached per path (bounded), so detach without closing
+        # — another logger may still share the old handler
+        for old in list(lg.handlers):
+            lg.removeHandler(old)
+        lg.addHandler(handler)
+        lg.propagate = False
+        lg.setLevel(logging.INFO)
+    return path

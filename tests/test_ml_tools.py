@@ -54,8 +54,8 @@ def test_dl_estimator_regression():
     w = rng.standard_normal((5, 1)).astype(np.float32)
     y = X @ w
     est = DLEstimator(nn.Linear(5, 1), nn.MSECriterion(),
-                      label_size=(1,), batch_size=32, max_epoch=30,
-                      optim_method=Adam(1e-2))
+                      label_size=(1,), batch_size=32, max_epoch=80,
+                      optim_method=Adam(3e-2))
     fitted = est.fit(X, y)
     pred = fitted.transform(X)
     assert pred.shape == (128, 1)
@@ -169,6 +169,14 @@ def test_record_generator_end_to_end(tmp_path):
     labels = sorted(r["label"] for r in recs)
     assert labels == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
     assert recs[0]["data"].shape == (4, 5, 3)
+    # pixel VALUES must survive the uint8 storage roundtrip (i=2 -> 20)
+    maxes = sorted(int(r["data"].max()) for r in recs)
+    assert maxes == [0, 0, 10, 10, 20, 20]
+    # and the training loader must rescale uint8 by dtype
+    from bigdl_tpu.models.run import _load_samples
+    samples = _load_samples(out + "-*-of-*", (4, 5, 3))
+    vals = sorted(round(float(s.feature.max()), 4) for s in samples)
+    assert vals[-1] == round(20 / 255, 4)
 
 
 def _write_ppm(path, arr):
