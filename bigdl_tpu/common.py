@@ -68,15 +68,21 @@ class _RngStream:
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.reset(seed)
+        self._seed = seed
+        # key creation is LAZY: jax.random.key initializes the backend, and
+        # importing the library must not touch devices (a hung/remote TPU
+        # would block every `import bigdl_tpu`)
+        self._key = None
 
     def reset(self, seed: int):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._seed = seed
             self._key = jax.random.key(seed)
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from .sample import Sample, MiniBatch, PaddingParam, FixedLength
 from .transformer import (Transformer, ChainedTransformer, SampleToMiniBatch,
-                          Identity)
+                          MTSampleToMiniBatch, Identity)
 from .text import (SentenceSplitter, SentenceTokenizer, SentenceBiPadding,
                    Dictionary, LabeledSentence, TextToLabeledSentence,
                    LabeledSentenceToSample)
@@ -33,7 +33,7 @@ from .text import (SentenceSplitter, SentenceTokenizer, SentenceBiPadding,
 __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "TransformedDataSet", "DataSet", "Sample", "MiniBatch",
            "PaddingParam", "FixedLength", "Transformer", "ChainedTransformer",
-           "SampleToMiniBatch", "Identity", "SentenceSplitter",
+           "SampleToMiniBatch", "MTSampleToMiniBatch", "Identity", "SentenceSplitter",
            "SentenceTokenizer", "SentenceBiPadding", "Dictionary",
            "LabeledSentence", "TextToLabeledSentence",
            "LabeledSentenceToSample"]

@@ -60,7 +60,10 @@ def _stack_features(values, padding: PaddingParam = None):
                 for i in range(n)]
     shapes = [v.shape for v in values]
     if all(s == shapes[0] for s in shapes) and not isinstance(padding, FixedLength):
-        return np.stack(values)
+        # native parallel gather (csrc/hostops.cc) for big equal-shape rows;
+        # np.stack fallback inside
+        from ..utils.native import gather_rows
+        return gather_rows(values)
     # variable length: pad dim0 of each sample (sequence axis)
     if isinstance(padding, FixedLength):
         max_len = padding.length
@@ -118,3 +121,84 @@ class SampleToMiniBatch(Transformer):
             return MiniBatch(feats)
         labels = _stack_features([s.label for s in samples], self.label_padding)
         return MiniBatch(feats, labels)
+
+
+class MTSampleToMiniBatch(SampleToMiniBatch):
+    """Multi-threaded batcher: upstream transform + batch assembly run in a
+    worker pool that stays `prefetch` batches ahead of the consumer.
+
+    Reference: dataset/image/MTLabeledBGRImgToBatch.scala — the reference's
+    thread pool decoded/copied images into batch buffers in parallel; here
+    the pool runs the (cloned) upstream transformer per chunk and the stack
+    uses the native gather kernel when built (csrc/hostops.cc).  The train
+    loop overlaps host batching with device steps for free: the device step
+    is async, so the pool fills the next batch while the chip computes.
+
+    `transformer` must map one sample to one sample (true of all the
+    reference's image/text record transformers) — chunked parallelism can't
+    rebalance a filtering/expanding transformer across chunk boundaries, so
+    a count change raises instead of silently emitting wrong-size batches.
+    Filtering transformers belong upstream: `filt >> MTSampleToMiniBatch`.
+    """
+
+    def __init__(self, batch_size: int, transformer: Transformer = None,
+                 feature_padding: PaddingParam = None,
+                 label_padding: PaddingParam = None, drop_last: bool = False,
+                 pad_last: bool = False, num_threads: int = None,
+                 prefetch: int = 4):
+        super().__init__(batch_size, feature_padding, label_padding,
+                         drop_last, pad_last)
+        import os
+        self.transformer = transformer
+        self.num_threads = num_threads or min(8, os.cpu_count() or 1)
+        self.prefetch = prefetch
+
+    def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
+        from ..utils.thread_pool import ThreadPool
+
+        def chunks():
+            buf = []
+            for s in it:
+                buf.append(s)
+                if len(buf) == self.batch_size:
+                    yield buf
+                    buf = []
+            if buf and not self.drop_last:
+                yield buf
+
+        def assemble(buf):
+            if self.transformer is not None:
+                # per-task transformer clone — the reference clones
+                # transformers per thread (Transformer.scala:56)
+                out = list(self.transformer.clone_transformer()(iter(buf)))
+                if len(out) != len(buf):
+                    raise ValueError(
+                        "MTSampleToMiniBatch requires a 1:1 transformer "
+                        f"(chunk of {len(buf)} became {len(out)}); apply "
+                        "filtering transformers upstream of the batcher")
+                buf = out
+            valid = len(buf)
+            if self.pad_last:
+                while len(buf) < self.batch_size:
+                    buf.append(buf[-1])
+            b = self._batch(buf)
+            if valid != len(buf):
+                b.valid = valid
+            return b
+
+        pool = ThreadPool(self.num_threads)
+        # in-flight window: enough tasks to feed every worker, at least
+        # `prefetch` batches ahead of the consumer
+        window = max(self.prefetch, self.num_threads)
+        pending = []
+        try:
+            for buf in chunks():
+                pending.extend(pool.invoke([lambda b=buf: assemble(b)]))
+                if len(pending) >= window:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+        finally:
+            for f in pending:
+                f.cancel()
+            pool.shutdown()

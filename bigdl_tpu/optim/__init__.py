@@ -12,7 +12,8 @@ from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
 from .trigger import Trigger
 from .validation import (ValidationResult, AccuracyResult, LossResult,
                          ValidationMethod, Top1Accuracy, Top5Accuracy, Loss,
-                         MAE, HitRatio, NDCG)
+                         MAE, HitRatio, NDCG, TreeNNAccuracy)
 from .metrics import Metrics
 from .optimizer import (Optimizer, DistriOptimizer, LocalOptimizer, Evaluator,
-                        Predictor)
+                        Predictor, Validator, DistriValidator,
+                        LocalValidator)

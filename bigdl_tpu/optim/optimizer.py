@@ -58,7 +58,7 @@ from .validation import ValidationMethod
 logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
-           "Predictor"]
+           "Predictor", "Validator", "DistriValidator", "LocalValidator"]
 
 
 def _trim(x, valid: int):
@@ -610,3 +610,23 @@ class Predictor:
 
     def predict_class(self, dataset):
         return np.argmax(self.predict(dataset), axis=-1)
+
+
+class Validator:
+    """Dataset-based evaluation facade (reference: optim/Validator.scala:34,
+    DistriValidator.scala:35, LocalValidator — deprecated there in favor of
+    model.evaluate; kept as a thin wrapper over Evaluator)."""
+
+    def __init__(self, model: Module, dataset):
+        self.model = model
+        self.dataset = dataset
+
+    def test(self, methods, batch_size: int = 128):
+        return Evaluator(self.model).test(self.dataset, methods,
+                                          batch_size=batch_size)
+
+
+#: aliases for reference-API parity (the Distri/Local split has no meaning
+#: under a device mesh)
+DistriValidator = Validator
+LocalValidator = Validator

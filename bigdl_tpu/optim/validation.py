@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 __all__ = ["ValidationResult", "AccuracyResult", "LossResult",
            "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss", "MAE",
-           "HitRatio", "NDCG"]
+           "HitRatio", "NDCG", "TreeNNAccuracy"]
 
 
 class ValidationResult:
@@ -165,3 +165,27 @@ class NDCG(ValidationMethod):
         rank = np.sum(o[None, :] > pos[:, None], axis=-1) + 1
         gain = np.where(rank <= self.k, 1.0 / np.log2(rank + 1), 0.0)
         return AccuracyResult(float(np.sum(gain)), pos.shape[0])
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy read at the tree ROOT node's prediction
+    (reference: ValidationMethod.scala:118 TreeNNAccuracy — output is the
+    per-node (batch, nodes, classes) tensor from BinaryTreeLSTM's head; the
+    root is the LAST node slot in topological order)."""
+
+    name = "TreeNNAccuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        o = np.asarray(output)
+        t = np.asarray(target)
+        if t.ndim >= 2 and t.shape[1] > 1:  # per-node labels: take the root
+            t = t[:, -1]
+        t = t.reshape(len(o)).astype(np.int64)
+        if self.one_based:
+            t = t - 1
+        root = o[:, -1, :] if o.ndim == 3 else o
+        pred = np.argmax(root, axis=-1)
+        return AccuracyResult(float(np.sum(pred == t)), len(t))

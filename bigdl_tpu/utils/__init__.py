@@ -2,5 +2,6 @@ from .table import Table, T
 from .engine import Engine
 from .rng import RandomGenerator, RNG
 from .util import kth_largest
+from .thread_pool import ThreadPool
 
-__all__ = ["Table", "T", "Engine", "RandomGenerator", "RNG", "kth_largest"]
+__all__ = ["Table", "T", "Engine", "RandomGenerator", "RNG", "kth_largest", "ThreadPool"]

@@ -162,8 +162,11 @@ def gather_rows(rows) -> np.ndarray:
     if lib is None or not rows:
         return np.stack(rows) if rows else np.empty((0,))
     if any(r.shape != rows[0].shape or r.dtype != rows[0].dtype
-           for r in rows[1:]):  # native memcpy would read out of bounds
-        raise ValueError("gather_rows requires equal shapes and dtypes")
+           for r in rows[1:]):
+        # heterogeneous rows: the native memcpy would read out of bounds;
+        # np.stack keeps behavior identical with and without the library
+        # (promoting dtypes, raising on shape mismatch)
+        return np.stack(rows)
     out = np.empty((len(rows),) + rows[0].shape, dtype=rows[0].dtype)
     ptrs = (ctypes.c_void_p * len(rows))(
         *[r.ctypes.data for r in rows])

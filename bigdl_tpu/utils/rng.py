@@ -27,7 +27,10 @@ class RandomGenerator:
     """Stateful convenience wrapper over a splittable key stream."""
 
     def __init__(self, seed: int = 0):
-        self.set_seed(seed)
+        # lazy: creating a PRNG key initializes the jax backend, and module
+        # import (the process-global RNG below) must not touch devices
+        self._seed = seed
+        self._key = None
 
     def set_seed(self, seed: int):
         self._key = jax.random.key(seed)
@@ -38,6 +41,8 @@ class RandomGenerator:
         return self._seed
 
     def _next(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

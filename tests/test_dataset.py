@@ -141,3 +141,82 @@ def test_dataset_record_file_builder(tmp_path):
     recordio.write_records(path, samples(6))
     ds = DataSet.record_file(path)
     assert ds.size() == 6
+
+
+def test_mt_sample_to_minibatch_matches_single_threaded():
+    import numpy as np
+    from bigdl_tpu.dataset import (MTSampleToMiniBatch, Sample,
+                                   SampleToMiniBatch)
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal((7, 3)).astype(np.float32),
+                      np.float32(i)) for i in range(50)]
+    ref = list(SampleToMiniBatch(16, pad_last=True)(iter(samples)))
+    got = list(MTSampleToMiniBatch(16, pad_last=True, num_threads=4)(
+        iter(samples)))
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r.get_input()),
+                                      np.asarray(g.get_input()))
+        np.testing.assert_array_equal(np.asarray(r.get_target()),
+                                      np.asarray(g.get_target()))
+        assert r.valid == g.valid
+
+
+def test_mt_batcher_with_upstream_transformer():
+    import numpy as np
+    from bigdl_tpu.dataset import MTSampleToMiniBatch, Sample, Transformer
+
+    class Scale(Transformer):
+        def __call__(self, it):
+            for s in it:
+                yield Sample(s.feature * 2.0, s.label)
+
+    samples = [Sample(np.full((2, 2), i, np.float32), np.float32(i))
+               for i in range(10)]
+    got = list(MTSampleToMiniBatch(4, transformer=Scale(), drop_last=True,
+                                   num_threads=2)(iter(samples)))
+    assert len(got) == 2
+    np.testing.assert_array_equal(
+        np.asarray(got[0].get_input())[3], np.full((2, 2), 6.0))
+
+
+def test_thread_pool_api():
+    from bigdl_tpu.utils import ThreadPool
+    pool = ThreadPool(4)
+    results = pool.invoke_and_wait([lambda i=i: i * i for i in range(8)])
+    assert results == [i * i for i in range(8)]
+    futs = pool.invoke([lambda: 42])
+    assert pool.sync(futs) == [42]
+    import pytest as _p
+    import time as _t
+    with _p.raises(Exception):
+        pool.invoke_and_wait([lambda: _t.sleep(0.3)], timeout=0.05)
+    pool.shutdown()
+
+
+def test_mt_batcher_rejects_filtering_transformer():
+    import numpy as np
+    import pytest
+    from bigdl_tpu.dataset import MTSampleToMiniBatch, Sample, Transformer
+
+    class DropOdd(Transformer):
+        def __call__(self, it):
+            for s in it:
+                if int(s.label) % 2 == 0:
+                    yield s
+
+    samples = [Sample(np.zeros(3, np.float32), np.float32(i))
+               for i in range(8)]
+    mt = MTSampleToMiniBatch(4, transformer=DropOdd(), num_threads=2)
+    with pytest.raises(ValueError, match="1:1"):
+        list(mt(iter(samples)))
+
+
+def test_gather_rows_heterogeneous_matches_np_stack():
+    import numpy as np
+    from bigdl_tpu.utils import native
+    rows = [np.zeros((2, 2), np.float32), np.zeros((2, 2), np.float64)]
+    got = native.gather_rows(rows)
+    ref = np.stack(rows)
+    assert got.dtype == ref.dtype
+    np.testing.assert_array_equal(got, ref)

@@ -118,3 +118,61 @@ def test_lbfgs_rosenbrock_improves():
         grads = {"w": jax.grad(f)(params["w"])}
         params, state = m.update(grads, params, state, 2e-3)
     assert float(f(params["w"])) < f0 * 0.5
+
+
+def test_tree_nn_accuracy():
+    import numpy as np
+    from bigdl_tpu.optim import TreeNNAccuracy
+    # (batch=2, nodes=3, classes=2): root = last node slot
+    out = np.zeros((2, 3, 2))
+    out[0, -1] = [0.9, 0.1]   # predicts 0
+    out[1, -1] = [0.2, 0.8]   # predicts 1
+    res = TreeNNAccuracy()(out, np.array([0.0, 0.0]))
+    acc, n = res.result()
+    assert n == 2 and acc == 0.5
+
+
+def test_validator_facade():
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.optim import (DistriValidator, LocalValidator,
+                                 Top1Accuracy, Validator)
+    assert DistriValidator is Validator and LocalValidator is Validator
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(4).astype(np.float32),
+                      np.float32(i % 2)) for i in range(32)]
+    model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+    model.build()
+    res = Validator(model, DataSet.array(samples)).test(
+        [Top1Accuracy()], batch_size=16)
+    _, r = res[0]
+    acc, n = r.result()
+    assert n == 32 and 0.0 <= acc <= 1.0
+
+
+def test_import_does_not_touch_devices():
+    # importing the library must not initialize a jax backend (a hung TPU
+    # tunnel would block every import); run in a clean subprocess
+    import subprocess
+    import sys
+    code = (
+        "import jax, bigdl_tpu, bigdl_tpu.optim, bigdl_tpu.nn\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, xla_bridge._backends\n"
+        "print('clean')\n")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120)
+    assert "clean" in out.stdout, out.stderr
+
+
+def test_tree_nn_accuracy_per_node_targets():
+    import numpy as np
+    from bigdl_tpu.optim import TreeNNAccuracy
+    out = np.zeros((2, 3, 2))
+    out[0, -1] = [0.9, 0.1]
+    out[1, -1] = [0.2, 0.8]
+    # per-node (batch, nodes) labels: root label is the last column
+    target = np.array([[1.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    acc, n = TreeNNAccuracy()(out, target).result()
+    assert n == 2 and acc == 1.0
