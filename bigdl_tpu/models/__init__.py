@@ -7,11 +7,12 @@ from .lenet import LeNet5
 from .resnet import ResNet, ShortcutType
 from .rnn import PTBModel, SimpleRNN
 from .textclassifier import TextClassifier
+from .treelstm_sentiment import TreeLSTMSentiment, encode_tree
 from .vgg import Vgg_16, Vgg_19, VggForCifar10
 
 __all__ = [
     "Autoencoder", "Inception_Layer_v1", "Inception_v1",
     "Inception_v1_NoAuxClassifier", "LeNet5", "PTBModel", "ResNet",
-    "ShortcutType", "SimpleRNN", "TextClassifier", "Vgg_16", "Vgg_19",
-    "VggForCifar10",
+    "ShortcutType", "SimpleRNN", "TextClassifier", "TreeLSTMSentiment",
+    "encode_tree", "Vgg_16", "Vgg_19", "VggForCifar10",
 ]

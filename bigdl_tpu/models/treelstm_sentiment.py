@@ -1,0 +1,93 @@
+"""TreeLSTM sentiment model + tree encoding helpers.
+
+Reference: example/treeLSTMSentiment/{TreeLSTMSentiment,Train,Utils}.scala —
+a BinaryTreeLSTM over constituency-parsed sentences (SST-style), embeddings
+in front, a classifier head over node hiddens, evaluated with
+TreeNNAccuracy.
+
+TPU re-design: trees arrive as the static-shape (children, leaf_ids) arrays
+BinaryTreeLSTM scans over (nn/tree.py); `encode_tree` converts a nested
+`(left, right)` tuple-tree of token indices into that form, padded to
+`n_nodes`."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import nn
+from ..nn.module import Module
+
+__all__ = ["TreeLSTMSentiment", "encode_tree"]
+
+
+def encode_tree(tree, n_nodes: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Nested tuple-tree of leaf ids -> (children, leaf_ids, root_slot).
+
+    `tree` is either an int (a leaf: index into the token sequence) or a
+    pair (left_subtree, right_subtree).  Output arrays are padded to
+    `n_nodes` slots with -1 rows; nodes are laid out children-before-parent
+    so BinaryTreeLSTM's scan sees ready children (the reference walked the
+    object graph recursively instead)."""
+    children: List[List[int]] = []
+    leaf_ids: List[int] = []
+
+    def walk(t) -> int:
+        if isinstance(t, (int, np.integer)):
+            children.append([-1, -1])
+            leaf_ids.append(int(t))
+            return len(children) - 1
+        left, right = t
+        li = walk(left)
+        ri = walk(right)
+        children.append([li, ri])
+        leaf_ids.append(-1)
+        return len(children) - 1
+
+    root = walk(tree)
+    if len(children) > n_nodes:
+        raise ValueError(f"tree has {len(children)} nodes > {n_nodes}")
+    while len(children) < n_nodes:
+        children.append([-1, -1])
+        leaf_ids.append(-1)
+    return (np.asarray(children, np.int32), np.asarray(leaf_ids, np.int32),
+            root)
+
+
+class TreeLSTMSentiment(Module):
+    """Embedding -> BinaryTreeLSTM -> per-node classifier
+    (reference: TreeLSTMSentiment.scala's treeLSTM+Linear+LogSoftMax head).
+
+    Input: (tokens (b, seq) int32, children (b, n, 2), leaf_ids (b, n)).
+    Output: (b, n_nodes, classes) log-probs per node slot; the root is the
+    highest non-padded slot (TreeNNAccuracy reads the last slot, so pad
+    trees so the root lands last — encode_tree does when the tree fills
+    n_nodes, otherwise gather by its returned root_slot)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int, hidden_size: int,
+                 class_num: int = 5):
+        super().__init__()
+        self.embedding = nn.LookupTable(vocab_size, embed_dim)
+        self.tree_lstm = nn.BinaryTreeLSTM(embed_dim, hidden_size)
+        self.head = nn.Linear(hidden_size, class_num)
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {"embedding": self.embedding.init(k1)[0],
+                  "tree": self.tree_lstm.init(k2)[0],
+                  "head": self.head.init(k3)[0]}
+        return params, {}
+
+    def apply(self, params, state, inp, *, training=False, rng=None):
+        tokens, children, leaf_ids = inp
+        emb, _ = self.embedding.apply(params["embedding"], {}, tokens,
+                                      training=training)
+        hiddens, _ = self.tree_lstm.apply(params["tree"], {},
+                                          (emb, children, leaf_ids),
+                                          training=training)
+        logits, _ = self.head.apply(params["head"], {}, hiddens,
+                                    training=training)
+        out = jax.nn.log_softmax(logits, axis=-1)
+        return out, state

@@ -1,0 +1,77 @@
+"""ModelValidator: load a pretrained model in any supported format and
+evaluate it.
+
+Reference: example/loadmodel/{ModelValidator,AlexNet}.scala — a CLI that
+loads Caffe/Torch/BigDL models and reports top-1/top-5 on a validation set.
+Formats here: bigdl (Module.save), caffe (.caffemodel), torch (.t7), tf
+(frozen GraphDef) — all via interop/.
+
+Usage:
+    python -m bigdl_tpu.tools.model_validator \
+        --model-type caffe --model /m.caffemodel \
+        --data /data/val.bdr --batch-size 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def load_model(model_type: str, path: str):
+    if model_type == "bigdl":
+        from ..nn.module import Module
+        m = Module.load(path)
+        return m
+    if model_type == "caffe":
+        from ..interop import load_caffe
+        return load_caffe(path)[0]
+    if model_type == "torch":
+        from ..interop import load_torch_module
+        return load_torch_module(path)[0]
+    if model_type == "tf":
+        from ..interop import load_tf
+        return load_tf(path)[0]
+    raise ValueError(f"unknown model type {model_type!r}")
+
+
+def validate(model_type: str, model_path: str, data_path: str,
+             batch_size: int = 128):
+    from ..dataset import DataSet
+    from ..models.run import _load_samples
+    from ..optim import Evaluator, Top1Accuracy, Top5Accuracy
+    from ..utils.engine import Engine
+
+    Engine.init()
+    model = load_model(model_type, model_path)
+    samples = _load_samples(data_path, None)
+    results = Evaluator(model).test(DataSet.array(samples),
+                                    [Top1Accuracy(), Top5Accuracy()],
+                                    batch_size=batch_size)
+    out = {}
+    for method, res in results:
+        acc, n = res.result()
+        out[method.name] = {"accuracy": acc, "count": n}
+        print(f"{method.name}: {res}")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="load + evaluate a pretrained model "
+                    "(reference: example/loadmodel/ModelValidator.scala)")
+    ap.add_argument("--model-type", required=True,
+                    choices=("bigdl", "caffe", "torch", "tf"))
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--data", required=True, help="BDRecord path/glob")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON line instead of text")
+    args = ap.parse_args(argv)
+    out = validate(args.model_type, args.model, args.data, args.batch_size)
+    if args.json:
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""TreeLSTM sentiment example tests (reference analog: the
+example/treeLSTMSentiment workload) + ModelValidator CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import TreeLSTMSentiment, encode_tree
+from bigdl_tpu.optim import TreeNNAccuracy
+
+
+def test_encode_tree_topological():
+    #    root
+    #    /  \
+    #   .    2
+    #  / \
+    # 0   1
+    children, leaf_ids, root = encode_tree(((0, 1), 2), n_nodes=5)
+    assert children.shape == (5, 2) and leaf_ids.shape == (5,)
+    assert root == 4  # children-before-parent layout, root last
+    # every internal node's children precede it
+    for i, (l, r) in enumerate(children):
+        if l >= 0:
+            assert l < i and r < i
+    assert sorted(leaf_ids[leaf_ids >= 0]) == [0, 1, 2]
+
+
+def test_tree_sentiment_forward_and_learn():
+    model = TreeLSTMSentiment(vocab_size=20, embed_dim=8, hidden_size=6,
+                              class_num=3)
+    params, state = model.init(jax.random.key(0))
+    children, leaf_ids, root = encode_tree(((0, 1), (2, 3)), n_nodes=7)
+    tokens = np.array([[1, 2, 3, 4]], np.int32)
+    batch = (jnp.asarray(tokens),
+             jnp.asarray(children[None]), jnp.asarray(leaf_ids[None]))
+    out, _ = jax.jit(lambda p, b: model.apply(p, {}, b))(params, batch)
+    assert out.shape == (1, 7, 3)
+    # log-probs sum to 1 after exp
+    np.testing.assert_allclose(np.exp(np.asarray(out[0, root])).sum(), 1.0,
+                               rtol=1e-5)
+
+    # a few SGD steps on the root loss must decrease it
+    def loss_fn(p):
+        o, _ = model.apply(p, {}, batch)
+        return -o[0, root, 1]  # target class 1
+
+    loss0 = float(loss_fn(params))
+    g = jax.jit(jax.grad(loss_fn))
+    for _ in range(20):
+        grads = g(params)
+        params = jax.tree.map(lambda w, d: w - 0.1 * d, params, grads)
+    assert float(loss_fn(params)) < loss0
+
+
+def test_tree_nn_accuracy_on_model_output():
+    model = TreeLSTMSentiment(vocab_size=10, embed_dim=4, hidden_size=4,
+                              class_num=2)
+    params, _ = model.init(jax.random.key(1))
+    children, leaf_ids, root = encode_tree((0, 1), n_nodes=3)
+    batch = (jnp.asarray(np.array([[1, 2]], np.int32)),
+             jnp.asarray(children[None]), jnp.asarray(leaf_ids[None]))
+    out, _ = model.apply(params, {}, batch)
+    res = TreeNNAccuracy()(np.asarray(out), np.array([0.0]))
+    acc, n = res.result()
+    assert n == 1 and acc in (0.0, 1.0)
+
+
+def test_model_validator_cli(tmp_path):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.tools.model_validator import validate
+    from bigdl_tpu.utils.recordio import write_records
+
+    model = nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+    model.build()
+    mp = str(tmp_path / "m.bigdl")
+    model.save(mp)
+    rng = np.random.default_rng(0)
+    recs = [Sample(rng.standard_normal(6).astype(np.float32),
+                   np.float32(i % 3)) for i in range(32)]
+    dp = str(tmp_path / "val.bdr")
+    write_records(dp, recs)
+    out = validate("bigdl", mp, dp, batch_size=16)
+    assert out["Top1Accuracy"]["count"] == 32
+    assert 0.0 <= out["Top1Accuracy"]["accuracy"] <= 1.0
+    assert out["Top5Accuracy"]["accuracy"] >= out["Top1Accuracy"]["accuracy"]
