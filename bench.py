@@ -1,41 +1,142 @@
 """Benchmark harness: prints ONE JSON line
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N, ...}
 
-North-star (BASELINE.md): ResNet-50 ImageNet images/sec/chip.  Falls back to
-the LeNet train step if the ResNet model is not yet available.
+North-star (BASELINE.md): ResNet-50 ImageNet images/sec/chip at >=45% MFU on
+TPU v5e.  All five BASELINE.md configs are benched (resnet50, lenet,
+inception_v1, textcnn, lstm); the primary JSON line is the ResNet-50 result
+with the others embedded under "configs".
 
 The reference's throughput metric is records/second logged per iteration
 (DistriOptimizer.scala:293-297); we report the same unit for the compiled
 train step (forward + loss + backward + update) on one chip.  The step is
 built by Optimizer._build_step — the exact program real training runs.
 
-The reference publishes no numeric baselines (BASELINE.md "published: {}"),
-so vs_baseline is reported against an ESTIMATED dual-socket-Xeon BigDL
-throughput (consistent with the SoCC'19 paper's Xeon results) and the JSON
-carries "baseline_estimated": true to say so.
+MFU accounting: model FLOPs/step = 3x analytic forward FLOPs (the standard
+fwd + 2x-bwd convention), where forward FLOPs come from XLA's own
+cost_analysis() of the jitted forward pass; MFU = flops/step / step_seconds /
+peak_chip_flops (bf16 peak per detected device kind).
+
+Failure handling (round-1 verdict): backend bring-up is wrapped in a watchdog
+thread — a hung TPU init (jax.devices() blocks forever when the chip is
+unreachable) or a transient UNAVAILABLE produces a machine-readable
+{"metric": "bench_error", ..., "error": ...} JSON line, never a traceback;
+transient errors are retried with backoff.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md "published: {}");
+the primary vs_baseline is MFU / 0.45 (the BASELINE.md target) when MFU is
+computable, else images/sec over an ESTIMATED dual-socket-Xeon BigDL
+throughput (SoCC'19-paper-consistent) with "baseline_estimated": true.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-
-ESTIMATED_XEON = {
-    "resnet50": 20.0,     # img/s, ResNet-50 training on a 2-socket Xeon
-    "lenet": 10000.0,     # img/s, LeNet on MNIST
+ESTIMATED_XEON = {   # img/s (records/s) training on a 2-socket Xeon, estimated
+    "resnet50": 20.0,
+    "lenet": 10000.0,
+    "inception_v1": 30.0,
+    "textcnn": 400.0,
+    "lstm": 500.0,
 }
+MFU_TARGET = 0.45  # BASELINE.md: ResNet-50 >= 45% MFU on v5e
+
+# bf16 peak FLOP/s per *jax device* (v2/v3 devices are single cores).
+_PEAK_BF16 = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite / v5e
+    ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12),
+)
 
 
-def _bench_train_step(model, criterion, batch_shape, target_maker, lr,
-                      warmup=2, iters=10):
-    """Time the REAL compiled train step (Optimizer._build_step) on the default
-    device mesh (one chip -> 1-device mesh)."""
+def _fail(err, stage):
+    print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "error",
+                      "vs_baseline": 0.0, "stage": stage, "error": str(err)}))
+    sys.stdout.flush()
+    os._exit(1)
+
+
+def _init_backend(timeout=240, retries=3, backoff=15):
+    """Bring up the jax backend with a watchdog: jax.devices() can block
+    forever when the TPU is unreachable (round-1 rc=124 root cause), and can
+    raise transient UNAVAILABLE during chip handoff."""
+    import jax
+
+    last_err = None
+    for attempt in range(retries):
+        box = {}
+
+        def probe():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 — recorded, retried
+                box["error"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout)
+        if "devices" in box:
+            return jax, box["devices"]
+        if t.is_alive():
+            # stuck inside native backend init; in-process retry can't help
+            _fail(TimeoutError(
+                f"jax.devices() did not return within {timeout}s"), "init")
+        last_err = box.get("error")
+        if attempt < retries - 1:
+            time.sleep(backoff * (attempt + 1))
+    _fail(last_err, "init")
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" in kind or "tpu" in getattr(device, "platform", ""):
+        for key, val in _PEAK_BF16:
+            if key in kind:
+                return val
+    return None  # CPU/unknown: MFU not meaningful
+
+
+def _fwd_flops(model, batch_shape, in_dtype):
+    """Analytic forward FLOPs for one batch from XLA cost analysis.
+
+    Probed at a small batch and scaled linearly — compiling the forward
+    pass a second time at the full benchmark batch is slow and can fail on
+    memory-constrained hosts, and conv/matmul FLOPs are linear in batch."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, x):
+        out, _ = model.apply(params, model.state, x, training=False, rng=None)
+        return out
+
+    probe = min(batch_shape[0], 8)
+    shape = (probe,) + tuple(batch_shape[1:])
+    try:
+        compiled = jax.jit(fwd).lower(
+            model.params, jnp.zeros(shape, in_dtype)).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0)) if ca else 0.0
+        return f * (batch_shape[0] / probe) if f > 0 else None
+    except Exception:  # noqa: BLE001 — flops are best-effort metadata
+        return None
+
+
+def _bench_config(name, build, warmup=2, iters=10):
+    """Time the REAL compiled train step (Optimizer._build_step) on a 1-chip
+    mesh; returns images/sec + flops/step + mfu."""
+    import jax
+    import jax.numpy as jnp
+
     from bigdl_tpu.optim import Optimizer, SGD, Trigger
     from bigdl_tpu.utils.engine import Engine
 
+    model, criterion, inp, tgt, lr = build()
     Engine.reset()
     Engine.init()
     mesh = Engine.mesh()
@@ -49,8 +150,6 @@ def _bench_train_step(model, criterion, batch_shape, target_maker, lr,
     params = jax.device_put(model.params, param_sh)
     net_state = model.state
     opt_state = opt.optim_method.init_state(params)
-    inp = jnp.zeros(batch_shape, jnp.float32)
-    tgt = target_maker(batch_shape[0])
     lr_arr, rng = jnp.float32(lr), jax.random.key(1)
 
     def run():
@@ -59,53 +158,145 @@ def _bench_train_step(model, criterion, batch_shape, target_maker, lr,
             params, net_state, opt_state, inp, tgt, lr_arr, rng)
         return loss
 
-    for _ in range(warmup):
-        jax.block_until_ready(run())
+    t0 = time.perf_counter()
+    jax.block_until_ready(run())
+    compile_s = time.perf_counter() - t0
+    for _ in range(max(warmup - 1, 0)):
+        run()
+    jax.block_until_ready(params)
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = run()
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / iters
-    return batch_shape[0] / dt
+
+    batch = inp.shape[0]
+    fwd = _fwd_flops(model, inp.shape, inp.dtype)
+    flops_step = 3.0 * fwd if fwd else None
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (flops_step / dt / peak) if (flops_step and peak) else None
+    return {"name": name, "images_per_sec": round(batch / dt, 2),
+            "step_seconds": round(dt, 6), "batch_size": batch,
+            "compile_seconds": round(compile_s, 2),
+            "model_flops_per_step": flops_step,
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "vs_estimated_xeon": round(batch / dt / ESTIMATED_XEON[name], 2)}
 
 
-def bench_resnet50(warmup=2, iters=10):
+# ---------------------------------------------------------------- configs
+
+
+def _cfg_resnet50():
+    import jax.numpy as jnp
     from bigdl_tpu.models.resnet import ResNet
     from bigdl_tpu.nn import CrossEntropyCriterion
-
-    batch = 32
-    ips = _bench_train_step(
-        ResNet(50, class_num=1000, dataset="imagenet"),
-        CrossEntropyCriterion(), (batch, 224, 224, 3),
-        lambda b: jnp.ones((b,), jnp.int32), lr=0.1,
-        warmup=warmup, iters=iters)
-    return {"metric": "resnet50_train_images_per_sec_per_chip",
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / ESTIMATED_XEON["resnet50"], 2),
-            "baseline_estimated": True}
+    b = 64
+    return (ResNet(50, class_num=1000, dataset="imagenet"),
+            CrossEntropyCriterion(),
+            jnp.zeros((b, 224, 224, 3), jnp.float32),
+            jnp.ones((b,), jnp.int32), 0.1)
 
 
-def bench_lenet(warmup=2, iters=10):
+def _cfg_lenet():
+    import jax.numpy as jnp
     from bigdl_tpu.models.lenet import LeNet5
     from bigdl_tpu.nn import ClassNLLCriterion
-
-    batch = 512
-    ips = _bench_train_step(
-        LeNet5(10), ClassNLLCriterion(), (batch, 28, 28, 1),
-        lambda b: jnp.ones((b,), jnp.int32), lr=0.05,
-        warmup=warmup, iters=iters)
-    return {"metric": "lenet_train_images_per_sec_per_chip",
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / ESTIMATED_XEON["lenet"], 2),
-            "baseline_estimated": True}
+    b = 512
+    return (LeNet5(10), ClassNLLCriterion(),
+            jnp.zeros((b, 28, 28, 1), jnp.float32),
+            jnp.ones((b,), jnp.int32), 0.05)
 
 
-def main():
-    try:
-        result = bench_resnet50()
-    except ImportError:
-        result = bench_lenet()
-    print(json.dumps(result))
+def _cfg_inception_v1():
+    import jax.numpy as jnp
+    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion
+    b = 64
+    return (Inception_v1_NoAuxClassifier(1000), ClassNLLCriterion(),
+            jnp.zeros((b, 224, 224, 3), jnp.float32),
+            jnp.ones((b,), jnp.int32), 0.1)
+
+
+def _cfg_textcnn():
+    import jax.numpy as jnp
+    from bigdl_tpu.models.textclassifier import TextClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion
+    b = 128
+    return (TextClassifier(20), ClassNLLCriterion(),
+            jnp.zeros((b, 500, 200), jnp.float32),
+            jnp.ones((b,), jnp.int32), 0.05)
+
+
+def _cfg_lstm():
+    import jax.numpy as jnp
+    from bigdl_tpu.models.rnn import PTBModel
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    b, t = 64, 35
+    return (PTBModel(vocab_size=10000, embed_size=200, hidden_size=200),
+            TimeDistributedCriterion(ClassNLLCriterion(), size_average=True),
+            jnp.zeros((b, t), jnp.int32),
+            jnp.ones((b, t), jnp.int32), 0.1)
+
+
+CONFIGS = {"resnet50": _cfg_resnet50, "lenet": _cfg_lenet,
+           "inception_v1": _cfg_inception_v1, "textcnn": _cfg_textcnn,
+           "lstm": _cfg_lstm}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", nargs="*", default=list(CONFIGS),
+                    choices=list(CONFIGS))
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) for local testing; "
+                         "env vars are too late under this image's "
+                         "sitecustomize, jax.config still works")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax as _jax
+        try:
+            _jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+    jax, devices = _init_backend()
+    results, errors = {}, {}
+    for name in args.configs:
+        try:
+            results[name] = _bench_config(name, CONFIGS[name],
+                                          warmup=args.warmup,
+                                          iters=args.iters)
+        except Exception as e:  # noqa: BLE001 — recorded per config
+            errors[name] = f"{type(e).__name__}: {e}"
+
+    primary = results.get("resnet50") or next(iter(results.values()), None)
+    if primary is None:
+        _fail("; ".join(f"{k}: {v}" for k, v in errors.items()) or
+              "no configs ran", "bench")
+
+    mfu = primary.get("mfu")
+    if mfu is not None and primary["name"] == "resnet50":
+        # the >=45%-MFU target is the ResNet-50 north star (BASELINE.md)
+        vs_baseline = round(mfu / MFU_TARGET, 3)
+        baseline_estimated = False
+    else:
+        vs_baseline = round(
+            primary["images_per_sec"] / ESTIMATED_XEON[primary["name"]], 2)
+        baseline_estimated = True
+    out = {"metric": f"{primary['name']}_train_images_per_sec_per_chip",
+           "value": primary["images_per_sec"], "unit": "images/sec",
+           "vs_baseline": vs_baseline,
+           "baseline_estimated": baseline_estimated,
+           "mfu": mfu, "mfu_target": MFU_TARGET,
+           "model_flops_per_step": primary["model_flops_per_step"],
+           "device": str(devices[0]),
+           "device_kind": getattr(devices[0], "device_kind", "unknown"),
+           "configs": results}
+    if errors:
+        out["config_errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -259,6 +259,13 @@ def load_torch_module(path: str):
     (module, params_list) like the caffe/tf loaders."""
     obj = load_t7(path)
     from .. import nn as N
+    from .caffe import _fc_cols_chw_to_hwc
+
+    # Torch activations are NCHW; ours are NHWC.  Track channels so FC
+    # weights crossing a conv->flatten boundary get their columns permuted
+    # from (C,H,W) to (H,W,C) order, and 3-D reshapes get transposed
+    # (round-1 advisor finding — mirrors the CaffeLoader handling).
+    ctx = {"ch": None, "spatial": False, "flat_ch": None}
 
     def convert(o):
         cls = o.get("__torch_class__", "") if isinstance(o, dict) else ""
@@ -274,10 +281,14 @@ def load_torch_module(path: str):
         if cls == "nn.Linear":
             w = np.asarray(o["weight"], np.float32)
             b = o.get("bias")
+            c = ctx["flat_ch"]
+            if c and w.shape[1] % c == 0:
+                w = _fc_cols_chw_to_hwc(w, c)
             m = N.Linear(w.shape[1], w.shape[0], with_bias=b is not None)
             p = {"weight": w}
             if b is not None:
                 p["bias"] = np.asarray(b, np.float32).reshape(-1)
+            ctx.update(ch=w.shape[0], spatial=False, flat_ch=None)
             return m, p
         if cls in ("nn.SpatialConvolution", "nn.SpatialConvolutionMM"):
             n_out = int(o["nOutputPlane"])
@@ -293,6 +304,7 @@ def load_torch_module(path: str):
             p = {"weight": np.transpose(w, (2, 3, 1, 0))}
             if b is not None:
                 p["bias"] = np.asarray(b, np.float32).reshape(-1)
+            ctx.update(ch=n_out, spatial=True)
             return m, p
         if cls == "nn.SpatialMaxPooling":
             m = N.SpatialMaxPooling(int(o["kW"]), int(o["kH"]),
@@ -319,6 +331,13 @@ def load_torch_module(path: str):
             size = o.get("size")
             dims = [int(s) for s in np.asarray(size).ravel()] \
                 if size is not None else [-1]
+            if len(dims) == 3:  # torch (C,H,W) -> our NHWC (H,W,C)
+                c, h, w_ = dims
+                ctx.update(ch=c, spatial=True)
+                return N.Reshape((h, w_, c)), {}
+            if ctx["spatial"]:
+                ctx["flat_ch"] = ctx["ch"]
+            ctx["spatial"] = False
             return N.Reshape(tuple(dims)), {}
         raise ValueError(f"load_torch_module: unsupported class {cls!r}")
 
@@ -339,6 +358,9 @@ def save_torch_module(module, params, path: str) -> None:
     """Serialize a bigdl_tpu module as a Lua-Torch nn object tree
     (reference: Module.saveTorch via TorchFile.save)."""
     from .. import nn as N
+    from .caffe import _fc_cols_hwc_to_chw
+
+    ctx = {"ch": None, "spatial": False, "flat_ch": None}
 
     def convert(mod, p):
         cls = type(mod).__name__
@@ -347,10 +369,15 @@ def save_torch_module(module, params, path: str) -> None:
                     "modules": [convert(m, pp)
                                 for m, pp in zip(mod.modules, p)]}
         if isinstance(mod, N.Linear):
-            o = {"__torch_class__": "nn.Linear",
-                 "weight": np.asarray(p["weight"], np.float32)}
+            w = np.asarray(p["weight"], np.float32)
+            c = ctx["flat_ch"]
+            if c and w.shape[1] % c == 0:
+                # our columns are NHWC-flat (H,W,C); torch wants (C,H,W)
+                w = _fc_cols_hwc_to_chw(w, c)
+            o = {"__torch_class__": "nn.Linear", "weight": w}
             if "bias" in p:
                 o["bias"] = np.asarray(p["bias"], np.float32)
+            ctx.update(ch=mod.output_size, spatial=False, flat_ch=None)
             return o
         if isinstance(mod, N.SpatialConvolution):
             kh, kw = mod.kernel
@@ -372,6 +399,7 @@ def save_torch_module(module, params, path: str) -> None:
                  "padW": pw, "padH": ph, "weight": w}
             if "bias" in p:
                 o["bias"] = np.asarray(p["bias"], np.float32)
+            ctx.update(ch=mod.n_output_plane, spatial=True)
             return o
         if isinstance(mod, N.SpatialMaxPooling):
             kh, kw = mod.kernel
@@ -389,8 +417,18 @@ def save_torch_module(module, params, path: str) -> None:
         if isinstance(mod, N.Dropout):
             return {"__torch_class__": "nn.Dropout", "p": mod.p}
         if isinstance(mod, (N.Reshape, N.View)):
+            size = tuple(getattr(mod, "size", None)
+                         or getattr(mod, "sizes", ()))
+            if len(size) == 3:  # our NHWC (H,W,C) -> torch (C,H,W)
+                h, w_, c = size
+                ctx.update(ch=c, spatial=True)
+                return {"__torch_class__": "nn.Reshape",
+                        "size": np.asarray((c, h, w_), np.int64)}
+            if ctx["spatial"]:
+                ctx["flat_ch"] = ctx["ch"]
+            ctx["spatial"] = False
             return {"__torch_class__": "nn.Reshape",
-                    "size": np.asarray(mod.size, np.int64)}
+                    "size": np.asarray(size, np.int64)}
         raise ValueError(f"save_torch_module: unsupported {cls}")
 
     save_t7(convert(module, params), path)

@@ -30,7 +30,11 @@ def encode_tree(tree, n_nodes: int) -> Tuple[np.ndarray, np.ndarray, int]:
     pair (left_subtree, right_subtree).  Output arrays are padded to
     `n_nodes` slots with -1 rows; nodes are laid out children-before-parent
     so BinaryTreeLSTM's scan sees ready children (the reference walked the
-    object graph recursively instead)."""
+    object graph recursively instead).  The root ALWAYS lands in the final
+    slot (`root_slot == n_nodes - 1`): padding rows are inserted *before*
+    it, so TreeNNAccuracy's read-the-last-slot convention (reference
+    ValidationMethod.scala:118 reads a fixed slot) holds for every tree
+    size, not just trees that exactly fill `n_nodes`."""
     children: List[List[int]] = []
     leaf_ids: List[int] = []
 
@@ -46,14 +50,20 @@ def encode_tree(tree, n_nodes: int) -> Tuple[np.ndarray, np.ndarray, int]:
         leaf_ids.append(-1)
         return len(children) - 1
 
-    root = walk(tree)
+    walk(tree)
     if len(children) > n_nodes:
         raise ValueError(f"tree has {len(children)} nodes > {n_nodes}")
-    while len(children) < n_nodes:
+    # pad BEFORE the root so the root occupies the last slot; pad rows are
+    # no-op leaves the scan processes before the root, which only depends on
+    # earlier real slots
+    root_row, root_leaf = children.pop(), leaf_ids.pop()
+    while len(children) < n_nodes - 1:
         children.append([-1, -1])
         leaf_ids.append(-1)
+    children.append(root_row)
+    leaf_ids.append(root_leaf)
     return (np.asarray(children, np.int32), np.asarray(leaf_ids, np.int32),
-            root)
+            n_nodes - 1)
 
 
 class TreeLSTMSentiment(Module):
@@ -61,10 +71,9 @@ class TreeLSTMSentiment(Module):
     (reference: TreeLSTMSentiment.scala's treeLSTM+Linear+LogSoftMax head).
 
     Input: (tokens (b, seq) int32, children (b, n, 2), leaf_ids (b, n)).
-    Output: (b, n_nodes, classes) log-probs per node slot; the root is the
-    highest non-padded slot (TreeNNAccuracy reads the last slot, so pad
-    trees so the root lands last — encode_tree does when the tree fills
-    n_nodes, otherwise gather by its returned root_slot)."""
+    Output: (b, n_nodes, classes) log-probs per node slot; the root is
+    always the LAST slot (encode_tree pads before the root), matching
+    TreeNNAccuracy's fixed-slot read."""
 
     def __init__(self, vocab_size: int, embed_dim: int, hidden_size: int,
                  class_num: int = 5):

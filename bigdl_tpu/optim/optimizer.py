@@ -441,8 +441,11 @@ class Optimizer:
                     if ptrig is not None and ptrig(state):
                         for kp, leaf in jax.tree_util.tree_flatten_with_path(
                                 params)[0]:
-                            name = jax.tree_util.keystr(kp).strip("'[]").replace(
-                                "']['", "/")
+                            name = "/".join(
+                                str(getattr(k, "key",
+                                            getattr(k, "idx",
+                                                    getattr(k, "name", k))))
+                                for k in kp)
                             # multi-host: leaves sharded across processes are
                             # not host-fetchable directly
                             if (hasattr(leaf, "is_fully_addressable")

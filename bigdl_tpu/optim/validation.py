@@ -169,23 +169,34 @@ class NDCG(ValidationMethod):
 
 class TreeNNAccuracy(ValidationMethod):
     """Accuracy read at the tree ROOT node's prediction
-    (reference: ValidationMethod.scala:118 TreeNNAccuracy — output is the
-    per-node (batch, nodes, classes) tensor from BinaryTreeLSTM's head; the
-    root is the LAST node slot in topological order)."""
+    (reference: ValidationMethod.scala:118 TreeNNAccuracy reads a FIXED
+    slot — output is the per-node (batch, nodes, classes) tensor from
+    BinaryTreeLSTM's head).  Here the fixed slot is the LAST one:
+    models.encode_tree always places the root there, padding variable-size
+    trees *before* the root so the convention holds for every tree size.
+    For layouts that don't follow it, pass per-example `root_slot` indices
+    (encode_tree returns them)."""
 
     name = "TreeNNAccuracy"
 
     def __init__(self, one_based: bool = False):
         self.one_based = one_based
 
-    def __call__(self, output, target):
+    def __call__(self, output, target, root_slot=None):
         o = np.asarray(output)
         t = np.asarray(target)
+        if root_slot is not None:
+            rs = np.asarray(root_slot).reshape(-1).astype(np.int64)
+        else:
+            rs = None
         if t.ndim >= 2 and t.shape[1] > 1:  # per-node labels: take the root
-            t = t[:, -1]
+            t = t[np.arange(len(t)), rs] if rs is not None else t[:, -1]
         t = t.reshape(len(o)).astype(np.int64)
         if self.one_based:
             t = t - 1
-        root = o[:, -1, :] if o.ndim == 3 else o
+        if o.ndim == 3:
+            root = o[np.arange(len(o)), rs, :] if rs is not None else o[:, -1, :]
+        else:
+            root = o
         pred = np.argmax(root, axis=-1)
         return AccuracyResult(float(np.sum(pred == t)), len(t))

@@ -273,3 +273,162 @@ def test_t7_read_by_torch_if_available(tmp_path):
     got = torchfile_mod.load(p)
     np.testing.assert_array_equal(got[b"w"], arr)
     assert got[b"n"] == 3
+
+
+# ------------------------------------------- caffe depth (round-2 additions)
+
+def test_caffe_fc_layout_semantics():
+    """The FC column permutation must match real caffe semantics: caffe
+    flattens NCHW (C,H,W); ours flattens NHWC (H,W,C).  W_caffe applied to
+    a CHW-flat vector must equal the permuted weight applied to the
+    HWC-flat vector (round-1 advisor finding)."""
+    from bigdl_tpu.interop.caffe import _fc_cols_chw_to_hwc, _fc_cols_hwc_to_chw
+    rng = np.random.default_rng(0)
+    C, H, W, out = 3, 4, 5, 7
+    x = rng.standard_normal((C, H, W)).astype(np.float32)
+    w_caffe = rng.standard_normal((out, C * H * W)).astype(np.float32)
+    y_caffe = w_caffe @ x.reshape(-1)                      # CHW flatten
+    w_ours = _fc_cols_chw_to_hwc(w_caffe, C)
+    y_ours = w_ours @ x.transpose(1, 2, 0).reshape(-1)     # HWC flatten
+    np.testing.assert_allclose(y_ours, y_caffe, rtol=1e-5)
+    np.testing.assert_allclose(_fc_cols_hwc_to_chw(w_ours, C), w_caffe)
+
+
+def test_caffe_lenet_roundtrip(tmp_path):
+    """LeNet crosses a conv->Flatten->InnerProduct boundary with H*W > 1,
+    so forward parity proves the FC layout permutation end-to-end."""
+    from bigdl_tpu.models.lenet import LeNet5
+    m = LeNet5(10)
+    m.build(jax.random.key(4))
+    path = str(tmp_path / "lenet.caffemodel")
+    save_caffe(m, m.params, path, state=m.state)
+    loaded, lparams = load_caffe(path)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 28, 28, 1)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_caffe_resnet_roundtrip(tmp_path):
+    """ResNet-20/CIFAR: BatchNorm+Scale fold, ConcatTable->Eltwise residual
+    branches, type-A shortcut (Concat + Power-as-MulConstant), pooling
+    (reference: LayerConverter.scala's BN/Scale/Eltwise converters)."""
+    from bigdl_tpu.models.resnet import ResNet
+    m = ResNet(20, class_num=10, dataset="cifar10")
+    m.build(jax.random.key(5))
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((2, 32, 32, 3)),
+                    jnp.float32)
+    # one training forward moves BN running stats off their init values so
+    # the round-trip actually carries information
+    _, trained_state = m.apply(m.params, m.state, x, training=True,
+                               rng=jax.random.key(6))
+    m.attach(m.params, trained_state)
+    path = str(tmp_path / "resnet20.caffemodel")
+    save_caffe(m, m.params, path, state=m.state)
+    loaded, lparams = load_caffe(path)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_caffe_inception_roundtrip(tmp_path):
+    """Inception-v1 (no aux): LRN, ceil-mode pooling, Concat towers,
+    Dropout, global 7x7 avgpool + classifier."""
+    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+    m = Inception_v1_NoAuxClassifier(10)
+    m.build(jax.random.key(7))
+    path = str(tmp_path / "inception.caffemodel")
+    save_caffe(m, m.params, path, state=m.state)
+    loaded, lparams = load_caffe(path)
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((1, 224, 224, 3)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_caffe_deconv_eltwise_max_roundtrip(tmp_path):
+    m = (nn.Sequential()
+         .add(nn.SpatialFullConvolution(3, 6, 3, 3, 2, 2, 1, 1))
+         .add(nn.ReLU())
+         .add(nn.ConcatTable()
+              .add(nn.SpatialConvolution(6, 4, 1, 1))
+              .add(nn.SpatialConvolution(6, 4, 1, 1)))
+         .add(nn.CMaxTable()))
+    m.build(jax.random.key(8))
+    path = str(tmp_path / "deconv.caffemodel")
+    save_caffe(m, m.params, path, state=m.state)
+    loaded, lparams = load_caffe(path)
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((2, 6, 6, 3)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_caffe_standalone_scale_power(tmp_path):
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1))
+         .add(nn.Scale((4,)))
+         .add(nn.Power(2.0, 0.5, 1.0)))
+    m.build(jax.random.key(9))
+    path = str(tmp_path / "scale.caffemodel")
+    save_caffe(m, m.params, path, state=m.state)
+    loaded, lparams = load_caffe(path)
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((2, 5, 5, 3)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_caffe_unsupported_raises_unless_permissive(tmp_path, mlp):
+    model, params, state = mlp
+    path = str(tmp_path / "unk.caffemodel")
+    save_caffe(model, params, path)
+    from bigdl_tpu.interop.caffe import CaffeLoader
+    loader = CaffeLoader(path)
+    loader.layers[0].type = "MVN"  # a type we do not convert
+    with pytest.raises(ValueError):
+        loader.build()
+    loader2 = CaffeLoader(path, permissive=True)
+    loader2.layers[0].type = "MVN"
+    loader2.build()  # maps to Identity with a warning
+
+
+def test_torch_lenet_roundtrip(tmp_path):
+    """LeNet through the .t7 codec: exercises the NCHW (C,H,W) <-> NHWC
+    (H,W,C) FC-column permutation and 3-D reshape transposition."""
+    from bigdl_tpu.interop.torchfile import (load_torch_module,
+                                             save_torch_module)
+    from bigdl_tpu.models.lenet import LeNet5
+    m = LeNet5(10)
+    m.build(jax.random.key(10))
+    path = str(tmp_path / "lenet.t7")
+    save_torch_module(m, m.params, path)
+    loaded, lparams = load_torch_module(path)
+    x = jnp.asarray(np.random.default_rng(10).standard_normal((2, 28, 28, 1)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_caffe_load_then_save_roundtrip(tmp_path):
+    """load_caffe returns a Graph; persisting that Graph again must work
+    (load -> modify -> save is the reference CaffePersister use case)."""
+    from bigdl_tpu.models.lenet import LeNet5
+    m = LeNet5(10)
+    m.build(jax.random.key(11))
+    p1 = str(tmp_path / "l1.caffemodel")
+    save_caffe(m, m.params, p1, state=m.state)
+    g, gp = load_caffe(p1)
+    p2 = str(tmp_path / "l2.caffemodel")
+    save_caffe(g, gp, p2, state=g.state)
+    g2, gp2 = load_caffe(p2)
+    x = jnp.asarray(np.random.default_rng(11).standard_normal((2, 28, 28, 1)),
+                    jnp.float32)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(g2, gp2, g2.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
