@@ -432,3 +432,160 @@ def test_caffe_load_then_save_roundtrip(tmp_path):
     ref = _forward(m, m.params, m.state, x)
     got = _forward(g2, gp2, g2.state, x)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------ tf depth (round-2 additions)
+
+def test_tf_batchnorm_roundtrip(tmp_path):
+    """FusedBatchNormV3 save/load with running stats."""
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, -1, -1))
+         .add(nn.SpatialBatchNormalization(8))
+         .add(nn.ReLU()))
+    m.build(jax.random.key(12))
+    x = jnp.asarray(np.random.default_rng(12).standard_normal((2, 8, 8, 3)),
+                    jnp.float32)
+    _, st = m.apply(m.params, m.state, x, training=True,
+                    rng=jax.random.key(13))
+    m.attach(m.params, st)
+    path = str(tmp_path / "bn.pb")
+    save_tf(m, m.params, path, state=m.state)
+    loaded, lparams = load_tf(path)
+    ref = _forward(m, m.params, m.state, x)
+    got = _forward(loaded, lparams, loaded.state, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_tf_decomposed_bn_const_folding(tmp_path):
+    """A frozen decomposed BatchNorm (Mul/Add over Rsqrt(var+eps) const
+    arithmetic) must import via constant folding and match numpy
+    (reference: TensorflowToBigDL.scala's BN patterns)."""
+    from bigdl_tpu.interop.tensorflow import (_const_node, _node_def,
+                                              load_tf as _load)
+    from bigdl_tpu.utils import pbwire
+    rng = np.random.default_rng(13)
+    c = 5
+    gamma = rng.standard_normal(c).astype(np.float32)
+    beta = rng.standard_normal(c).astype(np.float32)
+    mean = rng.standard_normal(c).astype(np.float32)
+    var = np.abs(rng.standard_normal(c)).astype(np.float32) + 0.5
+    eps = np.float32(1e-3)
+    out = bytearray()
+    out += _node_def("input", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _const_node("var", var)
+    out += _const_node("eps", np.array([eps], np.float32))
+    out += _const_node("gamma", gamma)
+    out += _const_node("beta", beta)
+    out += _const_node("mean", mean)
+    out += _node_def("add_eps", "Add", ["var", "eps"])
+    out += _node_def("rsqrt", "Rsqrt", ["add_eps"])
+    out += _node_def("scale", "Mul", ["rsqrt", "gamma"])
+    out += _node_def("scaled", "Mul", ["input", "scale"])
+    out += _node_def("mean_scale", "Mul", ["mean", "scale"])
+    out += _node_def("offset", "Sub", ["beta", "mean_scale"])
+    out += _node_def("output", "Add", ["scaled", "offset"])
+    path = str(tmp_path / "dbn.pb")
+    with open(path, "wb") as f:
+        f.write(out)
+    loaded, lparams = _load(path)
+    x = rng.standard_normal((2, 4, 4, c)).astype(np.float32)
+    got = _forward(loaded, lparams, loaded.state, jnp.asarray(x))
+    scale = gamma / np.sqrt(var + eps)
+    want = x * scale + (beta - mean * scale)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_concat_axis(tmp_path):
+    """ConcatV2 must honor its axis input (round-1 advisor: it was ignored
+    and always joined on -1)."""
+    from bigdl_tpu.interop.tensorflow import _const_node, _node_def
+    from bigdl_tpu.utils import pbwire
+    out = bytearray()
+    out += _node_def("input", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _node_def("r", "Relu", ["input"])
+    out += _const_node("axis", np.array(1, np.int32), 3)
+    out += _node_def("cat", "ConcatV2", ["input", "r", "axis"],
+                     {"N": pbwire.field_varint(3, 2)})
+    path = str(tmp_path / "cat.pb")
+    with open(path, "wb") as f:
+        f.write(out)
+    loaded, lparams = load_tf(path)
+    x = np.random.default_rng(14).standard_normal((2, 3, 4, 5)).astype(
+        np.float32)
+    got = _forward(loaded, lparams, loaded.state, jnp.asarray(x))
+    want = np.concatenate([x, np.maximum(x, 0)], axis=1)  # height concat
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_tf_unrolled_lstm_cell_import(tmp_path):
+    """A BasicLSTMCell-style op graph (ConcatV2/MatMul/BiasAdd/Split/
+    Sigmoid/Tanh/Mul/Add) imports as raw ops and computes a correct LSTM
+    step (reference: TensorflowToBigDL.scala's LSTM subgraph pattern)."""
+    from bigdl_tpu.interop.tensorflow import _const_node, _node_def
+    from bigdl_tpu.utils import pbwire
+    rng = np.random.default_rng(15)
+    n_in, n_hid, b = 3, 4, 2
+    W = rng.standard_normal((n_in + n_hid, 4 * n_hid)).astype(np.float32)
+    bias = rng.standard_normal(4 * n_hid).astype(np.float32)
+    x = rng.standard_normal((b, n_in)).astype(np.float32)
+    h = rng.standard_normal((b, n_hid)).astype(np.float32)
+    c = rng.standard_normal((b, n_hid)).astype(np.float32)
+
+    out = bytearray()
+    out += _node_def("x", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _node_def("h", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _node_def("c", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _const_node("axis1", np.array(1, np.int32), 3)
+    out += _node_def("xh", "ConcatV2", ["x", "h", "axis1"],
+                     {"N": pbwire.field_varint(3, 2)})
+    out += _const_node("W", W)
+    out += _const_node("bvec", bias)
+    out += _node_def("gates0", "MatMul", ["xh", "W"])
+    out += _node_def("gates", "BiasAdd", ["gates0", "bvec"])
+    out += _const_node("axis_s", np.array(1, np.int32), 3)
+    out += _node_def("split", "Split", ["axis_s", "gates"],
+                     {"num_split": pbwire.field_varint(3, 4)})
+    # TF BasicLSTMCell order: i, j (candidate), f, o
+    out += _node_def("ig", "Sigmoid", ["split:0"])
+    out += _node_def("jg", "Tanh", ["split:1"])
+    out += _node_def("fg", "Sigmoid", ["split:2"])
+    out += _node_def("og", "Sigmoid", ["split:3"])
+    out += _node_def("fc", "Mul", ["fg", "c"])
+    out += _node_def("ij", "Mul", ["ig", "jg"])
+    out += _node_def("c_new", "Add", ["fc", "ij"])
+    out += _node_def("c_act", "Tanh", ["c_new"])
+    out += _node_def("h_new", "Mul", ["og", "c_act"])
+    path = str(tmp_path / "lstm.pb")
+    with open(path, "wb") as f:
+        f.write(out)
+    loaded, lparams = load_tf(path, outputs="h_new")
+    got = _forward(loaded, lparams, loaded.state,
+                   [jnp.asarray(x), jnp.asarray(h), jnp.asarray(c)])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    gates = np.concatenate([x, h], 1) @ W + bias
+    i_, j_, f_, o_ = np.split(gates, 4, axis=1)
+    c_new = sig(f_) * c + sig(i_) * np.tanh(j_)
+    want = sig(o_) * np.tanh(c_new)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_unsupported_raises_unless_permissive(tmp_path):
+    from bigdl_tpu.interop.tensorflow import _node_def
+    from bigdl_tpu.utils import pbwire
+    out = bytearray()
+    out += _node_def("input", "Placeholder", [],
+                     {"dtype": pbwire.field_varint(6, 1)})
+    out += _node_def("w", "WeirdOp", ["input"])
+    path = str(tmp_path / "weird.pb")
+    with open(path, "wb") as f:
+        f.write(out)
+    with pytest.raises(ValueError):
+        load_tf(path)
+    loaded, _ = load_tf(path, permissive=True)
