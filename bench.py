@@ -11,21 +11,31 @@ The reference's throughput metric is records/second logged per iteration
 train step (forward + loss + backward + update) on one chip.  The step is
 built by Optimizer._build_step — the exact program real training runs.
 
-MFU accounting: model FLOPs/step = 3x analytic forward FLOPs (the standard
-fwd + 2x-bwd convention), where forward FLOPs come from XLA's own
-cost_analysis() of the jitted forward pass; MFU = flops/step / step_seconds /
-peak_chip_flops (bf16 peak per detected device kind).
+Timing methodology (round-3 fix for the round-2 MFU>1 scandal)
+--------------------------------------------------------------
+On this image's tunneled TPU backend, `jax.block_until_ready` returns
+WITHOUT waiting for device execution — only a host fetch of result bytes
+actually synchronizes (measured: an 8192^3 bf16 matmul "completed" in 22us
+= 50 PFLOP/s under block_until_ready; fetching the result took the
+physically-sensible time).  Every timing here therefore:
+  1. drains the dispatch queue with a host fetch,
+  2. enqueues n chained steps (step i consumes step i-1's params, so nothing
+     can be elided or reordered), fetches a scalar from the last output, and
+  3. DIFFERENCES two chain lengths: dt = (T(n2) - T(n1)) / (n2 - n1),
+     cancelling the constant fetch/tunnel round-trip overhead.
+A per-step fully-synced timing is also reported (`step_seconds_sync`) as a
+cross-check; it upper-bounds dt by one tunnel RTT.
 
-Failure handling (round-1 verdict): backend bring-up is wrapped in a watchdog
-thread — a hung TPU init (jax.devices() blocks forever when the chip is
-unreachable) or a transient UNAVAILABLE produces a machine-readable
-{"metric": "bench_error", ..., "error": ...} JSON line, never a traceback;
-transient errors are retried with backoff.
+MFU accounting: model FLOPs/step counted analytically from the jaxpr of the
+*actual train step* (fwd + bwd + update; `bigdl_tpu.utils.flops`), with XLA's
+`compiled.cost_analysis()` as a cross-check.  The peak-FLOP/s denominator is
+max(device-kind table, measured bf16-matmul roofline) — a harness whose
+denominator yields MFU > 1 refuses to report that MFU (emits `mfu_error`
+diagnostics instead).
 
-vs_baseline: the reference publishes no numbers (BASELINE.md "published: {}");
-the primary vs_baseline is MFU / 0.45 (the BASELINE.md target) when MFU is
-computable, else images/sec over an ESTIMATED dual-socket-Xeon BigDL
-throughput (SoCC'19-paper-consistent) with "baseline_estimated": true.
+vs_baseline: the reference publishes no numbers (BASELINE.md "published: {}").
+vs_baseline = MFU / 0.45 (the BASELINE.md target) when ResNet-50 MFU is
+measurable, else null — never an invented constant.
 """
 
 from __future__ import annotations
@@ -37,13 +47,6 @@ import sys
 import threading
 import time
 
-ESTIMATED_XEON = {   # img/s (records/s) training on a 2-socket Xeon, estimated
-    "resnet50": 20.0,
-    "lenet": 10000.0,
-    "inception_v1": 30.0,
-    "textcnn": 400.0,
-    "lstm": 500.0,
-}
 MFU_TARGET = 0.45  # BASELINE.md: ResNet-50 >= 45% MFU on v5e
 
 # bf16 peak FLOP/s per *jax device* (v2/v3 devices are single cores).
@@ -53,9 +56,13 @@ _PEAK_BF16 = (
 )
 
 
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 def _fail(err, stage):
     print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "error",
-                      "vs_baseline": 0.0, "stage": stage, "error": str(err)}))
+                      "vs_baseline": None, "stage": stage, "error": str(err)}))
     sys.stdout.flush()
     os._exit(1)
 
@@ -91,43 +98,121 @@ def _init_backend(timeout=240, retries=3, backoff=15):
     _fail(last_err, "init")
 
 
-def _peak_flops(device):
+def _table_peak_flops(device):
     kind = getattr(device, "device_kind", "").lower()
     if "tpu" in kind or "tpu" in getattr(device, "platform", ""):
         for key, val in _PEAK_BF16:
             if key in kind:
                 return val
-    return None  # CPU/unknown: MFU not meaningful
+    return None  # CPU/unknown: no table entry
 
 
-def _fwd_flops(model, batch_shape, in_dtype):
-    """Analytic forward FLOPs for one batch from XLA cost analysis.
+def _fetch_scalar(x):
+    """Force completion of everything `x` depends on via a host byte fetch."""
+    import numpy as np
+    while isinstance(x, (list, tuple)):
+        x = x[0]
+    flat = x.ravel() if getattr(x, "ndim", 0) else x
+    return float(np.asarray(flat[0] if getattr(flat, "ndim", 0) else flat))
 
-    Probed at a small batch and scaled linearly — compiling the forward
-    pass a second time at the full benchmark batch is slow and can fail on
-    memory-constrained hosts, and conv/matmul FLOPs are linear in batch."""
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def _measure_chain(run, n1=4, n2=16, reps=3):
+    """Differenced chained timing; returns (dt_seconds, details dict)."""
+    _fetch_scalar(run())  # drain queue + any lazy backend state
+    times = {}
+    for n in (n1, n2):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = run()
+            _fetch_scalar(out)
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    dt = (times[n2] - times[n1]) / (n2 - n1)
+    overhead = max(times[n1] - n1 * dt, 0.0)
+    return dt, {"n1": n1, "n2": n2, "t_n1": round(times[n1], 6),
+                "t_n2": round(times[n2], 6),
+                "fixed_overhead_seconds": round(overhead, 6)}
+
+
+def _measure_sync(run, iters=6):
+    """Per-step fetch-synced timing (includes one tunnel RTT per step)."""
+    _fetch_scalar(run())
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _fetch_scalar(run())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def _measure_roofline(n=8192):
+    """Measured bf16 matmul FLOP/s on device 0 — the empirical peak used to
+    calibrate the MFU denominator (round-2 verdict: a device-kind string
+    table alone produced MFU=3.67)."""
     import jax
     import jax.numpy as jnp
+    from functools import partial
 
-    def fwd(params, x):
-        out, _ = model.apply(params, model.state, x, training=False, rng=None)
-        return out
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+    scale = jnp.bfloat16(1.0 / (n ** 0.5))
 
-    probe = min(batch_shape[0], 8)
-    shape = (probe,) + tuple(batch_shape[1:])
+    @partial(jax.jit, static_argnums=2)
+    def chain(x, w, length):
+        def body(c, _):
+            return (c @ w) * scale, ()
+        y, _ = jax.lax.scan(body, x, None, length=length)
+        return y
+
+    # compile both lengths before timing
+    _fetch_scalar(chain(a, b, 2))
+    _fetch_scalar(chain(a, b, 8))
+    t2 = min(_timed(lambda: _fetch_scalar(chain(a, b, 2)))
+             for _ in range(3))
+    t8 = min(_timed(lambda: _fetch_scalar(chain(a, b, 8)))
+             for _ in range(3))
+    per_mm = (t8 - t2) / 6.0
+    if per_mm <= 0:
+        return None
+    return 2.0 * (n ** 3) / per_mm
+
+
+def _step_flops(jitted, compiled, example_args):
+    """Model FLOPs for ONE train step: analytic jaxpr count (primary) with
+    XLA cost_analysis as cross-check.  Failures are logged, never swallowed
+    (round-2 verdict: resnet50 mfu=null from a silently-dead probe)."""
+    import jax
+    from bigdl_tpu.utils.flops import jaxpr_flops
+
+    analytic = xla = None
     try:
-        compiled = jax.jit(fwd).lower(
-            model.params, jnp.zeros(shape, in_dtype)).compile()
+        analytic = jaxpr_flops(jax.make_jaxpr(jitted)(*example_args))
+    except Exception as e:  # noqa: BLE001
+        _log(f"analytic flops failed: {type(e).__name__}: {e}")
+    try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
-        f = float(ca.get("flops", 0.0)) if ca else 0.0
-        return f * (batch_shape[0] / probe) if f > 0 else None
-    except Exception:  # noqa: BLE001 — flops are best-effort metadata
-        return None
+        if ca:
+            xla = float(ca.get("flops", 0.0)) or None
+    except Exception as e:  # noqa: BLE001
+        _log(f"xla cost_analysis failed: {type(e).__name__}: {e}")
+    if analytic and xla and not (0.3 < xla / analytic < 3.0):
+        _log(f"flops disagreement: analytic={analytic:.3e} xla={xla:.3e}")
+    return analytic or xla, {"flops_analytic": analytic, "flops_xla": xla}
 
 
-def _bench_config(name, build, warmup=2, iters=10):
+def _bench_config(name, build, peak_flops):
     """Time the REAL compiled train step (Optimizer._build_step) on a 1-chip
     mesh; returns images/sec + flops/step + mfu."""
     import jax
@@ -138,7 +223,9 @@ def _bench_config(name, build, warmup=2, iters=10):
 
     model, criterion, inp, tgt, lr = build()
     Engine.reset()
-    Engine.init()
+    # per-CHIP numbers: bench on device 0 only, so flops/dt is divided by a
+    # single device's peak (a mesh over N devices would inflate MFU by N)
+    Engine.init(devices=[jax.devices()[0]])
     mesh = Engine.mesh()
 
     model.build(jax.random.key(0))
@@ -152,35 +239,55 @@ def _bench_config(name, build, warmup=2, iters=10):
     opt_state = opt.optim_method.init_state(params)
     lr_arr, rng = jnp.float32(lr), jax.random.key(1)
 
+    t0 = time.perf_counter()
+    lowered = step.lower(params, net_state, opt_state, inp, tgt, lr_arr, rng)
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+
+    flops_step, flops_detail = _step_flops(
+        step, compiled, (params, net_state, opt_state, inp, tgt, lr_arr, rng))
+
+    box = {"params": params, "net_state": net_state, "opt_state": opt_state}
+
     def run():
-        nonlocal params, net_state, opt_state
-        params, net_state, opt_state, loss = step(
-            params, net_state, opt_state, inp, tgt, lr_arr, rng)
+        box["params"], box["net_state"], box["opt_state"], loss = compiled(
+            box["params"], box["net_state"], box["opt_state"],
+            inp, tgt, lr_arr, rng)
         return loss
 
-    t0 = time.perf_counter()
-    jax.block_until_ready(run())
-    compile_s = time.perf_counter() - t0
-    for _ in range(max(warmup - 1, 0)):
-        run()
-    jax.block_until_ready(params)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = run()
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+    dt, timing = _measure_chain(run)
+    dt_sync = _measure_sync(run)
+    if dt <= 0 or dt > dt_sync * 1.5:
+        # differencing went sideways (noise/backlog); fall back to the
+        # conservative synced number rather than report garbage
+        _log(f"{name}: chained dt={dt:.6f}s inconsistent with "
+             f"sync={dt_sync:.6f}s; using sync timing")
+        timing["fallback"] = "sync"
+        dt = dt_sync
 
-    batch = inp.shape[0]
-    fwd = _fwd_flops(model, inp.shape, inp.dtype)
-    flops_step = 3.0 * fwd if fwd else None
-    peak = _peak_flops(jax.devices()[0])
-    mfu = (flops_step / dt / peak) if (flops_step and peak) else None
-    return {"name": name, "images_per_sec": round(batch / dt, 2),
-            "step_seconds": round(dt, 6), "batch_size": batch,
-            "compile_seconds": round(compile_s, 2),
-            "model_flops_per_step": flops_step,
-            "mfu": round(mfu, 4) if mfu is not None else None,
-            "vs_estimated_xeon": round(batch / dt / ESTIMATED_XEON[name], 2)}
+    batch = int(inp.shape[0])
+    mfu = mfu_raw = mfu_error = None
+    if flops_step and peak_flops:
+        mfu_raw = flops_step / dt / peak_flops
+        if 0.0 < mfu_raw <= 1.0:
+            mfu = round(mfu_raw, 4)
+        else:
+            mfu_error = (
+                f"raw MFU {mfu_raw:.3f} outside (0,1]: flops/step="
+                f"{flops_step:.3e}, dt={dt:.6f}s, peak={peak_flops:.3e} — "
+                "timing and FLOPs disagree; refusing to report")
+            _log(f"{name}: {mfu_error}")
+    rec = {"name": name, "images_per_sec": round(batch / dt, 2),
+           "step_seconds": round(dt, 6),
+           "step_seconds_sync": round(dt_sync, 6),
+           "batch_size": batch,
+           "compile_seconds": round(compile_s, 2),
+           "model_flops_per_step": flops_step,
+           "mfu": mfu, "timing": timing, **flops_detail}
+    if mfu_error:
+        rec["mfu_raw"] = round(mfu_raw, 4)
+        rec["mfu_error"] = mfu_error
+    return rec
 
 
 # ---------------------------------------------------------------- configs
@@ -247,12 +354,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="*", default=list(CONFIGS),
                     choices=list(CONFIGS))
-    ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) for local testing; "
                          "env vars are too late under this image's "
                          "sitecustomize, jax.config still works")
+    ap.add_argument("--roofline-n", type=int, default=8192)
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -262,14 +368,36 @@ def main(argv=None):
         except RuntimeError:
             pass
     jax, devices = _init_backend()
+
+    table_peak = _table_peak_flops(devices[0])
+    measured_peak = None
+    if devices[0].platform == "tpu":
+        try:
+            measured_peak = _measure_roofline(args.roofline_n)
+        except Exception as e:  # noqa: BLE001
+            _log(f"roofline measurement failed: {type(e).__name__}: {e}")
+        if measured_peak is None:
+            _log("roofline measurement inconclusive (non-positive "
+                 "differenced time)")
+        elif table_peak and measured_peak > 1.25 * table_peak:
+            # a differencing glitch can fake an arbitrarily high roofline,
+            # which would silently deflate every MFU — refuse it
+            _log(f"measured roofline {measured_peak/1e12:.1f} TFLOP/s "
+                 f"exceeds 1.25x table peak {table_peak/1e12:.1f}; "
+                 "discarding as a timing glitch")
+            measured_peak = None
+        else:
+            _log(f"measured bf16 roofline: {measured_peak/1e12:.1f} TFLOP/s "
+                 f"(table: {table_peak and table_peak/1e12} TFLOP/s)")
+    peak = max(filter(None, (table_peak, measured_peak)), default=None)
+
     results, errors = {}, {}
     for name in args.configs:
         try:
-            results[name] = _bench_config(name, CONFIGS[name],
-                                          warmup=args.warmup,
-                                          iters=args.iters)
+            results[name] = _bench_config(name, CONFIGS[name], peak)
         except Exception as e:  # noqa: BLE001 — recorded per config
             errors[name] = f"{type(e).__name__}: {e}"
+            _log(f"config {name} failed: {errors[name]}")
 
     primary = results.get("resnet50") or next(iter(results.values()), None)
     if primary is None:
@@ -280,17 +408,16 @@ def main(argv=None):
     if mfu is not None and primary["name"] == "resnet50":
         # the >=45%-MFU target is the ResNet-50 north star (BASELINE.md)
         vs_baseline = round(mfu / MFU_TARGET, 3)
-        baseline_estimated = False
     else:
-        vs_baseline = round(
-            primary["images_per_sec"] / ESTIMATED_XEON[primary["name"]], 2)
-        baseline_estimated = True
+        vs_baseline = None  # no real published baseline exists (BASELINE.md)
     out = {"metric": f"{primary['name']}_train_images_per_sec_per_chip",
            "value": primary["images_per_sec"], "unit": "images/sec",
            "vs_baseline": vs_baseline,
-           "baseline_estimated": baseline_estimated,
            "mfu": mfu, "mfu_target": MFU_TARGET,
            "model_flops_per_step": primary["model_flops_per_step"],
+           "peak_flops_table": table_peak,
+           "peak_flops_measured_roofline": measured_peak,
+           "peak_flops_used": peak,
            "device": str(devices[0]),
            "device_kind": getattr(devices[0], "device_kind", "unknown"),
            "configs": results}
