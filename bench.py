@@ -107,86 +107,6 @@ def _table_peak_flops(device):
     return None  # CPU/unknown: no table entry
 
 
-def _fetch_scalar(x):
-    """Force completion of everything `x` depends on via a host byte fetch."""
-    import numpy as np
-    while isinstance(x, (list, tuple)):
-        x = x[0]
-    flat = x.ravel() if getattr(x, "ndim", 0) else x
-    return float(np.asarray(flat[0] if getattr(flat, "ndim", 0) else flat))
-
-
-def _timed(fn):
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
-
-
-def _measure_chain(run, n1=4, n2=16, reps=3):
-    """Differenced chained timing; returns (dt_seconds, details dict)."""
-    _fetch_scalar(run())  # drain queue + any lazy backend state
-    times = {}
-    for n in (n1, n2):
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(n):
-                out = run()
-            _fetch_scalar(out)
-            best = min(best, time.perf_counter() - t0)
-        times[n] = best
-    dt = (times[n2] - times[n1]) / (n2 - n1)
-    overhead = max(times[n1] - n1 * dt, 0.0)
-    return dt, {"n1": n1, "n2": n2, "t_n1": round(times[n1], 6),
-                "t_n2": round(times[n2], 6),
-                "fixed_overhead_seconds": round(overhead, 6)}
-
-
-def _measure_sync(run, iters=6):
-    """Per-step fetch-synced timing (includes one tunnel RTT per step)."""
-    _fetch_scalar(run())
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        _fetch_scalar(run())
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
-
-
-def _measure_roofline(n=8192):
-    """Measured bf16 matmul FLOP/s on device 0 — the empirical peak used to
-    calibrate the MFU denominator (round-2 verdict: a device-kind string
-    table alone produced MFU=3.67)."""
-    import jax
-    import jax.numpy as jnp
-    from functools import partial
-
-    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
-    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
-    scale = jnp.bfloat16(1.0 / (n ** 0.5))
-
-    @partial(jax.jit, static_argnums=2)
-    def chain(x, w, length):
-        def body(c, _):
-            return (c @ w) * scale, ()
-        y, _ = jax.lax.scan(body, x, None, length=length)
-        return y
-
-    # compile both lengths before timing
-    _fetch_scalar(chain(a, b, 2))
-    _fetch_scalar(chain(a, b, 8))
-    t2 = min(_timed(lambda: _fetch_scalar(chain(a, b, 2)))
-             for _ in range(3))
-    t8 = min(_timed(lambda: _fetch_scalar(chain(a, b, 8)))
-             for _ in range(3))
-    per_mm = (t8 - t2) / 6.0
-    if per_mm <= 0:
-        return None
-    return 2.0 * (n ** 3) / per_mm
-
-
 def _step_flops(jitted, compiled, example_args):
     """Model FLOPs for ONE train step: analytic jaxpr count (primary) with
     XLA cost_analysis as cross-check.  Failures are logged, never swallowed
@@ -255,15 +175,10 @@ def _bench_config(name, build, peak_flops):
             inp, tgt, lr_arr, rng)
         return loss
 
-    dt, timing = _measure_chain(run)
-    dt_sync = _measure_sync(run)
-    if dt <= 0 or dt > dt_sync * 1.5:
-        # differencing went sideways (noise/backlog); fall back to the
-        # conservative synced number rather than report garbage
-        _log(f"{name}: chained dt={dt:.6f}s inconsistent with "
-             f"sync={dt_sync:.6f}s; using sync timing")
-        timing["fallback"] = "sync"
-        dt = dt_sync
+    from bigdl_tpu.utils.timing import measure_step_seconds
+    dt, timing = measure_step_seconds(
+        run, log=lambda m: _log(f"{name}: {m}"))
+    dt_sync = timing["step_seconds_sync"]
 
     batch = int(inp.shape[0])
     mfu = mfu_raw = mfu_error = None
@@ -369,19 +284,22 @@ def main(argv=None):
             pass
     jax, devices = _init_backend()
 
+    from bigdl_tpu.utils.timing import is_tpu_like, measure_roofline
+
     table_peak = _table_peak_flops(devices[0])
     measured_peak = None
-    if devices[0].platform == "tpu":
+    if is_tpu_like(devices[0]):
         try:
-            measured_peak = _measure_roofline(args.roofline_n)
+            # measure_roofline self-checks reproducibility (reps must agree)
+            measured_peak = measure_roofline(args.roofline_n)
         except Exception as e:  # noqa: BLE001
             _log(f"roofline measurement failed: {type(e).__name__}: {e}")
         if measured_peak is None:
-            _log("roofline measurement inconclusive (non-positive "
-                 "differenced time)")
+            _log("roofline measurement inconclusive (irreproducible or "
+                 "non-positive differenced time)")
         elif table_peak and measured_peak > 1.25 * table_peak:
-            # a differencing glitch can fake an arbitrarily high roofline,
-            # which would silently deflate every MFU — refuse it
+            # a glitch that survives the reps-agreement check but contradicts
+            # the hardware table would silently deflate every MFU — refuse it
             _log(f"measured roofline {measured_peak/1e12:.1f} TFLOP/s "
                  f"exceeds 1.25x table peak {table_peak/1e12:.1f}; "
                  "discarding as a timing glitch")

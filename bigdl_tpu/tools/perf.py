@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.timing import fetch_scalar, measure_step_seconds
+
 # zoo names, resolved through models/run._build_model so the benched step
 # uses the SAME model/criterion pairing as real training (LogSoftMax heads
 # pair with ClassNLL, logits heads with CrossEntropy)
@@ -63,20 +65,15 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
             params, net_state, opt_state, inp, tgt, jnp.float32(0.01), rng)
         return loss
 
+    # fetch-synced timing (utils/timing.py): block_until_ready does not
+    # actually synchronize on this image's tunneled TPU backend
     t0 = time.perf_counter()
-    one().block_until_ready()
+    fetch_scalar(one())
     compile_s = time.perf_counter() - t0
-    for _ in range(warmup):
-        one()
-    jax.block_until_ready(params)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = one()
-    loss.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    dt, detail = measure_step_seconds(one, n2=max(iters, 8))
     return {"model": model_name, "batch_size": batch_size,
             "step_seconds": dt, "records_per_second": batch_size / dt,
-            "compile_seconds": compile_s,
+            "compile_seconds": compile_s, "timing": detail,
             "device": str(jax.devices()[0])}
 
 

@@ -1,0 +1,139 @@
+"""Trustworthy device timing for benchmarks.
+
+On this image's tunneled TPU backend, `jax.block_until_ready` returns WITHOUT
+waiting for device execution — only a host fetch of result bytes actually
+synchronizes (measured: an 8192^3 bf16 matmul "completed" in 22us = 50
+PFLOP/s under block_until_ready; fetching the result took the physically
+sensible ~7ms).  Every timing helper here therefore synchronizes by fetching
+a scalar derived from the result, and the per-step measurement DIFFERENCES
+two chained-run lengths to cancel the constant fetch/tunnel round-trip:
+
+    dt = (T(n2) - T(n1)) / (n2 - n1)
+
+Role in the reference: DistriOptimizer's per-iteration wall timing
+(optim/DistriOptimizer.scala:293-297) is host-side around a synchronous Spark
+job, so it never had this problem; a compiled async backend needs explicit
+sync discipline.  Shared by `bench.py` and `bigdl_tpu/tools/perf.py`.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["fetch_scalar", "measure_chain", "measure_sync",
+           "measure_roofline"]
+
+
+def fetch_scalar(x) -> float:
+    """Force completion of everything `x` depends on via a host byte fetch."""
+    while isinstance(x, (list, tuple)):
+        x = x[0]
+    flat = x.ravel() if getattr(x, "ndim", 0) else x
+    return float(np.asarray(flat[0] if getattr(flat, "ndim", 0) else flat))
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def measure_chain(run, n1=4, n2=16, reps=3):
+    """Differenced chained timing of `run()` (must return a device value that
+    depends on all prior `run()` calls, e.g. the loss of a step that threads
+    its params).  Returns (seconds_per_run, details dict)."""
+    fetch_scalar(run())  # drain queue + any lazy backend state
+    times = {}
+    for n in (n1, n2):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = run()
+            fetch_scalar(out)
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    dt = (times[n2] - times[n1]) / (n2 - n1)
+    overhead = max(times[n1] - n1 * dt, 0.0)
+    return dt, {"n1": n1, "n2": n2, "t_n1": round(times[n1], 6),
+                "t_n2": round(times[n2], 6),
+                "fixed_overhead_seconds": round(overhead, 6)}
+
+
+def measure_sync(run, iters=6) -> float:
+    """Median per-call timing with a host fetch per call (upper-bounds the
+    true step time by one tunnel round-trip)."""
+    fetch_scalar(run())
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fetch_scalar(run())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def measure_step_seconds(run, n1=4, n2=16, reps=3, log=None):
+    """Best-effort step time: differenced chain, falling back to the synced
+    median when the differencing is inconsistent (noise/backlog)."""
+    dt, detail = measure_chain(run, n1=n1, n2=n2, reps=reps)
+    dt_sync = measure_sync(run)
+    detail["step_seconds_sync"] = round(dt_sync, 6)
+    if dt <= 0 or dt > dt_sync * 1.5:
+        if log:
+            log(f"chained dt={dt:.6f}s inconsistent with sync="
+                f"{dt_sync:.6f}s; using sync timing")
+        detail["fallback"] = "sync"
+        dt = dt_sync
+    return dt, detail
+
+
+def measure_roofline(n=8192, reps=2, tolerance=1.25):
+    """Measured bf16 matmul FLOP/s on the default device — the empirical
+    peak used to calibrate MFU denominators.  Runs the measurement `reps`
+    times; returns None (inconclusive) unless all agree within `tolerance`x,
+    so a single differencing glitch cannot silently deflate every MFU."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+    scale = jnp.bfloat16(1.0 / (n ** 0.5))
+
+    @partial(jax.jit, static_argnums=2)
+    def chain(x, w, length):
+        def body(c, _):
+            return (c @ w) * scale, ()
+        y, _ = jax.lax.scan(body, x, None, length=length)
+        return y
+
+    # compile both lengths before timing
+    fetch_scalar(chain(a, b, 2))
+    fetch_scalar(chain(a, b, 8))
+
+    estimates = []
+    for _ in range(reps):
+        t2 = min(_timed(lambda: fetch_scalar(chain(a, b, 2)))
+                 for _ in range(3))
+        t8 = min(_timed(lambda: fetch_scalar(chain(a, b, 8)))
+                 for _ in range(3))
+        per_mm = (t8 - t2) / 6.0
+        if per_mm <= 0:
+            return None
+        estimates.append(2.0 * (n ** 3) / per_mm)
+    if max(estimates) > tolerance * min(estimates):
+        return None  # irreproducible — refuse rather than mis-calibrate
+    return sum(estimates) / len(estimates)
+
+
+def is_tpu_like(device) -> bool:
+    """True for real TPUs however the platform registers itself (the tunneled
+    backend on this image reports platform 'tpu' but other plugin builds may
+    expose the plugin name, e.g. 'axon'; device_kind stays 'TPU ...')."""
+    kind = getattr(device, "device_kind", "").lower()
+    platform = getattr(device, "platform", "").lower()
+    return "tpu" in kind or platform in ("tpu", "axon")

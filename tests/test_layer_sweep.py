@@ -1,0 +1,627 @@
+"""Parametrized per-layer sweep: every exported nn class gets at least a
+forward check, most get a numpy golden value, parameterized layers get a
+finite-difference gradient check.
+
+This is the rebuild's analog of the reference's per-layer spec coverage
+(SURVEY.md §4: 122 Torch-golden specs under test/.../torch/ + 75 layer specs
+under test/.../nn/).  The Torch7 oracle is replaced by numpy formulas and,
+for a few criterions, by pytorch (CPU) as a genuine independent oracle.
+
+`test_every_exported_class_is_tested` at the bottom enforces closure: any
+newly exported nn class without a test anywhere under tests/ fails the suite.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def rng():
+    return jax.random.key(7)
+
+
+def _x(shape, seed=0, positive=False, scale=1.0):
+    r = np.random.default_rng(seed)
+    v = r.normal(size=shape).astype(np.float32) * scale
+    if positive:
+        v = np.abs(v) + 0.5
+    return jnp.asarray(v)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / activation golden sweep: (ctor, input, numpy golden)
+# ---------------------------------------------------------------------------
+
+ELEMENTWISE = [
+    ("Abs", lambda: nn.Abs(), lambda: _x((3, 4)), lambda x: np.abs(x)),
+    ("AddConstant", lambda: nn.AddConstant(2.5), lambda: _x((3, 4)),
+     lambda x: x + 2.5),
+    ("Clamp", lambda: nn.Clamp(-0.5, 0.5), lambda: _x((3, 4)),
+     lambda x: np.clip(x, -0.5, 0.5)),
+    ("Contiguous", lambda: nn.Contiguous(), lambda: _x((3, 4)), lambda x: x),
+    ("Echo", lambda: nn.Echo(), lambda: _x((3, 4)), lambda x: x),
+    ("Exp", lambda: nn.Exp(), lambda: _x((3, 4)), lambda x: np.exp(x)),
+    ("Log", lambda: nn.Log(), lambda: _x((3, 4), positive=True),
+     lambda x: np.log(x)),
+    ("Sqrt", lambda: nn.Sqrt(), lambda: _x((3, 4), positive=True),
+     lambda x: np.sqrt(x)),
+    ("Square", lambda: nn.Square(), lambda: _x((3, 4)), lambda x: x * x),
+    ("HardShrink", lambda: nn.HardShrink(0.5), lambda: _x((3, 4)),
+     lambda x: np.where(np.abs(x) > 0.5, x, 0.0)),
+    ("SoftShrink", lambda: nn.SoftShrink(0.5), lambda: _x((3, 4)),
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0))),
+    ("Threshold", lambda: nn.Threshold(0.1, -1.0), lambda: _x((3, 4)),
+     lambda x: np.where(x > 0.1, x, -1.0)),
+    ("LogSigmoid", lambda: nn.LogSigmoid(), lambda: _x((3, 4)),
+     lambda x: -np.log1p(np.exp(-x))),
+    ("SoftPlus", lambda: nn.SoftPlus(2.0), lambda: _x((3, 4)),
+     lambda x: np.log1p(np.exp(2.0 * x)) / 2.0),
+    ("SoftMin", lambda: nn.SoftMin(), lambda: _x((3, 4)),
+     lambda x: np.exp(-x) / np.exp(-x).sum(-1, keepdims=True)),
+    ("Normalize", lambda: nn.Normalize(2.0), lambda: _x((3, 4)),
+     lambda x: x / (np.linalg.norm(x, axis=-1, keepdims=True) + 1e-10)),
+]
+
+
+@pytest.mark.parametrize("name,ctor,inp,golden", ELEMENTWISE,
+                         ids=[e[0] for e in ELEMENTWISE])
+def test_elementwise_golden(name, ctor, inp, golden):
+    m = ctor().build(rng())
+    x = inp()
+    y = m.forward(x)
+    np.testing.assert_allclose(np.asarray(y), golden(np.asarray(x)),
+                               rtol=1e-5, atol=1e-5)
+    # input gradient exists and is finite
+    gx = m.backward(x, jnp.ones_like(y))
+    assert np.all(np.isfinite(np.asarray(gx)))
+
+
+def test_rrelu_eval_and_train():
+    m = nn.RReLU(0.1, 0.3).build(rng())
+    x = _x((4, 5))
+    m.evaluate()
+    y_eval = np.asarray(m.forward(x))
+    xn = np.asarray(x)
+    np.testing.assert_allclose(
+        y_eval, np.where(xn >= 0, xn, xn * 0.2), rtol=1e-5, atol=1e-6)
+    m.training()
+    out, _ = m.apply(m.params, m.state, x, training=True,
+                     rng=jax.random.key(3))
+    y_tr = np.asarray(out)
+    neg = xn < 0
+    slopes = y_tr[neg] / xn[neg]
+    assert np.all(slopes >= 0.1 - 1e-6) and np.all(slopes <= 0.3 + 1e-6)
+    np.testing.assert_allclose(y_tr[~neg], xn[~neg], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# reductions / shape ops
+# ---------------------------------------------------------------------------
+
+REDUCTIONS = [
+    ("Max", lambda: nn.Max(dim=1), (2, 5), lambda x: x.max(1)),
+    ("Min", lambda: nn.Min(dim=1), (2, 5), lambda x: x.min(1)),
+    ("Mean", lambda: nn.Mean(dimension=1), (2, 5), lambda x: x.mean(1)),
+    ("Sum", lambda: nn.Sum(dimension=1), (2, 5), lambda x: x.sum(1)),
+]
+
+
+@pytest.mark.parametrize("name,ctor,shape,golden", REDUCTIONS,
+                         ids=[e[0] for e in REDUCTIONS])
+def test_reduction_golden(name, ctor, shape, golden):
+    m = ctor().build(rng())
+    x = _x(shape)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               golden(np.asarray(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_view_reshape():
+    m = nn.View(2, 3, 4).build(rng())
+    x = _x((2, 12))
+    y = m.forward(x)
+    assert y.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x).reshape(2, 3, 4))
+
+
+def test_infer_reshape_zero_and_minus_one():
+    m = nn.InferReshape((0, -1)).build(rng())
+    x = _x((2, 3, 4))
+    y = m.forward(x)
+    assert y.shape == (2, 12)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x).reshape(2, 12))
+
+
+def test_replicate():
+    m = nn.Replicate(3, dim=1).build(rng())
+    x = _x((2, 4))
+    y = m.forward(x)
+    assert y.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.tile(np.asarray(x)[:, None, :], (1, 3, 1)))
+
+
+def test_index_gathers_rows():
+    m = nn.Index(dim=0).build(rng())
+    t, idx = _x((5, 3)), jnp.asarray([3, 1])
+    y = m.forward([t, idx])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(t)[[3, 1]])
+
+
+def test_masked_select_outside_jit():
+    m = nn.MaskedSelect().build(rng())
+    t = _x((3, 4))
+    mask = jnp.asarray(np.asarray(t) > 0)
+    y = m.forward([t, mask])
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(t)[np.asarray(mask)])
+
+
+# ---------------------------------------------------------------------------
+# table ops
+# ---------------------------------------------------------------------------
+
+def _pair(seed=0):
+    return [_x((3, 4), seed), _x((3, 4), seed + 1, positive=True)]
+
+
+TABLE_OPS = [
+    ("CSubTable", lambda: nn.CSubTable(), lambda a, b: a - b),
+    ("CDivTable", lambda: nn.CDivTable(), lambda a, b: a / b),
+    ("CMulTable", lambda: nn.CMulTable(), lambda a, b: a * b),
+    ("CMinTable", lambda: nn.CMinTable(), lambda a, b: np.minimum(a, b)),
+]
+
+
+@pytest.mark.parametrize("name,ctor,golden", TABLE_OPS,
+                         ids=[e[0] for e in TABLE_OPS])
+def test_binary_table_op(name, ctor, golden):
+    m = ctor().build(rng())
+    a, b = _pair()
+    y = m.forward([a, b])
+    np.testing.assert_allclose(np.asarray(y),
+                               golden(np.asarray(a), np.asarray(b)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flatten_table():
+    m = nn.FlattenTable().build(rng())
+    a, b = _pair()
+    c = _x((2, 2), 9)
+    out = m.forward([a, [b, c]])
+    assert len(out) == 3
+    np.testing.assert_allclose(np.asarray(out[2]), np.asarray(c))
+
+
+def test_narrow_table_and_select_table():
+    a, b = _pair()
+    c = _x((2, 2), 5)
+    out = nn.NarrowTable(1, 2).build(rng()).forward([a, b, c])
+    assert len(out) == 2
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(b))
+    sel = nn.SelectTable(2).build(rng()).forward([a, b, c])
+    np.testing.assert_allclose(np.asarray(sel), np.asarray(c))
+
+
+def test_split_table_and_pack_roundtrip():
+    x = _x((2, 3, 4))
+    parts = nn.SplitTable(1).build(rng()).forward(x)
+    assert len(parts) == 3 and parts[0].shape == (2, 4)
+    packed = nn.Pack(1).build(rng()).forward(parts)
+    np.testing.assert_allclose(np.asarray(packed), np.asarray(x))
+
+
+def test_mixture_table_blend():
+    gate = jax.nn.softmax(_x((2, 3), 3), axis=-1)
+    experts = [_x((2, 4), i + 10) for i in range(3)]
+    y = nn.MixtureTable().build(rng()).forward([gate, experts])
+    g = np.asarray(gate)
+    expect = sum(g[:, i:i + 1] * np.asarray(experts[i]) for i in range(3))
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# parameterized math layers (+ finite-difference gradient checks)
+# ---------------------------------------------------------------------------
+
+def _fd_check_param(m, x, leaf_path, idx, eps=1e-2, rtol=5e-2, atol=1e-3):
+    """Finite-difference check of d(sum(out^2))/d(params[leaf_path][idx])
+    — the reference's GradientChecker role."""
+    def f(params):
+        y, _ = m.apply(params, m.state, x)
+        leaves = [jnp.sum(jnp.square(t)) for t in jax.tree.leaves(y)]
+        return sum(leaves)
+
+    g = jax.grad(f)(m.params)
+
+    def peek(tree):
+        node = tree
+        for k in leaf_path:
+            node = node[k]
+        return node
+
+    grad_val = float(peek(g)[idx])
+    plus = jax.tree.map(lambda t: t, m.params)
+    minus = jax.tree.map(lambda t: t, m.params)
+
+    def poke(tree, delta):
+        node = tree
+        for k in leaf_path[:-1]:
+            node = node[k]
+        node[leaf_path[-1]] = node[leaf_path[-1]].at[idx].add(delta)
+
+    poke(plus, eps)
+    poke(minus, -eps)
+    fd = (float(f(plus)) - float(f(minus))) / (2 * eps)
+    np.testing.assert_allclose(grad_val, fd, rtol=rtol, atol=atol)
+
+
+def test_bilinear_golden_and_grad():
+    m = nn.Bilinear(3, 4, 2).build(rng())
+    x1, x2 = _x((2, 3)), _x((2, 4), 1)
+    y = m.forward([x1, x2])
+    w, b = np.asarray(m.params["weight"]), np.asarray(m.params["bias"])
+    expect = np.einsum("bi,kij,bj->bk", np.asarray(x1), w, np.asarray(x2)) + b
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+    _fd_check_param(m, [x1, x2], ("weight",), (0, 1, 2))
+
+
+def test_cadd_cmul_golden_and_grad():
+    x = _x((3, 4))
+    ma = nn.CAdd((4,)).build(rng())
+    np.testing.assert_allclose(np.asarray(ma.forward(x)),
+                               np.asarray(x) + np.asarray(ma.params["bias"]),
+                               rtol=1e-6)
+    _fd_check_param(ma, x, ("bias",), (1,))
+    mm = nn.CMul((4,)).build(rng())
+    np.testing.assert_allclose(np.asarray(mm.forward(x)),
+                               np.asarray(x) * np.asarray(mm.params["weight"]),
+                               rtol=1e-6)
+    _fd_check_param(mm, x, ("weight",), (2,))
+
+
+def test_cosine_layer_golden():
+    m = nn.Cosine(4, 3).build(rng())
+    x = _x((2, 4))
+    y = m.forward(x)
+    xn_ = np.asarray(x)
+    w = np.asarray(m.params["weight"])
+    xn = xn_ / (np.linalg.norm(xn_, axis=-1, keepdims=True) + 1e-12)
+    wn = w / (np.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+    np.testing.assert_allclose(np.asarray(y), xn @ wn.T, rtol=1e-4, atol=1e-5)
+    _fd_check_param(m, x, ("weight",), (0, 1))
+
+
+def test_euclidean_layer_golden():
+    m = nn.Euclidean(4, 3).build(rng())
+    x = _x((2, 4))
+    y = m.forward(x)
+    w = np.asarray(m.params["weight"])
+    expect = np.sqrt(
+        ((np.asarray(x)[:, None, :] - w[None]) ** 2).sum(-1) + 1e-12)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+    _fd_check_param(m, x, ("weight",), (1, 2))
+
+
+def test_rowwise_pair_layers_golden():
+    a, b = _x((3, 4)), _x((3, 4), 1)
+    an, bn = np.asarray(a), np.asarray(b)
+    np.testing.assert_allclose(
+        np.asarray(nn.DotProduct().build(rng()).forward([a, b])),
+        (an * bn).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nn.PairwiseDistance(2).build(rng()).forward([a, b])),
+        np.linalg.norm(an - bn, axis=-1), rtol=1e-5)
+    cos = np.asarray(nn.CosineDistance().build(rng()).forward([a, b]))
+    expect = (an * bn).sum(-1) / (
+        np.linalg.norm(an, axis=-1) * np.linalg.norm(bn, axis=-1))
+    np.testing.assert_allclose(cos, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_mm_mv_golden():
+    a, b = _x((2, 3, 4)), _x((2, 4, 5), 1)
+    y = nn.MM().build(rng()).forward([a, b])
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(a) @ np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
+    yt = nn.MM(trans_a=True).build(rng()).forward(
+        [jnp.swapaxes(a, -1, -2), b])
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(y),
+                               rtol=1e-4, atol=1e-5)
+    m, v = _x((2, 3, 4)), _x((2, 4), 1)
+    got = nn.MV().build(rng()).forward([m, v])
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.einsum("bij,bj->bi", np.asarray(m), np.asarray(v)),
+        rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+def test_bottle_flattens_leading_dims():
+    inner = nn.Linear(4, 2)
+    m = nn.Bottle(inner, n_input_dim=2).build(rng())
+    x = _x((3, 5, 4))
+    y = m.forward(x)
+    assert y.shape == (3, 5, 2)
+    w = np.asarray(m.params[0]["weight"])
+    b = np.asarray(m.params[0]["bias"])
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x) @ w.T + b, rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_table_applies_per_element():
+    m = nn.ParallelTable(nn.Linear(3, 2), nn.ReLU()).build(rng())
+    x1, x2 = _x((2, 3)), _x((2, 5))
+    y1, y2 = m.forward([x1, x2])
+    assert y1.shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(y2),
+                               np.maximum(np.asarray(x2), 0.0))
+
+
+def test_map_table_shares_parameters():
+    m = nn.MapTable(nn.Linear(3, 2)).build(rng())
+    x = _x((2, 3))
+    y1, y2 = m.forward([x, x])
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    # one underlying param set despite two applications
+    assert len(m.params) == 1
+
+
+def test_container_and_cell_hierarchy():
+    assert isinstance(nn.Sequential(), nn.Container)
+    assert isinstance(nn.MapTable(nn.ReLU()), nn.Container)
+    assert issubclass(nn.ConvLSTMPeephole, nn.Cell)
+    assert issubclass(nn.LSTM, nn.Cell)
+
+
+def test_module_node_graph_construction():
+    """ModuleNode is the Graph-building node handle (reference:
+    Graph.scala ModuleNode / utils/Node.scala)."""
+    inp = nn.Input()
+    h = nn.Linear(4, 3)(inp)
+    out = nn.Linear(3, 2)(h)
+    assert isinstance(h, nn.ModuleNode)
+    g = nn.Graph([inp], [out]).build(rng())
+    y = g.forward(_x((2, 4)))
+    assert y.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# convolutional / pooling extras
+# ---------------------------------------------------------------------------
+
+def test_volumetric_convolution_shape_and_grad():
+    m = nn.VolumetricConvolution(2, 3, 3, 3, 3).build(rng())
+    x = _x((1, 5, 6, 6, 2))
+    y = m.forward(x)
+    assert y.shape == (1, 3, 4, 4, 3)
+    _fd_check_param(m, x, ("bias",), (0,), rtol=5e-2, atol=5e-3)
+
+
+def test_volumetric_max_pooling_golden():
+    m = nn.VolumetricMaxPooling(2, 2, 2).build(rng())
+    x = _x((1, 4, 4, 4, 2))
+    y = m.forward(x)
+    assert y.shape == (1, 2, 2, 2, 2)
+    xn = np.asarray(x)
+    expect = xn.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
+
+
+def test_spatial_share_convolution_matches_spatial_convolution():
+    """SpatialShareConvolution is the reference's memory-sharing variant of
+    SpatialConvolution (same math, SpatialShareConvolution.scala) — outputs
+    must be identical given identical params."""
+    a = nn.SpatialConvolution(2, 3, 3, 3).build(rng())
+    b = nn.SpatialShareConvolution(2, 3, 3, 3).build(rng())
+    b.attach(a.params, a.state)
+    x = _x((2, 6, 6, 2))
+    np.testing.assert_allclose(np.asarray(a.forward(x)),
+                               np.asarray(b.forward(x)), rtol=1e-6)
+
+
+def test_roi_pooling_golden():
+    m = nn.RoiPooling(2, 2, spatial_scale=1.0).build(rng())
+    feats = _x((1, 8, 8, 3))
+    rois = jnp.asarray([[0, 0, 0, 3, 3]], jnp.float32)
+    y = m.forward([feats, rois])
+    assert y.shape == (1, 2, 2, 3)
+    region = np.asarray(feats)[0, 0:4, 0:4, :]
+    expect = region.reshape(2, 2, 2, 2, 3).max(axis=(1, 3))
+    np.testing.assert_allclose(np.asarray(y)[0], expect, rtol=1e-5)
+
+
+def test_conv_lstm_peephole_in_recurrent():
+    cell = nn.ConvLSTMPeephole(2, 4, 3, 3)
+    m = nn.Recurrent(cell).build(rng())
+    x = _x((1, 3, 5, 5, 2))  # (batch, time, H, W, C)
+    y = m.forward(x)
+    assert y.shape == (1, 3, 5, 5, 4)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+# ---------------------------------------------------------------------------
+# local normalization family
+# ---------------------------------------------------------------------------
+
+def test_spatial_subtractive_normalization_zeroes_constant_input():
+    m = nn.SpatialSubtractiveNormalization(2, 5).build(rng())
+    x = jnp.full((1, 9, 9, 2), 3.0)
+    y = np.asarray(m.forward(x))
+    assert y.shape == (1, 9, 9, 2)
+    # center pixels: local mean == value -> ~0 (borders may differ)
+    np.testing.assert_allclose(y[0, 4, 4], 0.0, atol=1e-4)
+
+
+def test_spatial_divisive_normalization_scales_down_variance():
+    m = nn.SpatialDivisiveNormalization(2, 5).build(rng())
+    x = _x((1, 9, 9, 2), scale=4.0)
+    y = np.asarray(m.forward(x))
+    assert y.shape == x.shape
+    assert np.all(np.isfinite(y))
+    assert np.std(y) < np.std(np.asarray(x))
+
+
+def test_spatial_contrastive_normalization_runs():
+    m = nn.SpatialContrastiveNormalization(2, 5).build(rng())
+    x = _x((1, 9, 9, 2))
+    y = np.asarray(m.forward(x))
+    assert y.shape == x.shape and np.all(np.isfinite(y))
+
+
+def test_spatial_within_channel_lrn_suppresses_large_windows():
+    m = nn.SpatialWithinChannelLRN(3, alpha=1.0, beta=0.75).build(rng())
+    x = jnp.full((1, 7, 7, 2), 2.0)
+    y = np.asarray(m.forward(x))
+    assert y.shape == x.shape
+    assert np.all(y[0, 3, 3] < 2.0)  # denominator > 1 for constant maps
+
+
+# ---------------------------------------------------------------------------
+# criterions
+# ---------------------------------------------------------------------------
+
+def test_l1_cost_and_penalty_golden():
+    x = _x((3, 4))
+    got = float(nn.L1Cost().loss(x, None))
+    np.testing.assert_allclose(got, np.abs(np.asarray(x)).sum(), rtol=1e-5)
+    got = float(nn.L1Penalty(0.3).loss(x))
+    np.testing.assert_allclose(got, 0.3 * np.abs(np.asarray(x)).sum(),
+                               rtol=1e-5)
+
+
+def test_cosine_distance_criterion_zero_at_equality():
+    x = _x((3, 4))
+    assert float(nn.CosineDistanceCriterion().loss(x, x)) < 1e-5
+    y = -x
+    np.testing.assert_allclose(
+        float(nn.CosineDistanceCriterion().loss(x, y)), 2.0, rtol=1e-4)
+
+
+def test_class_simplex_criterion_zero_at_vertex():
+    c = nn.ClassSimplexCriterion(4)
+    t = jnp.asarray([0, 2], jnp.int32)
+    out = c.simplex[np.asarray(t)]
+    assert float(c.loss(out, t)) < 1e-10
+    assert float(c.loss(out + 0.1, t)) > 0.0
+
+
+def test_l1_hinge_embedding_criterion_golden():
+    a, b = _x((3, 4)), _x((3, 4), 1)
+    d = np.abs(np.asarray(a) - np.asarray(b)).sum(-1)
+    t = jnp.asarray([1.0, -1.0, 1.0])
+    got = float(nn.L1HingeEmbeddingCriterion(margin=2.0).loss([a, b], t))
+    expect = np.mean([d[0], max(0.0, 2.0 - d[1]), d[2]])
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_margin_ranking_criterion_golden():
+    x1, x2 = _x((4,)), _x((4,), 1)
+    t = jnp.asarray([1.0, -1.0, 1.0, -1.0])
+    got = float(nn.MarginRankingCriterion(margin=0.5).loss([x1, x2], t))
+    d = np.asarray(x1) - np.asarray(x2)
+    expect = np.maximum(0.0, -np.asarray(t) * d + 0.5).mean()
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_soft_margin_criterion_vs_torch():
+    torch = pytest.importorskip("torch")
+    x, t = _x((3, 4)), jnp.asarray(np.sign(np.asarray(_x((3, 4), 5))))
+    got = float(nn.SoftMarginCriterion().loss(x, t))
+    expect = torch.nn.SoftMarginLoss()(
+        torch.tensor(np.asarray(x)), torch.tensor(np.asarray(t))).item()
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_multi_label_margin_criterion_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = _x((2, 4))
+    t = np.array([[2, 0, -1, -1], [1, -1, -1, -1]], np.int64)
+    got = float(nn.MultiLabelMarginCriterion().loss(x, jnp.asarray(t)))
+    expect = torch.nn.MultiLabelMarginLoss()(
+        torch.tensor(np.asarray(x)), torch.tensor(t)).item()
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_smooth_l1_with_weights_golden():
+    x, t = _x((3, 4)), _x((3, 4), 1)
+    d = np.asarray(x) - np.asarray(t)
+    ad = np.abs(d)
+    base = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum()
+    got = float(nn.SmoothL1CriterionWithWeights(sigma=1.0).loss(x, [t]))
+    np.testing.assert_allclose(got, base, rtol=1e-5)
+    got_n = float(nn.SmoothL1CriterionWithWeights(sigma=1.0, num=3).loss(
+        x, [t]))
+    np.testing.assert_allclose(got_n, base / 3.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# initialization methods
+# ---------------------------------------------------------------------------
+
+def test_const_initializers():
+    m = nn.Linear(4, 3).build(rng())
+    m.set_init_method(weight_init=nn.Zeros(), bias_init=nn.Ones())
+    assert float(jnp.abs(m.params["weight"]).sum()) == 0.0
+    np.testing.assert_allclose(np.asarray(m.params["bias"]), 1.0)
+    m.set_init_method(weight_init=nn.ConstInitMethod(0.3),
+                      bias_init=nn.ConstInitMethod(-1.0))
+    np.testing.assert_allclose(np.asarray(m.params["weight"]), 0.3)
+    np.testing.assert_allclose(np.asarray(m.params["bias"]), -1.0)
+
+
+def test_random_initializers_statistics():
+    k = jax.random.key(0)
+    u = np.asarray(nn.RandomUniform(-0.2, 0.2)(k, (200, 200)))
+    assert u.min() >= -0.2 and u.max() <= 0.2 and u.std() > 0.05
+    g = np.asarray(nn.RandomNormal(1.0, 0.5)(k, (200, 200)))
+    np.testing.assert_allclose(g.mean(), 1.0, atol=0.02)
+    np.testing.assert_allclose(g.std(), 0.5, atol=0.02)
+
+
+def test_fan_based_initializers():
+    k = jax.random.key(1)
+    w = np.asarray(nn.Xavier()(k, (100, 200)))  # (out, in) linear layout
+    a = np.sqrt(6.0 / (200 + 100))
+    assert w.min() >= -a - 1e-6 and w.max() <= a + 1e-6
+    np.testing.assert_allclose(w.std(), np.sqrt(2.0 / (200 + 100)),
+                               rtol=0.15)
+    m = np.asarray(nn.MsraFiller()(k, (100, 200)))
+    np.testing.assert_allclose(m.std(), np.sqrt(2.0 / 200), rtol=0.15)
+
+
+def test_bilinear_filler_kernel_shape():
+    """BilinearFiller builds the deconv upsampling kernel
+    (InitializationMethod.scala:277): symmetric, peaked at center."""
+    w = np.asarray(nn.BilinearFiller()(jax.random.key(0), (4, 4, 2, 2)))
+    k = w[:, :, 0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)  # symmetric
+    assert k.max() <= 1.0 + 1e-6 and k.min() >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# closure: every exported nn class must be named somewhere under tests/
+# ---------------------------------------------------------------------------
+
+def test_every_exported_class_is_tested():
+    import pathlib
+    import re
+    text = ""
+    for p in pathlib.Path(__file__).parent.glob("*.py"):
+        if p.name != "test_layer_sweep.py":
+            text += p.read_text()
+    here = pathlib.Path(__file__).read_text()
+    exported = sorted(n for n in dir(nn) if n[0:1].isupper())
+    untested = []
+    for name in exported:
+        if not (re.search(rf"\b{re.escape(name)}\b", text) or
+                re.search(rf"\bnn\.{re.escape(name)}\b", here) or
+                re.search(rf"\b{re.escape(name)}\b", here)):
+            untested.append(name)
+    assert not untested, f"exported nn classes with no test: {untested}"
