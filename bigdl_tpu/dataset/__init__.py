@@ -158,6 +158,14 @@ class DataSet:
         return LocalArrayDataSet(records, seed=seed)
 
     @staticmethod
+    def rdd(records, seed: int = 1):
+        """Distributed in-memory dataset — every process holds the same record
+        list and keeps only its process_index-th shard resident (reference:
+        DataSet.rdd coalescing to Engine.nodeNumber() partitions,
+        dataset/DataSet.scala:336-364)."""
+        return DistributedDataSet(records, seed=seed)
+
+    @staticmethod
     def image_folder(path, distributed: bool = False):
         """reference: DataSet.ImageFolder (DataSet.scala) — directory-per-class
         image tree -> LabeledImage records."""

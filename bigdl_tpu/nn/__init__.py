@@ -40,7 +40,8 @@ from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable,
                         CMinTable, JoinTable, SplitTable, NarrowTable,
                         FlattenTable, SelectTable, MixtureTable, Pack)
 from .recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
-                        ConvLSTMPeephole, Recurrent, TimeDistributed,
+                        ConvLSTMPeephole, ConvLSTMPeephole3D, Recurrent,
+                        TimeDistributed,
                         BiRecurrent)
 from .criterion import (
     AbsCriterion, BCECriterion, ClassNLLCriterion, ClassSimplexCriterion,

@@ -207,6 +207,11 @@ class ConvLSTMPeephole(Cell):
     """Convolutional LSTM with peepholes over NHWC maps
     (reference: nn/ConvLSTMPeephole.scala)."""
 
+    #: spatial rank; ConvLSTMPeephole3D overrides with 3 (NDHWC maps)
+    SPATIAL_NDIM = 2
+    _DIM_NUMBERS = {2: ("NHWC", "HWIO", "NHWC"),
+                    3: ("NDHWC", "DHWIO", "NDHWC")}
+
     def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
                  kernel_c: int = 3, stride: int = 1, with_peephole: bool = True):
         super().__init__()
@@ -215,15 +220,16 @@ class ConvLSTMPeephole(Cell):
         self.stride = stride
         self.with_peephole = with_peephole
         self.hidden_size = output_size
-        self._spatial = None  # (H, W), bound at first step
+        self._spatial = None  # spatial dims tuple, bound at first step
 
     def _init(self, rng):
         ks = jax.random.split(rng, 5)
-        k = self.kernel
+        k, n = self.kernel, self.SPATIAL_NDIM
         cin = self.input_size + self.output_size
-        fan_in = k * k * cin
+        fan_in = (k ** n) * cin
         stdv = 1.0 / (fan_in ** 0.5)
-        p = {"kernel": _uniform(ks[0], (k, k, cin, 4 * self.output_size), stdv),
+        p = {"kernel": _uniform(ks[0], (k,) * n + (cin, 4 * self.output_size),
+                                stdv),
              "bias": _uniform(ks[1], (4 * self.output_size,), stdv)}
         if self.with_peephole:
             p["peep_i"] = jnp.zeros((self.output_size,), jnp.float32)
@@ -234,18 +240,19 @@ class ConvLSTMPeephole(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32, spatial=None):
         if spatial is None:
             spatial = self._spatial
-        h, w = spatial
-        z = jnp.zeros((batch_size, h, w, self.output_size), dtype)
+        z = jnp.zeros((batch_size,) + tuple(spatial) + (self.output_size,),
+                      dtype)
         return (z, z)
 
     def step(self, params, x_t, hidden):
         h, cst = hidden
+        n = self.SPATIAL_NDIM
         z = jnp.concatenate([x_t, h], axis=-1)
         pad = self.kernel // 2
         gates = lax.conv_general_dilated(
             z, params["kernel"].astype(z.dtype),
-            (self.stride, self.stride), [(pad, pad), (pad, pad)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            (self.stride,) * n, [(pad, pad)] * n,
+            dimension_numbers=self._DIM_NUMBERS[n],
             preferred_element_type=jnp.float32) + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         cf = cst.astype(jnp.float32)
@@ -260,6 +267,14 @@ class ConvLSTMPeephole(Cell):
         o = jax.nn.sigmoid(o)
         h_new = (o * jnp.tanh(c_new)).astype(x_t.dtype)
         return h_new, (h_new, c_new.astype(x_t.dtype))
+
+
+class ConvLSTMPeephole3D(ConvLSTMPeephole):
+    """Volumetric ConvLSTM with peepholes over NDHWC maps
+    (reference: nn/ConvLSTMPeephole3D.scala); input
+    (batch, time, D, H, W, C) under Recurrent."""
+
+    SPATIAL_NDIM = 3
 
 
 class Recurrent(Container):
@@ -277,7 +292,7 @@ class Recurrent(Container):
         cell: Cell = self.modules[0]
         cp = params[0]
         if isinstance(cell, ConvLSTMPeephole):
-            cell._spatial = (x.shape[2], x.shape[3])
+            cell._spatial = tuple(x.shape[2:2 + cell.SPATIAL_NDIM])
         # cell input dropout (the reference's `p` on LSTM/GRU,
         # nn/LSTM.scala) — applied as VARIATIONAL dropout: one mask shared
         # across all time steps (a TPU-friendly re-design; the reference draws

@@ -24,14 +24,17 @@ class Trigger:
 
     @staticmethod
     def every_epoch():
-        """Fires when the epoch number advanced past the last firing (:37)."""
-        box = {"last": 0}
+        """Fires when state["epoch"] advances past the value seen at the
+        first call (:37).  State-only predicate: any caller driving a state
+        dict gets reference semantics — no coupling to driver internals."""
+        box = {"last": None}
 
         def fn(state):
             e = state.get("epoch", 1)
-            # fires at the first iteration of a new epoch, like the reference
-            # (which records the epoch at creation and fires when it changes)
-            if state.get("_epoch_just_finished", False) and e != box["last"]:
+            if box["last"] is None:
+                box["last"] = e
+                return False
+            if e > box["last"]:
                 box["last"] = e
                 return True
             return False

@@ -41,14 +41,48 @@ class ShardingStrategy:
         # batch dim sharded over the data axis; everything else replicated
         return NamedSharding(mesh, P(tuple(axes) if axes else None))
 
-    def opt_state_sharding(self, mesh: Mesh, opt_state, param_shardings):
-        """Default: mirror the param sharding for momentum-like slots, replicate
-        scalars."""
-        def share(leaf):
-            if hasattr(leaf, "ndim") and leaf.ndim == 0:
-                return NamedSharding(mesh, P())
-            return None  # filled by matching params below
-        return None  # None = let jit infer from params/update structure
+    def opt_state_sharding(self, mesh: Mesh, opt_state, params,
+                           param_shardings):
+        """Shardings for the optimizer-state pytree: momentum/Adam slots are
+        param-shaped, so each slot leaf inherits the sharding of the
+        same-shaped parameter; scalars (step counters) and unmatched leaves
+        replicate.  Under ShardedDataParallel this is what makes the ZeRO
+        claim real — optimizer state lives in 1/N slices, the TPU-native form
+        of the reference's per-node slice update
+        (optim/DistriOptimizer.scala:265-280)."""
+        rep = NamedSharding(mesh, P())
+        params_def = jax.tree.structure(params)
+        sh_leaves = jax.tree.leaves(param_shardings)
+        # shape -> sharding, but only where unambiguous: two same-shaped
+        # params with different shardings (e.g. row- vs column-parallel TP
+        # weights) must not have their slots guessed
+        by_shape = {}
+        ambiguous = set()
+        for p_leaf, p_sh in zip(jax.tree.leaves(params), sh_leaves):
+            shape = tuple(p_leaf.shape)
+            if shape in by_shape and by_shape[shape] is not p_sh \
+                    and by_shape[shape] != p_sh:
+                ambiguous.add(shape)
+            by_shape.setdefault(shape, p_sh)
+
+        def assign(subtree):
+            # a subtree structurally identical to params (momentum / Adam
+            # m,v slots) inherits the param shardings leaf-for-leaf
+            if jax.tree.structure(subtree) == params_def:
+                return jax.tree.unflatten(params_def, sh_leaves)
+            if isinstance(subtree, dict):
+                return {k: assign(v) for k, v in subtree.items()}
+            if isinstance(subtree, (list, tuple)):
+                return type(subtree)(assign(v) for v in subtree)
+            leaf = subtree
+            if getattr(leaf, "ndim", 0) == 0:
+                return rep
+            shape = tuple(getattr(leaf, "shape", ()))
+            if shape in ambiguous:
+                return rep
+            return by_shape.get(shape, rep)
+
+        return assign(opt_state)
 
 
 class DataParallel(ShardingStrategy):

@@ -70,6 +70,9 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
     t0 = time.perf_counter()
     fetch_scalar(one())
     compile_s = time.perf_counter() - t0
+    for _ in range(max(warmup - 1, 0)):
+        one()
+    fetch_scalar(one())
     dt, detail = measure_step_seconds(one, n2=max(iters, 8))
     return {"model": model_name, "batch_size": batch_size,
             "step_seconds": dt, "records_per_second": batch_size / dt,

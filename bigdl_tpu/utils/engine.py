@@ -44,9 +44,61 @@ class Engine:
     SEQ_AXIS = "seq"
     EXPERT_AXIS = "expert"
 
+    #: True once jax.distributed.initialize has run in this process
+    _distributed_initialized = False
+
+    @classmethod
+    def init_distributed(cls, coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None,
+                         local_device_ids: Optional[Sequence[int]] = None
+                         ) -> None:
+        """Join the multi-host runtime (jax.distributed.initialize).
+
+        The reference discovers cluster topology from the Spark master URL
+        (`Engine.parseExecutorAndCore`, utils/Engine.scala:353-418); here the
+        coordination contract is environment variables — set by the launcher
+        on every host, mirroring how spark-submit seeds each executor:
+
+          BIGDL_TPU_COORDINATOR    host:port of process 0
+          BIGDL_TPU_NUM_PROCESSES  world size
+          BIGDL_TPU_PROCESS_ID     this process's rank
+
+        On TPU pods all three may be omitted: jax auto-detects them from the
+        TPU metadata service.  After this call `jax.devices()` is GLOBAL
+        (every chip of every host) and `Engine.init()` builds the global mesh;
+        each process addresses only its local chips and feeds them its data
+        shard via `make_array_from_process_local_data`
+        (Optimizer._put_batch — SURVEY.md §5.8).
+        """
+        if cls._distributed_initialized:
+            return
+        from . import config
+        kwargs = {}
+        coord = coordinator_address or config.get_str("COORDINATOR", "")
+        if coord:
+            kwargs["coordinator_address"] = coord
+        nproc = (num_processes if num_processes is not None
+                 else config.get_int("NUM_PROCESSES", 0))
+        if nproc:
+            kwargs["num_processes"] = int(nproc)
+        pid = (process_id if process_id is not None
+               else config.get_int("PROCESS_ID", -1))
+        if pid >= 0:
+            kwargs["process_id"] = int(pid)
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = list(local_device_ids)
+        jax.distributed.initialize(**kwargs)
+        cls._distributed_initialized = True
+        logger.info(
+            "Engine.init_distributed: process %d/%d, %d local / %d global "
+            "devices", jax.process_index(), jax.process_count(),
+            jax.local_device_count(), jax.device_count())
+
     @classmethod
     def init(cls, mesh_shape: Optional[dict] = None,
-             devices: Optional[Sequence] = None) -> Mesh:
+             devices: Optional[Sequence] = None,
+             distributed: Optional[bool] = None) -> Mesh:
         """Discover devices and build the global mesh.
 
         mesh_shape: dict axis_name -> size, e.g. {"data": 4, "model": 2}.
@@ -54,7 +106,15 @@ class Engine:
           reference's only inter-node strategy (SURVEY.md §2.5: sync data-parallel
           SGD is BigDL's sole distribution mode, optim/DistriOptimizer.scala).
         devices: explicit device list (tests pass virtual CPU devices here).
+        distributed: join the multi-host runtime first (init_distributed).
+          Defaults to True when BIGDL_TPU_COORDINATOR is set, so launcher
+          scripts only need to export the env contract.
         """
+        if distributed is None:
+            from . import config
+            distributed = bool(config.get_str("COORDINATOR", ""))
+        if distributed:
+            cls.init_distributed()
         devs = list(devices) if devices is not None else list(jax.devices())
         if mesh_shape is None:
             mesh_shape = {cls.DATA_AXIS: len(devs)}

@@ -54,13 +54,6 @@ def _eqn_flops(eqn) -> float:
 def _sub_jaxprs(eqn):
     """Yield (jaxpr, multiplier) for every sub-jaxpr in an equation."""
     name = eqn.primitive.name
-    if name == "cond":
-        branches = eqn.params.get("branches", ())
-        # conservative: cost of the most expensive branch
-        costs = [(jaxpr_flops(b), b) for b in branches]
-        if costs:
-            yield max(costs, key=lambda t: t[0])[1], 1.0
-        return
     for pname, val in eqn.params.items():
         mult = 1.0
         if name == "scan" and pname == "jaxpr":
@@ -83,6 +76,12 @@ def jaxpr_flops(jaxpr) -> float:
     total = 0.0
     for eqn in inner.eqns:
         total += _eqn_flops(eqn)
+        if eqn.primitive.name == "cond":
+            # conservative: cost of the most expensive branch, counted once
+            branches = eqn.params.get("branches", ())
+            if branches:
+                total += max(jaxpr_flops(b) for b in branches)
+            continue
         for sub, mult in _sub_jaxprs(eqn):
             total += mult * jaxpr_flops(sub)
     return total

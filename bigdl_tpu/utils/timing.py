@@ -23,7 +23,7 @@ import time
 import numpy as np
 
 __all__ = ["fetch_scalar", "measure_chain", "measure_sync",
-           "measure_roofline"]
+           "measure_step_seconds", "measure_roofline", "is_tpu_like"]
 
 
 def fetch_scalar(x) -> float:

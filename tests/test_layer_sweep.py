@@ -446,6 +446,18 @@ def test_conv_lstm_peephole_in_recurrent():
     assert np.all(np.isfinite(np.asarray(y)))
 
 
+def test_conv_lstm_peephole_3d_in_recurrent():
+    cell = nn.ConvLSTMPeephole3D(2, 3, 3, 3)
+    m = nn.Recurrent(cell).build(rng())
+    x = _x((1, 2, 4, 5, 5, 2))  # (batch, time, D, H, W, C)
+    y = m.forward(x)
+    assert y.shape == (1, 2, 4, 5, 5, 3)
+    assert np.all(np.isfinite(np.asarray(y)))
+    # gradient flows through the scan + 3D conv
+    gx = m.backward(x, jnp.ones_like(y))
+    assert np.all(np.isfinite(np.asarray(gx)))
+
+
 # ---------------------------------------------------------------------------
 # local normalization family
 # ---------------------------------------------------------------------------

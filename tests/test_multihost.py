@@ -1,0 +1,108 @@
+"""Multi-host integration: 2 real OS processes, each with 2 virtual CPU
+devices, jointly training LeNet through the public API.
+
+This is the TPU-native analog of the reference's DistriOptimizerSpec
+"distributed-without-a-cluster" pattern (SURVEY.md §4) taken one step
+further: the processes here are REAL separate runtimes joined via
+jax.distributed (Gloo over localhost), so the test drives the genuinely
+multi-process paths — Engine.init_distributed's env contract,
+DistributedDataSet per-process sharding, and Optimizer._put_batch's
+`make_array_from_process_local_data` branch — that a single-process
+8-device mesh cannot reach.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import numpy as np
+    from bigdl_tpu.utils.engine import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    # env contract: BIGDL_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID are set by
+    # the launcher (the test); Engine.init() auto-joins the cluster.
+    mesh = Engine.init()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    rank = jax.process_index()
+    r = np.random.default_rng(1234)  # SAME dataset on every process
+    n, classes = 256, 10
+    xs = r.normal(0.0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
+    ys = r.integers(0, classes, size=n)
+    for i, l in enumerate(ys):  # class k = bright k-th block (separable)
+        row, col = divmod(int(l), 5)
+        xs[i, 4 + row * 10: 12 + row * 10, 2 + col * 5: 7 + col * 5, 0] += 1.5
+    samples = [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]
+
+    # DistributedDataSet: each process keeps its process_index-th shard
+    ds = DataSet.rdd(samples).transform(SampleToMiniBatch(32, drop_last=True))
+
+    from bigdl_tpu.optim import Adam
+    model = LeNet5(classes)
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(learning_rate=3e-3))
+           .set_end_when(Trigger.max_epoch(8)))
+    trained = opt.optimize()
+
+    # verify the model learned AND both processes agree bit-for-bit
+    w, _ = trained.get_parameters()
+    digest = float(np.abs(np.asarray(w)).sum())
+    loss = opt.optim_method.hyper["loss"]  # driver state Table (SGD.scala)
+    print(json.dumps({"rank": rank, "loss": loss, "digest": digest}),
+          flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = _free_port()
+    env_base = {**os.environ,
+                "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+                "BIGDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "BIGDL_TPU_NUM_PROCESSES": "2"}
+    procs = [
+        subprocess.Popen([sys.executable, str(worker)],
+                         env={**env_base, "BIGDL_TPU_PROCESS_ID": str(i)},
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        outs.append(json.loads(line))
+
+    by_rank = {o["rank"]: o for o in outs}
+    assert set(by_rank) == {0, 1}
+    # training happened and converged on the separable data
+    for o in outs:
+        assert o["loss"] < 1.0, o
+    # replicated parameters must be identical across processes
+    assert by_rank[0]["digest"] == pytest.approx(by_rank[1]["digest"],
+                                                 rel=1e-6)

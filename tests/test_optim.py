@@ -84,9 +84,11 @@ def test_triggers():
     assert Trigger.several_iteration(5)({"neval": 10})
     assert not Trigger.several_iteration(5)({"neval": 11})
     t = Trigger.every_epoch()
-    assert not t({"epoch": 1, "_epoch_just_finished": False})
-    assert t({"epoch": 2, "_epoch_just_finished": True})
-    assert not t({"epoch": 2, "_epoch_just_finished": True})  # fires once
+    assert not t({"epoch": 1})  # records the starting epoch
+    assert not t({"epoch": 1})  # same epoch: no fire
+    assert t({"epoch": 2})      # epoch advanced: fire
+    assert not t({"epoch": 2})  # fires once per epoch
+    assert t({"epoch": 3})
     assert Trigger.min_loss(0.1)({"loss": 0.05})
     assert Trigger.max_score(0.9)({"score": 0.95})
 

@@ -238,3 +238,58 @@ class TestRingChunkedInner:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+
+class TestOptStateSharding:
+    """ShardedDataParallel must shard same-shaped optimizer slots like the
+    params (ZeRO — the TPU-native form of the reference's per-node 1/N slice
+    update, DistriOptimizer.scala:265-280)."""
+
+    def test_momentum_inherits_param_sharding(self):
+        from bigdl_tpu.parallel.sharding import ShardedDataParallel
+        from bigdl_tpu.optim import SGD
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        params = {"w": jnp.zeros((1024, 64)), "b": jnp.zeros((64,))}
+        strat = ShardedDataParallel(min_size=1024)
+        p_sh = strat.param_sharding(mesh, params)
+        opt = SGD(learning_rate=0.1, momentum=0.9)
+        opt_state = opt.init_state(params)
+        os_sh = strat.opt_state_sharding(mesh, opt_state, params, p_sh)
+        placed = jax.device_put(opt_state, os_sh)
+        flat = jax.tree_util.tree_flatten_with_path(placed)[0]
+        mom_w = [l for kp, l in flat if l.ndim == 2]
+        assert mom_w, "expected a 2-D momentum slot"
+        for leaf in mom_w:
+            assert len(leaf.sharding.device_set) == 8  # sharded, not replicated
+            assert "data" in jax.tree.leaves(
+                [ax for ax in leaf.sharding.spec if ax])
+
+    def test_scalars_replicate(self):
+        from bigdl_tpu.parallel.sharding import ShardedDataParallel
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        params = {"w": jnp.zeros((1024, 64))}
+        strat = ShardedDataParallel(min_size=1024)
+        p_sh = strat.param_sharding(mesh, params)
+        state = {"t": jnp.zeros(()), "m": {"w": jnp.zeros((1024, 64))}}
+        sh = strat.opt_state_sharding(mesh, state, params, p_sh)
+        assert sh["t"].spec == jax.sharding.PartitionSpec()
+
+    def test_ambiguous_shapes_replicate(self):
+        """Two same-shaped params with different shardings: their optimizer
+        slots must not be guessed by shape (row- vs column-parallel TP)."""
+        from bigdl_tpu.parallel.sharding import ShardingStrategy
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        params = {"a": jnp.zeros((64, 64)), "b": jnp.zeros((64, 64))}
+        p_sh = {"a": NamedSharding(mesh, P("data", None)),
+                "b": NamedSharding(mesh, P(None, "data"))}
+        # state that is NOT structurally identical to params (extra leaf)
+        state = {"slot_a": jnp.zeros((64, 64)), "t": jnp.zeros(())}
+        sh = ShardingStrategy().opt_state_sharding(mesh, state, params, p_sh)
+        assert sh["slot_a"].spec == P()  # ambiguous -> replicated
+        # structurally-matching subtree still inherits exactly
+        state2 = {"m": {"a": jnp.zeros((64, 64)), "b": jnp.zeros((64, 64))},
+                  "t": jnp.zeros(())}
+        sh2 = ShardingStrategy().opt_state_sharding(mesh, state2, params, p_sh)
+        assert sh2["m"]["a"].spec == P("data", None)
+        assert sh2["m"]["b"].spec == P(None, "data")
