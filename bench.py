@@ -274,6 +274,8 @@ def main(argv=None):
                          "env vars are too late under this image's "
                          "sitecustomize, jax.config still works")
     ap.add_argument("--roofline-n", type=int, default=8192)
+    ap.add_argument("--no-scaling", action="store_true",
+                    help="skip the virtual-mesh scaling table")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -341,7 +343,30 @@ def main(argv=None):
            "configs": results}
     if errors:
         out["config_errors"] = errors
+    if not args.no_scaling:
+        out["scaling_virtual_cpu"] = _scaling_table()
     print(json.dumps(out))
+
+
+def _scaling_table():
+    """BASELINE.md's 'linear 8->64' target, simulated: run the scaling tool
+    (collective introspection + 1-vs-8-device virtual throughput) in a CPU
+    subprocess so it cannot disturb this process's TPU backend."""
+    import subprocess
+    cmd = [sys.executable, "-m", "bigdl_tpu.tools.scaling", "--devices", "8"]
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join(
+               filter(None, [repo_dir, os.environ.get("PYTHONPATH")]))}
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=420, env=env)
+        line = [l for l in res.stdout.splitlines() if l.startswith("{")]
+        if res.returncode == 0 and line:
+            return json.loads(line[-1])
+        return {"error": (res.stderr or "no output")[-500:]}
+    except Exception as e:  # noqa: BLE001 — scaling is best-effort metadata
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

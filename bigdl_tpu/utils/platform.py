@@ -1,0 +1,31 @@
+"""Backend-platform selection helpers.
+
+This image's sitecustomize imports jax at interpreter startup (axon TPU
+plugin), so JAX_PLATFORMS env vars set after startup are too late; only
+`jax.config.update` works, and only before the backend is first used.  This
+helper is the single home for that idiom (previously duplicated across
+tests/conftest.py, __graft_entry__.py, tools/scaling.py, bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["force_cpu"]
+
+
+def force_cpu(n_devices: Optional[int] = None) -> bool:
+    """Point jax at the CPU backend with `n_devices` virtual devices.
+
+    Returns True when the config took effect, False when the backend was
+    already initialized (caller should then check jax.devices() itself).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if n_devices is not None:
+            jax.config.update("jax_num_cpu_devices", int(n_devices))
+        return True
+    except RuntimeError:
+        return False  # backend already initialized — use as-is

@@ -1,0 +1,43 @@
+"""Scaling-evidence tooling (bigdl_tpu/tools/scaling.py): the compiled
+distributed train step must contain real XLA collectives, and the HLO
+introspection that bench.py / dryrun_multichip rely on must find them.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.optim import Optimizer, SGD, Trigger
+from bigdl_tpu.tools.scaling import collective_counts
+from bigdl_tpu.models.lenet import LeNet5
+
+
+def test_dp_step_contains_gradient_allreduce():
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
+    model = LeNet5(10).build(jax.random.key(0))
+    opt = Optimizer(model, dataset=None, criterion=nn.ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    step, param_sh, data_sh = opt._build_step(mesh)
+    params = jax.device_put(model.params, param_sh)
+    opt_state = opt.optim_method.init_state(params)
+    inp = jax.device_put(jnp.zeros((16, 28, 28, 1), jnp.float32), data_sh)
+    tgt = jax.device_put(jnp.ones((16,), jnp.int32), data_sh)
+    compiled = step.lower(params, model.state, opt_state, inp, tgt,
+                          jnp.float32(0.05), jax.random.key(1)).compile()
+    colls = collective_counts(compiled.as_text())
+    assert colls.get("all-reduce", 0) >= 1, colls
+
+
+def test_collective_counts_parses_hlo_snippets():
+    hlo = """
+    %all-reduce.1 = f32[100]{0} all-reduce(%p), replica_groups={}
+    %all-gather.2 = f32[8,4]{1,0} all-gather(%x), dimensions={0}
+    %add.3 = f32[] add(%a, %b)
+    """
+    counts = collective_counts(hlo)
+    assert counts.get("all-reduce") == 1
+    assert counts.get("all-gather") == 1
+    assert "reduce-scatter" not in counts
