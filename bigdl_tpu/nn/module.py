@@ -292,6 +292,33 @@ class Module:
 
     # -- misc parity helpers ------------------------------------------
 
+    def get_times(self):
+        """(module, forward_seconds, backward_seconds) triples for this module
+        tree, populated by the most recent utils.profiling.ModuleProfiler run
+        (reference: AbstractModule.getTimes, abstractnn/AbstractModule.scala:197
+        — always-on there; opt-in here because per-layer timers cannot live
+        inside one fused XLA program)."""
+        out = []
+
+        def walk(m):
+            f, b = getattr(m, "_profile_times", (0.0, 0.0))
+            out.append((m, f, b))
+            for c in getattr(m, "modules", []):
+                walk(c)
+
+        walk(self)
+        return out
+
+    def reset_times(self):
+        """Clear profiling counters (AbstractModule.resetTimes:204)."""
+        def walk(m):
+            if hasattr(m, "_profile_times"):
+                del m._profile_times
+            for c in getattr(m, "modules", []):
+                walk(c)
+
+        walk(self)
+
     def set_name(self, name: str):
         self.name = name
         return self

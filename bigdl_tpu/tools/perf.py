@@ -30,7 +30,8 @@ _MODELS = {"inception_v1": ("inception", 1000), "vgg16": ("vgg16", 1000),
            "lenet": ("lenet", 10)}
 
 
-def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
+def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
+        profile_dir: str = None):
     from ..models.run import _build_model
     from ..nn import (ClassNLLCriterion, CrossEntropyCriterion,
                       MSECriterion)
@@ -74,10 +75,15 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3):
         one()
     fetch_scalar(one())
     dt, detail = measure_step_seconds(one, n2=max(iters, 8))
-    return {"model": model_name, "batch_size": batch_size,
-            "step_seconds": dt, "records_per_second": batch_size / dt,
-            "compile_seconds": compile_s, "timing": detail,
-            "device": str(jax.devices()[0])}
+    out = {"model": model_name, "batch_size": batch_size,
+           "step_seconds": dt, "records_per_second": batch_size / dt,
+           "compile_seconds": compile_s, "timing": detail,
+           "device": str(jax.devices()[0])}
+    if profile_dir:
+        # xplane trace of the real compiled step (SURVEY.md §7.6)
+        from ..utils.profiling import trace_steps
+        out["profile_dir"] = trace_steps(one, max(iters // 2, 3), profile_dir)
+    return out
 
 
 def main(argv=None):
@@ -88,9 +94,11 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler xplane trace of the step here")
     args = ap.parse_args(argv)
     print(json.dumps(run(args.model, args.batch_size, args.iters,
-                         args.warmup)))
+                         args.warmup, profile_dir=args.profile_dir)))
 
 
 if __name__ == "__main__":

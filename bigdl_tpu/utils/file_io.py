@@ -1,16 +1,21 @@
-"""Checkpoint save/load.
+"""Checkpoint save/load with URL-scheme storage dispatch.
 
 Reference: BigDL `utils/File.scala:25` — java-serialization save/load with
-HDFS/S3 support (saveToHdfs:106); checkpoint file contract `model.<neval>` /
+HDFS/S3 support (`saveToHdfs:106`, `loadFromHdfs:139`: the path's scheme
+selects the Hadoop filesystem); checkpoint file contract `model.<neval>` /
 `optimMethod.<neval>` written by `optim/Optimizer.scala:284-322` and
 `DistriOptimizer.scala:394-416`, resumed via `getLatestFile`
 (DistriOptimizer.scala:828-845).
 
-TPU-native re-design: params/state pytrees are pulled to host numpy and written
-as a single .npz-in-pickle blob (portable, no JVM serialization); the
+TPU-native re-design: params/state pytrees are pulled to host numpy and
+written as a single pickle blob (portable, no JVM serialization); the
 `model.<neval>` / `optimMethod.<neval>` naming contract is preserved so
-resume-by-latest works identically.  Remote stores (HDFS/S3/GCS) are out of
-scope for this image (zero egress) — the API takes any local path.
+resume-by-latest works identically.  Storage dispatch mirrors the
+reference's scheme-based routing: plain paths use the local FS fast path
+(atomic tmp+rename); `gs://`, `s3://`, `hdfs://`, ... routes through fsspec
+(the TPU-native stack's HDFS: GCS is the storage actually attached to TPU
+pods).  Custom backends register with `register_filesystem` (tests register
+a `mem://` store).
 """
 
 from __future__ import annotations
@@ -18,12 +23,137 @@ from __future__ import annotations
 import os
 import pickle
 import re
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File"]
+__all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File",
+           "register_filesystem", "get_filesystem"]
+
+_SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+
+
+class LocalFileSystem:
+    """Local fast path with atomic writes (tmp + rename)."""
+
+    def write_pickle(self, path: str, obj) -> None:
+        """Stream-pickle straight to disk (no whole-blob bytes object —
+        matters for multi-GB checkpoints)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+
+    def read_pickle(self, path: str):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str):
+        return os.listdir(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+
+class FsspecFileSystem:
+    """Remote store via fsspec (gs://, s3://, hdfs://, memory://, ...)."""
+
+    def __init__(self, scheme: str):
+        import fsspec
+        self.scheme = scheme
+        self._fs = fsspec.filesystem(scheme)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        parent = path.rsplit("/", 1)[0]
+        if parent and parent != path:
+            try:
+                self._fs.makedirs(self._strip(parent), exist_ok=True)
+            except Exception:  # noqa: BLE001 — flat stores have no dirs
+                pass
+        with self._fs.open(self._strip(path), "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open(self._strip(path), "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(self._strip(path))
+
+    def isdir(self, path: str) -> bool:
+        try:
+            return self._fs.isdir(self._strip(path))
+        except Exception:  # noqa: BLE001
+            return False
+
+    def listdir(self, path: str):
+        return [p.rsplit("/", 1)[-1]
+                for p in self._fs.ls(self._strip(path), detail=False)]
+
+    def makedirs(self, path: str) -> None:
+        try:
+            self._fs.makedirs(self._strip(path), exist_ok=True)
+        except Exception:  # noqa: BLE001 — flat stores have no dirs
+            pass
+
+    def _strip(self, path: str) -> str:
+        # fsspec accepts scheme-qualified paths; keep them as-is
+        return path
+
+
+_REGISTRY: Dict[str, Any] = {}
+_LOCAL = LocalFileSystem()
+
+
+def register_filesystem(scheme: str, fs) -> None:
+    """Install a filesystem for a URL scheme (tests: an in-memory store)."""
+    _REGISTRY[scheme] = fs
+
+
+def get_filesystem(path: str):
+    """Route a path to its filesystem by scheme (File.scala:106 role)."""
+    m = _SCHEME_RE.match(path)
+    if not m:
+        return _LOCAL
+    scheme = m.group(1)
+    if scheme == "file":
+        return _LOCAL
+    if scheme not in _REGISTRY:
+        _REGISTRY[scheme] = FsspecFileSystem(scheme)
+    return _REGISTRY[scheme]
+
+
+def _join(base: str, name: str) -> str:
+    if _SCHEME_RE.match(base):
+        return base.rstrip("/") + "/" + name
+    return os.path.join(base, name)
+
+
+def _strip_file_scheme(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
 
 
 def _to_numpy(tree):
@@ -34,28 +164,36 @@ def _to_numpy(tree):
 
 
 def save(obj: Any, path: str, overwrite: bool = True) -> None:
-    """(File.scala:25 `save`)."""
-    if os.path.exists(path) and not overwrite:
+    """(File.scala:25 `save`; remote schemes = saveToHdfs:106 role)."""
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    if fs.exists(path) and not overwrite:
         raise FileExistsError(path)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(_to_numpy(obj), f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    obj = _to_numpy(obj)
+    if hasattr(fs, "write_pickle"):  # local: stream, no whole-blob copy
+        fs.write_pickle(path, obj)
+    else:
+        fs.write_bytes(path, pickle.dumps(obj,
+                                          protocol=pickle.HIGHEST_PROTOCOL))
 
 
 def load(path: str) -> Any:
-    """(File.scala `load`)."""
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    """(File.scala `load`; remote schemes = loadFromHdfs:139 role)."""
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    if hasattr(fs, "read_pickle"):
+        return fs.read_pickle(path)
+    return pickle.loads(fs.read_bytes(path))
 
 
 def save_checkpoint(path: str, neval: int, model_blob: Any,
                     optim_blob: Any, overwrite: bool = True) -> Tuple[str, str]:
     """Write model.<neval> + optimMethod.<neval>
     (DistriOptimizer.scala:394-416)."""
-    os.makedirs(path, exist_ok=True)
-    mp = os.path.join(path, f"model.{neval}")
-    op = os.path.join(path, f"optimMethod.{neval}")
+    path = _strip_file_scheme(path)
+    get_filesystem(path).makedirs(path)
+    mp = _join(path, f"model.{neval}")
+    op = _join(path, f"optimMethod.{neval}")
     save(model_blob, mp, overwrite)
     save(optim_blob, op, overwrite)
     return mp, op
@@ -64,20 +202,21 @@ def save_checkpoint(path: str, neval: int, model_blob: Any,
 def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
     """Find the newest (model, optimMethod, neval) triple
     (getLatestFile, DistriOptimizer.scala:828-845)."""
-    if not os.path.isdir(path):
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    if not fs.isdir(path):
         return None
     best = -1
-    for name in os.listdir(path):
+    for name in fs.listdir(path):
         m = re.fullmatch(r"model\.(\d+)", name)
         if m:
             n = int(m.group(1))
-            if n > best and os.path.exists(
-                    os.path.join(path, f"optimMethod.{n}")):
+            if n > best and fs.exists(_join(path, f"optimMethod.{n}")):
                 best = n
     if best < 0:
         return None
-    return (os.path.join(path, f"model.{best}"),
-            os.path.join(path, f"optimMethod.{best}"), best)
+    return (_join(path, f"model.{best}"),
+            _join(path, f"optimMethod.{best}"), best)
 
 
 class File:

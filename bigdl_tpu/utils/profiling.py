@@ -1,0 +1,176 @@
+"""Per-module profiling + compiled-step tracing.
+
+Reference: `nn/abstractnn/AbstractModule.scala:193-217` — every module
+accumulates `forwardTime`/`backwardTime` inside the `forward`/`backward`
+wrappers and `getTimes()` returns (module, forwardTime, backwardTime)
+triples; conv layers additionally track im2col/col2im time
+(SpatialConvolution.scala:108-113).
+
+TPU-native re-design: always-on per-layer timers are impossible inside one
+fused XLA program (and would defeat the fusion that makes the step fast), so
+profiling splits into two tools matching the two execution modes:
+
+1. `ModuleProfiler` — EAGER per-module wall times.  Wraps every submodule's
+   `apply` on the instance tree, synchronizing on each output (host fetch —
+   `block_until_ready` does not synchronize on this image's tunneled
+   backend, see utils/timing.py), and measures per-leaf backward via
+   `jax.vjp` on the captured inputs.  `model.get_times()` then mirrors the
+   reference's `getTimes()` contract.
+
+2. `trace_steps` — the compiled path: wraps N executions of the real train
+   step in `jax.profiler.trace`, producing a TensorBoard-loadable xplane
+   trace where XLA's own per-op breakdown lives (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from .timing import fetch_scalar
+
+__all__ = ["ModuleProfiler", "trace_steps"]
+
+
+def _sync(x) -> None:
+    leaves = jax.tree.leaves(x)
+    if not leaves or isinstance(leaves[0], jax.core.Tracer):
+        return  # under a jax trace (e.g. facade backward's vjp): no-op
+    try:
+        fetch_scalar(leaves[0])
+    except Exception:  # noqa: BLE001 — non-array leaves
+        pass
+
+
+class ModuleProfiler:
+    """Eager per-module wall-time profiler (AbstractModule.getTimes role).
+
+    Usage:
+        with ModuleProfiler(model) as prof:
+            model.forward(x)
+        for mod, fwd_s, bwd_s in prof.get_times():
+            ...
+
+    Forward times are recorded live (each submodule's apply is wrapped and
+    synced).  Backward times are measured on demand from the captured
+    (params, state, input) of each call via jax.vjp — the facade's whole-
+    model vjp cannot attribute time to submodules, exactly like the
+    reference cannot attribute MKL time across JNI calls without its
+    per-layer wrappers.
+    """
+
+    def __init__(self, model, measure_backward: bool = True):
+        self.model = model
+        self.measure_backward = measure_backward
+        self.fwd: Dict[int, float] = {}
+        self.bwd: Dict[int, float] = {}
+        self.calls: Dict[int, Tuple] = {}
+        self._mods: List = []
+        self._saved: List[Tuple] = []
+
+    def _walk(self, m, _seen=None):
+        # dedup by identity: a shared module instance (weight sharing) must
+        # be wrapped and restored exactly once
+        if _seen is None:
+            _seen = set()
+        if id(m) in _seen:
+            return
+        _seen.add(id(m))
+        yield m
+        for child in getattr(m, "modules", []):
+            yield from self._walk(child, _seen)
+
+    def __enter__(self):
+        self._mods = list(self._walk(self.model))
+        for m in self._mods:
+            orig = m.apply
+            self._saved.append((m, orig))
+
+            def timed(params, state, input, *, training=False, rng=None,
+                      _m=m, _orig=orig):
+                t0 = time.perf_counter()
+                out, ns = _orig(params, state, input, training=training,
+                                rng=rng)
+                _sync(out)
+                key = id(_m)
+                self.fwd[key] = self.fwd.get(key, 0.0) + \
+                    (time.perf_counter() - t0)
+                self.calls[key] = (params, state, input, training, rng)
+                return out, ns
+
+            m.apply = timed
+        return self
+
+    def __exit__(self, *exc):
+        for m, _orig in self._saved:
+            # the wrapper lives in the instance __dict__; deleting it
+            # re-exposes the class method (bound methods never lived there)
+            m.__dict__.pop("apply", None)
+        self._saved = []
+        if self.measure_backward and not any(exc):
+            self._measure_backward()
+        # publish on the model for the get_times() parity accessor
+        for m in self._mods:
+            m._profile_times = (self.fwd.get(id(m), 0.0),
+                                self.bwd.get(id(m), 0.0))
+        return False
+
+    def _measure_backward(self):
+        import jax.numpy as jnp
+        for m in self._mods:
+            rec = self.calls.get(id(m))
+            if rec is None or getattr(m, "modules", None):
+                continue  # containers: reported as sum of leaves
+            params, state, input, training, rng = rec
+
+            def f(p, x, _m=m, _s=state, _t=training, _r=rng):
+                out, _ = _m.apply(p, _s, x, training=_t, rng=_r)
+                return out
+
+            try:
+                out, vjp = jax.vjp(f, params, input)
+                ct = jax.tree.map(lambda o: jnp.ones_like(o), out)
+                t0 = time.perf_counter()
+                grads = vjp(ct)
+                _sync(grads)
+                self.bwd[id(m)] = time.perf_counter() - t0
+            except Exception:  # noqa: BLE001 — non-differentiable layers
+                continue
+        # containers: sum of their leaves (reference reports the wrapper
+        # time, which includes children)
+        for m in self._mods:
+            if getattr(m, "modules", None):
+                self.bwd[id(m)] = sum(
+                    self.bwd.get(id(c), 0.0) for c in self._walk(m)
+                    if c is not m)
+
+    def get_times(self) -> List[Tuple[Any, float, float]]:
+        """(module, forward_seconds, backward_seconds) per submodule —
+        the reference's getTimes() shape (AbstractModule.scala:197)."""
+        return [(m, self.fwd.get(id(m), 0.0), self.bwd.get(id(m), 0.0))
+                for m in self._mods]
+
+    def summary(self, top: int = 20) -> str:
+        rows = sorted(self.get_times(), key=lambda r: -(r[1] + r[2]))[:top]
+        lines = [f"{'module':40s} {'fwd_ms':>9s} {'bwd_ms':>9s}"]
+        for m, f, b in rows:
+            lines.append(f"{m.name[:40]:40s} {f*1e3:9.3f} {b*1e3:9.3f}")
+        return "\n".join(lines)
+
+
+def trace_steps(run, n: int, logdir: str):
+    """Run `run()` n times under jax.profiler.trace (SURVEY.md §7.6).
+
+    `run` must return a device value; the last output is host-fetched so the
+    trace covers real execution.  View with TensorBoard's profile plugin or
+    xprof on `logdir`.
+    """
+    out = None
+    with jax.profiler.trace(logdir):
+        for _ in range(n):
+            out = run()
+        if out is not None:
+            _sync(out)
+    return logdir

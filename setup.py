@@ -1,0 +1,47 @@
+"""Build hook: compile the native host-runtime library into the wheel.
+
+Reference role: make-dist.sh + the BigDL-core per-OS Maven artifacts that
+ship libjmkl.so inside jars (SURVEY.md §2.1).  Here `csrc/` builds to
+`bigdl_tpu/lib/libbigdl_tpu_native.so`, which utils/native.py loads with a
+source-tree and pure-Python fallback — so a wheel built on a machine
+without a toolchain still works (host paths run the Python fallbacks).
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_native() -> str | None:
+    csrc = os.path.join(ROOT, "csrc")
+    if not os.path.isdir(csrc) or shutil.which("make") is None:
+        return None
+    try:
+        subprocess.run(["make", "-C", csrc], check=True,
+                       capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        print(f"[setup] native build failed (wheel will use Python "
+              f"fallbacks): {e.stderr[-500:]}")
+        return None
+    so = os.path.join(csrc, "build", "libbigdl_tpu_native.so")
+    return so if os.path.exists(so) else None
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        so = _build_native()
+        if so:
+            dest_dir = os.path.join(ROOT, "bigdl_tpu", "lib")
+            os.makedirs(dest_dir, exist_ok=True)
+            shutil.copy2(so, dest_dir)
+            print(f"[setup] bundled native library: {so}")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -14,8 +14,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")  # harmless if sitecustomize won
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from bigdl_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,92 @@
+"""Per-module profiling (utils/profiling.py) — the getTimes() analog.
+
+Reference: AbstractModule.scala:193-217 accumulates per-module
+forward/backward wall time; getTimes() returns (module, fwd, bwd) triples.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.utils.profiling import ModuleProfiler, trace_steps
+
+
+def test_module_profiler_records_leaf_times():
+    model = nn.Sequential().add(nn.Linear(32, 64)).add(nn.Tanh()) \
+        .add(nn.Linear(64, 8))
+    model.build(jax.random.key(0))
+    x = jnp.ones((16, 32))
+    with ModuleProfiler(model) as prof:
+        y = model.forward(x)
+    assert y.shape == (16, 8)
+    times = prof.get_times()
+    mods = [m for m, _, _ in times]
+    assert model in mods and len(mods) == 4  # container + 3 leaves
+    leaf_fwd = [f for m, f, _ in times if not getattr(m, "modules", None)]
+    assert all(f > 0 for f in leaf_fwd), times
+    leaf_bwd = [b for m, _, b in times if not getattr(m, "modules", None)]
+    assert all(b > 0 for b in leaf_bwd), times
+    # facade restored: no timing wrapper left in any instance __dict__
+    def assert_clean(m):
+        assert "apply" not in m.__dict__, m
+        for c in getattr(m, "modules", []):
+            assert_clean(c)
+    assert_clean(model)
+    assert model.forward(x).shape == (16, 8)
+
+
+def test_get_times_parity_accessor():
+    model = LeNet5(10).build(jax.random.key(0))
+    with ModuleProfiler(model, measure_backward=False):
+        model.forward(jnp.zeros((4, 28, 28, 1)))
+    triples = model.get_times()
+    assert len(triples) > 5  # the whole submodule tree reports
+    total_leaf_fwd = sum(f for m, f, _ in triples
+                         if not getattr(m, "modules", None))
+    assert total_leaf_fwd > 0
+    model.reset_times()
+    assert all(f == 0.0 for _, f, _ in model.get_times())
+
+
+def test_profiler_summary_renders():
+    model = nn.Sequential().add(nn.Linear(8, 8)).build(jax.random.key(0))
+    with ModuleProfiler(model) as prof:
+        model.forward(jnp.ones((2, 8)))
+    s = prof.summary()
+    assert "fwd_ms" in s and "Linear" in s
+
+
+def test_trace_steps_writes_xplane(tmp_path):
+    logdir = str(tmp_path / "trace")
+
+    @jax.jit
+    def step(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((64, 64))
+    out = trace_steps(lambda: step(x), 3, logdir)
+    assert out == logdir
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, f"no xplane trace written under {logdir}"
+
+
+def test_profiler_with_shared_module_and_backward():
+    """Weight-sharing (same instance added twice) and facade backward under
+    the profiler: wrappers must restore exactly and vjp tracing must not
+    crash the sync hook."""
+    shared = nn.Linear(4, 4)
+    m = nn.Sequential().add(shared).add(nn.Tanh()).add(shared)
+    m.build(jax.random.key(0))
+    with ModuleProfiler(m) as prof:
+        y = m.forward(jnp.ones((2, 4)))
+        gx = m.backward(jnp.ones((2, 4)), jnp.ones_like(y))
+    assert gx.shape == (2, 4)
+    assert "apply" not in shared.__dict__ and "apply" not in m.__dict__
+    # forward after exit is wrapper-free and works
+    assert m.forward(jnp.ones((2, 4))).shape == (2, 4)
