@@ -299,8 +299,12 @@ class Module:
         — always-on there; opt-in here because per-layer timers cannot live
         inside one fused XLA program)."""
         out = []
+        seen = set()
 
         def walk(m):
+            if id(m) in seen:  # shared (weight-tied) instance: report once
+                return
+            seen.add(id(m))
             f, b = getattr(m, "_profile_times", (0.0, 0.0))
             out.append((m, f, b))
             for c in getattr(m, "modules", []):
@@ -311,7 +315,12 @@ class Module:
 
     def reset_times(self):
         """Clear profiling counters (AbstractModule.resetTimes:204)."""
+        seen = set()
+
         def walk(m):
+            if id(m) in seen:
+                return
+            seen.add(id(m))
             if hasattr(m, "_profile_times"):
                 del m._profile_times
             for c in getattr(m, "modules", []):

@@ -167,7 +167,9 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
     """(File.scala:25 `save`; remote schemes = saveToHdfs:106 role)."""
     path = _strip_file_scheme(path)
     fs = get_filesystem(path)
-    if fs.exists(path) and not overwrite:
+    # check order matters: exists() can be a remote round-trip, skip it
+    # entirely in the default overwrite=True case
+    if not overwrite and fs.exists(path):
         raise FileExistsError(path)
     obj = _to_numpy(obj)
     if hasattr(fs, "write_pickle"):  # local: stream, no whole-blob copy

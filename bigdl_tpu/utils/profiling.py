@@ -86,10 +86,18 @@ class ModuleProfiler:
         self._mods = list(self._walk(self.model))
         for m in self._mods:
             orig = m.apply
-            self._saved.append((m, orig))
+            # remember whether apply was already an instance attribute
+            # (nested profiler / custom wrapper) so __exit__ restores it
+            self._saved.append((m, m.__dict__.get("apply")))
 
             def timed(params, state, input, *, training=False, rng=None,
                       _m=m, _orig=orig):
+                leaves = jax.tree.leaves((params, input))
+                if leaves and isinstance(leaves[0], jax.core.Tracer):
+                    # under a jax trace (facade backward's vjp, jit):
+                    # timing is meaningless and captured tracers would leak
+                    return _orig(params, state, input, training=training,
+                                 rng=rng)
                 t0 = time.perf_counter()
                 out, ns = _orig(params, state, input, training=training,
                                 rng=rng)
@@ -104,10 +112,12 @@ class ModuleProfiler:
         return self
 
     def __exit__(self, *exc):
-        for m, _orig in self._saved:
-            # the wrapper lives in the instance __dict__; deleting it
-            # re-exposes the class method (bound methods never lived there)
-            m.__dict__.pop("apply", None)
+        for m, prev_instance_apply in self._saved:
+            if prev_instance_apply is not None:
+                m.apply = prev_instance_apply  # restore outer wrapper
+            else:
+                # deleting the instance attr re-exposes the class method
+                m.__dict__.pop("apply", None)
         self._saved = []
         if self.measure_backward and not any(exc):
             self._measure_backward()

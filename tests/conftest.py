@@ -16,7 +16,13 @@ import jax  # noqa: E402
 
 from bigdl_tpu.utils.platform import force_cpu  # noqa: E402
 
-force_cpu(8)
+if not force_cpu(8):
+    # backend already initialized — only acceptable if it is ALREADY the
+    # 8-device CPU config (e.g. re-entrant collection); fail loudly instead
+    # of running the suite on the wrong backend
+    assert jax.default_backend() == "cpu" and jax.device_count() >= 8, (
+        f"jax backend initialized before conftest: "
+        f"{jax.default_backend()} x {jax.device_count()}")
 
 import pytest  # noqa: E402
 

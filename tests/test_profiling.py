@@ -90,3 +90,27 @@ def test_profiler_with_shared_module_and_backward():
     assert "apply" not in shared.__dict__ and "apply" not in m.__dict__
     # forward after exit is wrapper-free and works
     assert m.forward(jnp.ones((2, 4))).shape == (2, 4)
+
+
+def test_nested_profilers_restore_in_order():
+    """Inner profiler exit must restore the OUTER wrapper, not strip it."""
+    m = nn.Sequential().add(nn.Linear(4, 4)).build(jax.random.key(0))
+    x = jnp.ones((2, 4))
+    with ModuleProfiler(m, measure_backward=False) as outer:
+        with ModuleProfiler(m, measure_backward=False) as inner:
+            m.forward(x)
+        m.forward(x)  # outer wrapper must still observe this call
+    assert outer.fwd and inner.fwd
+    assert "apply" not in m.__dict__
+    assert "apply" not in m.modules[0].__dict__
+
+
+def test_backward_inside_profiled_region_keeps_concrete_captures():
+    """model.backward under the profiler runs apply under jax.vjp tracing;
+    recorded captures must stay concrete so backward times are measured."""
+    m = nn.Sequential().add(nn.Linear(4, 4)).build(jax.random.key(0))
+    x = jnp.ones((2, 4))
+    with ModuleProfiler(m) as p:
+        y = m.forward(x)
+        m.backward(x, jnp.ones_like(y))
+    assert p.bwd.get(id(m.modules[0]), 0.0) > 0.0, p.bwd

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Generate GENUINE foreign-format interop fixtures (committed under
+tests/fixtures/interop/).
+
+Round-2 verdict demand #6: the interop suite only round-tripped this repo's
+own savers, so a convention bug shared by saver+loader would pass.  These
+fixtures are produced by INDEPENDENT encoders:
+
+  * `convnet.pb` — a frozen TensorFlow GraphDef built and exported by REAL
+    tensorflow (present in this image), with expected outputs computed by a
+    real tf session.  Nothing from bigdl_tpu.interop touches the bytes.
+  * `lenet_bn.caffemodel` — encoded by the minimal protobuf wire writer IN
+    THIS FILE (no bigdl_tpu.utils.pbwire, no interop.caffe), using the
+    public caffe.proto field numbers; expected outputs computed by the
+    plain-numpy NCHW forward implemented here.
+  * `codec.t7` — Torch7 binary written by the minimal writer IN THIS FILE
+    (no interop.torchfile), following the public torch7/File.lua format.
+
+Run from the repo root:  python tools/gen_interop_fixtures.py
+Deterministic (fixed seeds): regenerating must reproduce identical bytes,
+so fixture drift shows up in git.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "interop")
+
+
+# ---------------------------------------------------------------------------
+# independent minimal protobuf wire encoder
+# ---------------------------------------------------------------------------
+
+def _vint(n: int) -> bytes:
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _vint((field << 3) | wire)
+
+
+def pb_uint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _vint(v)
+
+
+def pb_bool(field: int, v: bool) -> bytes:
+    return pb_uint(field, 1 if v else 0)
+
+
+def pb_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _vint(len(payload)) + payload
+
+
+def pb_str(field: int, s: str) -> bytes:
+    return pb_bytes(field, s.encode())
+
+
+def pb_packed_floats(field: int, arr) -> bytes:
+    a = np.asarray(arr, np.float32).ravel()
+    return pb_bytes(field, struct.pack(f"<{a.size}f", *a))
+
+
+def pb_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+# ---------------------------------------------------------------------------
+# caffe fixture: conv -> BN -> Scale -> ReLU -> MaxPool -> InnerProduct ->
+# Softmax, hand-encoded NetParameter + plain-numpy NCHW forward oracle
+# ---------------------------------------------------------------------------
+
+def _blob(arr) -> bytes:
+    a = np.asarray(arr, np.float32)
+    shape = b"".join(pb_uint(1, int(d)) for d in a.shape)
+    return pb_bytes(7, shape) + pb_packed_floats(5, a)
+
+
+def make_caffe_fixture():
+    r = np.random.default_rng(42)
+    cin, cout, hw, classes = 2, 4, 8, 3
+    conv_w = r.normal(0, 0.3, size=(cout, cin, 3, 3)).astype(np.float32)
+    conv_b = r.normal(0, 0.1, size=(cout,)).astype(np.float32)
+    bn_mean = r.normal(0, 0.5, size=(cout,)).astype(np.float32)
+    bn_var = (r.uniform(0.5, 2.0, size=(cout,))).astype(np.float32)
+    bn_factor = np.float32(2.0)  # stored mean/var are scaled by this
+    gamma = r.uniform(0.5, 1.5, size=(cout,)).astype(np.float32)
+    beta = r.normal(0, 0.2, size=(cout,)).astype(np.float32)
+    # InnerProduct over the pooled 4x4 map, columns in caffe's (C,H,W) order
+    fc_w = r.normal(0, 0.2, size=(classes, cout * 4 * 4)).astype(np.float32)
+    fc_b = r.normal(0, 0.1, size=(classes,)).astype(np.float32)
+
+    def layer(name, type_, bottoms, tops, blobs=(), extra=b""):
+        body = pb_str(1, name) + pb_str(2, type_)
+        body += b"".join(pb_str(3, b) for b in bottoms)
+        body += b"".join(pb_str(4, t) for t in tops)
+        body += b"".join(pb_bytes(7, _blob(a)) for a in blobs)
+        return pb_bytes(100, body + extra)
+
+    conv_param = (pb_uint(1, cout) + pb_bool(2, True) + pb_uint(3, 1) +
+                  pb_uint(4, 3) + pb_uint(6, 1))
+    pool_param = pb_uint(1, 0) + pb_uint(2, 2) + pb_uint(3, 2)
+    ip_param = pb_uint(1, classes) + pb_bool(2, True)
+    bn_param = pb_bool(1, True) + pb_float(3, 1e-5)
+    scale_param = pb_bool(4, True)
+
+    net = pb_str(1, "fixture_net")
+    net += pb_str(3, "data")
+    for d in (1, cin, hw, hw):
+        net += pb_uint(4, d)
+    net += layer("conv1", "Convolution", ["data"], ["conv1"],
+                 [conv_w, conv_b], pb_bytes(106, conv_param))
+    net += layer("bn1", "BatchNorm", ["conv1"], ["bn1"],
+                 [bn_mean * bn_factor, bn_var * bn_factor,
+                  np.array([bn_factor])],
+                 pb_bytes(139, bn_param))
+    net += layer("scale1", "Scale", ["bn1"], ["scale1"], [gamma, beta],
+                 pb_bytes(142, scale_param))
+    net += layer("relu1", "ReLU", ["scale1"], ["relu1"])
+    net += layer("pool1", "Pooling", ["relu1"], ["pool1"],
+                 extra=pb_bytes(103, pool_param))
+    net += layer("fc", "InnerProduct", ["pool1"], ["fc"], [fc_w, fc_b],
+                 pb_bytes(117, ip_param))
+    net += layer("prob", "Softmax", ["fc"], ["prob"])
+
+    # plain-numpy NCHW forward (the caffe-semantics oracle)
+    x = r.normal(0, 1, size=(2, cin, hw, hw)).astype(np.float32)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((2, cout, hw, hw), np.float32)
+    for i in range(hw):
+        for j in range(hw):
+            patch = xp[:, :, i:i + 3, j:j + 3]
+            conv[:, :, i, j] = np.tensordot(
+                patch, conv_w, axes=([1, 2, 3], [1, 2, 3])) + conv_b
+    bn = (conv - bn_mean[None, :, None, None]) / np.sqrt(
+        bn_var[None, :, None, None] + 1e-5)
+    sc = bn * gamma[None, :, None, None] + beta[None, :, None, None]
+    relu = np.maximum(sc, 0.0)
+    pool = relu.reshape(2, cout, 4, 2, 4, 2).max(axis=(3, 5))
+    flat = pool.reshape(2, -1)  # (C,H,W) order — caffe's flatten
+    logits = flat @ fc_w.T + fc_b
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    prob = e / e.sum(-1, keepdims=True)
+
+    with open(os.path.join(OUT, "lenet_bn.caffemodel"), "wb") as f:
+        f.write(net)
+    # input for the loader is NHWC
+    np.savez(os.path.join(OUT, "lenet_bn_expected.npz"),
+             input_nhwc=x.transpose(0, 2, 3, 1), prob=prob, logits=logits)
+    print("caffe fixture:", len(net), "bytes")
+
+
+# ---------------------------------------------------------------------------
+# tf fixture: frozen GraphDef produced by real tensorflow
+# ---------------------------------------------------------------------------
+
+def make_tf_fixture():
+    import tensorflow as tf
+
+    r = np.random.default_rng(7)
+    g = tf.Graph()
+    with g.as_default():
+        inp = tf.compat.v1.placeholder(tf.float32, (1, 8, 8, 2),
+                                       name="input")
+        w1 = tf.constant(r.normal(0, 0.3, (3, 3, 2, 4)).astype(np.float32))
+        b1 = tf.constant(r.normal(0, 0.1, (4,)).astype(np.float32))
+        c = tf.nn.conv2d(inp, w1, strides=[1, 1, 1, 1], padding="SAME")
+        c = tf.nn.bias_add(c, b1)
+        c = tf.nn.relu(c)
+        p = tf.nn.max_pool2d(c, ksize=2, strides=2, padding="VALID")
+        flat = tf.reshape(p, (1, 4 * 4 * 4))
+        w2 = tf.constant(r.normal(0, 0.2, (64, 3)).astype(np.float32))
+        b2 = tf.constant(r.normal(0, 0.1, (3,)).astype(np.float32))
+        logits = tf.nn.bias_add(tf.matmul(flat, w2), b2)
+        out = tf.nn.softmax(logits, name="output")
+
+        x = r.normal(0, 1, (1, 8, 8, 2)).astype(np.float32)
+        with tf.compat.v1.Session(graph=g) as sess:
+            expected = sess.run(out, {inp: x})
+        gd = g.as_graph_def()
+
+    with open(os.path.join(OUT, "convnet.pb"), "wb") as f:
+        f.write(gd.SerializeToString())
+    np.savez(os.path.join(OUT, "convnet_expected.npz"),
+             input=x, output=expected)
+    print("tf fixture:", len(gd.SerializeToString()), "bytes,",
+          len(gd.node), "nodes:", sorted({n.op for n in gd.node}))
+
+
+# ---------------------------------------------------------------------------
+# t7 fixture: independent minimal Torch7 writer (torch7/File.lua format)
+# ---------------------------------------------------------------------------
+
+class _T7:
+    def __init__(self, f):
+        self.f = f
+        self.next_idx = 1
+
+    def i32(self, v):
+        self.f.write(struct.pack("<i", v))
+
+    def i64(self, v):
+        self.f.write(struct.pack("<q", v))
+
+    def f64(self, v):
+        self.f.write(struct.pack("<d", v))
+
+    def string(self, s):
+        b = s.encode()
+        self.i32(len(b))
+        self.f.write(b)
+
+    def number(self, v):
+        self.i32(1)
+        self.f64(float(v))
+
+    def boolean(self, v):
+        self.i32(5)
+        self.i32(1 if v else 0)
+
+    def str_value(self, s):
+        self.i32(2)
+        self.string(s)
+
+    def tensor(self, arr):
+        a = np.ascontiguousarray(arr, np.float32)
+        self.i32(4)                      # TYPE_TORCH
+        self.i32(self.next_idx); self.next_idx += 1
+        self.string("V 1")
+        self.string("torch.FloatTensor")
+        self.i32(a.ndim)
+        for d in a.shape:
+            self.i64(d)
+        strides = [int(s // a.itemsize) for s in a.strides]
+        for s in strides:
+            self.i64(s)
+        self.i64(1)                      # storageOffset (1-based)
+        self.i32(4)                      # storage object
+        self.i32(self.next_idx); self.next_idx += 1
+        self.string("V 1")
+        self.string("torch.FloatStorage")
+        self.i64(a.size)
+        self.f.write(a.tobytes())
+
+    def table(self, d):
+        self.i32(3)
+        self.i32(self.next_idx); self.next_idx += 1
+        self.i32(len(d))
+        for k, v in d.items():
+            if isinstance(k, str):
+                self.str_value(k)
+            else:
+                self.number(k)
+            if isinstance(v, np.ndarray):
+                self.tensor(v)
+            elif isinstance(v, bool):
+                self.boolean(v)
+            elif isinstance(v, (int, float)):
+                self.number(v)
+            elif isinstance(v, str):
+                self.str_value(v)
+            elif isinstance(v, dict):
+                self.table(v)
+            else:
+                raise TypeError(type(v))
+
+
+def make_t7_fixture():
+    r = np.random.default_rng(3)
+    weight = r.normal(0, 1, (4, 5)).astype(np.float32)
+    bias = r.normal(0, 1, (4,)).astype(np.float32)
+    obj = {"weight": weight, "bias": bias, "train": False,
+           "name": "fixture", "epoch": 3,
+           "nested": {1: 10.5, 2: "two"}}
+    path = os.path.join(OUT, "codec.t7")
+    with open(path, "wb") as f:
+        _T7(f).table(obj)
+    np.savez(os.path.join(OUT, "codec_t7_expected.npz"),
+             weight=weight, bias=bias)
+    print("t7 fixture:", os.path.getsize(path), "bytes")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    make_caffe_fixture()
+    make_t7_fixture()
+    try:
+        make_tf_fixture()
+    except ImportError:
+        print("tensorflow not available; skipping tf fixture",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
