@@ -58,6 +58,30 @@ def set_policy(policy: DTypePolicy) -> None:
     _policy = policy
 
 
+_warned_accum = set()
+
+
+def conv_accum_dtype():
+    """`preferred_element_type` for convolutions under the current policy.
+
+    Explicit f32 accumulation is requested only when computing in f32: jax's
+    conv transpose (autodiff) rejects a preferred_element_type that differs
+    from the operand dtype (unlike dot_general).  bf16 needs no request —
+    the TPU MXU accumulates bf16 convolutions in f32 natively.  Other
+    reduced dtypes (e.g. float16, which TPUs do not support natively) get
+    same-dtype accumulation and a one-time warning."""
+    c = jnp.dtype(_policy.compute_dtype)
+    if c == jnp.dtype(jnp.float32):
+        return jnp.float32
+    if c != jnp.dtype(jnp.bfloat16) and c.name not in _warned_accum:
+        _warned_accum.add(c.name)
+        import logging
+        logging.getLogger("bigdl_tpu").warning(
+            "compute_dtype %s: convolutions accumulate in the same dtype "
+            "(no f32 accumulation guarantee; prefer bfloat16 on TPU)", c.name)
+    return None
+
+
 class _RngStream:
     """Host-side deterministic key stream (the facade's hidden RNG).
 

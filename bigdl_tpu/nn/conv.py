@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..common import get_policy
+from ..common import conv_accum_dtype, get_policy
 from .initialization import default_bias_init, default_weight_init
 from .module import Module
 
@@ -90,7 +90,7 @@ class SpatialConvolution(Module):
             rhs_dilation=rhs_dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32)
+            preferred_element_type=conv_accum_dtype())
         return y.astype(c)
 
     def _apply(self, params, x):
@@ -241,7 +241,7 @@ class SpatialFullConvolution(Module):
             lhs_dilation=(sh, sw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32).astype(c)
+            preferred_element_type=conv_accum_dtype()).astype(c)
         if self.with_bias:
             y = y + params["bias"].astype(y.dtype)
         return y
@@ -282,7 +282,7 @@ class TemporalConvolution(Module):
             window_strides=(self.stride_w,),
             padding=[(0, 0)],
             dimension_numbers=("NWC", "WIO", "NWC"),
-            preferred_element_type=jnp.float32).astype(c)
+            preferred_element_type=conv_accum_dtype()).astype(c)
         return y + params["bias"].astype(y.dtype)
 
 
@@ -321,7 +321,7 @@ class VolumetricConvolution(Module):
             window_strides=self.stride,
             padding=[(pt, pt), (ph, ph), (pw, pw)],
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            preferred_element_type=jnp.float32).astype(c)
+            preferred_element_type=conv_accum_dtype()).astype(c)
         if self.with_bias:
             y = y + params["bias"].astype(y.dtype)
         return y

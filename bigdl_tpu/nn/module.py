@@ -298,35 +298,32 @@ class Module:
         (reference: AbstractModule.getTimes, abstractnn/AbstractModule.scala:197
         — always-on there; opt-in here because per-layer timers cannot live
         inside one fused XLA program)."""
-        out = []
-        seen = set()
-
-        def walk(m):
-            if id(m) in seen:  # shared (weight-tied) instance: report once
-                return
-            seen.add(id(m))
-            f, b = getattr(m, "_profile_times", (0.0, 0.0))
-            out.append((m, f, b))
-            for c in getattr(m, "modules", []):
-                walk(c)
-
-        walk(self)
-        return out
+        return [(m, *getattr(m, "_profile_times", (0.0, 0.0)))
+                for m in self.unique_modules()]
 
     def reset_times(self):
         """Clear profiling counters (AbstractModule.resetTimes:204)."""
+        for m in self.unique_modules():
+            if hasattr(m, "_profile_times"):
+                del m._profile_times
+
+    def unique_modules(self):
+        """Pre-order walk of the module tree, visiting each INSTANCE once —
+        shared (weight-tied) submodules appear a single time.  Shared by
+        get_times/reset_times and utils.profiling.ModuleProfiler."""
         seen = set()
 
         def walk(m):
             if id(m) in seen:
                 return
             seen.add(id(m))
-            if hasattr(m, "_profile_times"):
-                del m._profile_times
+            yield m
             for c in getattr(m, "modules", []):
-                walk(c)
+                yield from walk(c)
 
-        walk(self)
+        # note: the inner generator must be consumed, not returned, so the
+        # seen-set is shared across recursion
+        yield from walk(self)
 
     def set_name(self, name: str):
         self.name = name

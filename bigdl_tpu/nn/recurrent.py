@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..common import get_policy
+from ..common import conv_accum_dtype, get_policy
 from .module import Container, Module
 
 __all__ = ["Cell", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "ConvLSTMPeephole",
@@ -253,7 +253,7 @@ class ConvLSTMPeephole(Cell):
             z, params["kernel"].astype(z.dtype),
             (self.stride,) * n, [(pad, pad)] * n,
             dimension_numbers=self._DIM_NUMBERS[n],
-            preferred_element_type=jnp.float32) + params["bias"]
+            preferred_element_type=conv_accum_dtype()) + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         cf = cst.astype(jnp.float32)
         if self.with_peephole:

@@ -70,20 +70,10 @@ class ModuleProfiler:
         self._mods: List = []
         self._saved: List[Tuple] = []
 
-    def _walk(self, m, _seen=None):
-        # dedup by identity: a shared module instance (weight sharing) must
-        # be wrapped and restored exactly once
-        if _seen is None:
-            _seen = set()
-        if id(m) in _seen:
-            return
-        _seen.add(id(m))
-        yield m
-        for child in getattr(m, "modules", []):
-            yield from self._walk(child, _seen)
-
     def __enter__(self):
-        self._mods = list(self._walk(self.model))
+        # identity-deduped walk: a shared module instance (weight sharing)
+        # is wrapped and restored exactly once (Module.unique_modules)
+        self._mods = list(self.model.unique_modules())
         for m in self._mods:
             orig = m.apply
             # remember whether apply was already an instance attribute
@@ -93,7 +83,7 @@ class ModuleProfiler:
             def timed(params, state, input, *, training=False, rng=None,
                       _m=m, _orig=orig):
                 leaves = jax.tree.leaves((params, input))
-                if leaves and isinstance(leaves[0], jax.core.Tracer):
+                if any(isinstance(l, jax.core.Tracer) for l in leaves):
                     # under a jax trace (facade backward's vjp, jit):
                     # timing is meaningless and captured tracers would leak
                     return _orig(params, state, input, training=training,
@@ -153,7 +143,7 @@ class ModuleProfiler:
         for m in self._mods:
             if getattr(m, "modules", None):
                 self.bwd[id(m)] = sum(
-                    self.bwd.get(id(c), 0.0) for c in self._walk(m)
+                    self.bwd.get(id(c), 0.0) for c in m.unique_modules()
                     if c is not m)
 
     def get_times(self) -> List[Tuple[Any, float, float]]:
