@@ -491,11 +491,16 @@ class Optimizer:
                 not self.validation_trigger(state)):
             return
         results = self._run_validation(params, net_state)
+        # observation counter for Trigger.plateau: one validation = one tick
+        state["val_obs"] = state.get("val_obs", 0) + 1
         for method, res in results:
             val, _ = res.result()
             logger.info("Validation %s: %s", method.name, res)
             if method.name in ("Top1Accuracy", "Top5Accuracy"):
                 state["score"] = val
+            elif method.name == "Loss":
+                # early-stopping triggers (Trigger.plateau) monitor this
+                state["val_loss"] = val
             if self.validation_summary is not None:
                 self.validation_summary.add_scalar(
                     method.name, val, state["neval"] - 1)

@@ -70,6 +70,43 @@ class Trigger:
                        f"minLoss({minimum})")
 
     @staticmethod
+    def plateau(monitor: str = "val_loss", patience: int = 3,
+                mode: str = "min", min_delta: float = 0.0,
+                counter: str = "val_obs"):
+        """Fires when `state[monitor]` has not improved for `patience`
+        consecutive observations — estimator-level early stopping.  Mirrors
+        the reference's Plateau policy (SGD.scala:534 applies it to the LR;
+        here it ends training).
+
+        A "new observation" is detected via `state[counter]`, which the
+        Optimizer increments at every validation (so a perfectly constant
+        monitored value still counts).  Callers driving a state dict
+        without a counter can pass counter=None, falling back to
+        value-change detection (which cannot see exact plateaus)."""
+        sign = 1.0 if mode == "min" else -1.0
+        box = {"best": None, "bad": 0, "last": None, "tick": None}
+
+        def fn(state):
+            v = state.get(monitor)
+            if v is None:
+                return False
+            if counter is not None and counter in state:
+                if state[counter] == box["tick"]:
+                    return False  # same observation as last check
+                box["tick"] = state[counter]
+            elif v == box["last"]:
+                return False
+            box["last"] = v
+            if box["best"] is None or sign * v < sign * box["best"] - min_delta:
+                box["best"] = v
+                box["bad"] = 0
+                return False
+            box["bad"] += 1
+            return box["bad"] >= patience
+
+        return Trigger(fn, f"plateau({monitor},{patience})")
+
+    @staticmethod
     def and_(*triggers):
         return Trigger(lambda s: all(t(s) for t in triggers), "and")
 

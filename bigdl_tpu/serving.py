@@ -1,0 +1,102 @@
+"""Model serving as DataFrame/SQL-style UDFs.
+
+Reference: `example/udfpredictor/` — `DataframePredictor.scala` loads a
+trained text classifier and registers a Spark SQL UDF so queries can filter
+rows by predicted class (`SELECT ... WHERE textClassifier(text) = k`), with
+`Utils.scala` holding the text -> embedded-tensor preprocessing.
+
+TPU-native re-design: there is no Spark SQL session; the host query engine
+is pandas (or plain Python).  `UDFPredictor` wraps a trained Module as a
+vectorized callable: rows in -> predictions out, internally batched and
+mesh-sharded through `optim.Predictor`, so it drops into
+`df[udf(df["text"]) == k]` filters, `DataFrame.assign`, or any row-wise
+serving loop.  `TextClassifierUDF` packages the reference example's text
+pipeline (tokenize -> dictionary lookup -> pad/crop -> embed).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .nn.module import Module
+from .optim.optimizer import Predictor
+
+__all__ = ["UDFPredictor", "TextClassifierUDF"]
+
+
+class UDFPredictor:
+    """Vectorized predict-UDF over rows (DataframePredictor.scala role).
+
+    preprocess: row -> feature ndarray (applied per row, host-side).
+    postprocess: model outputs (N, ...) -> predictions (N,); defaults to
+      argmax over the last axis (the reference UDF returns the class id).
+    """
+
+    def __init__(self, model: Module, preprocess: Optional[Callable] = None,
+                 postprocess: Optional[Callable] = None,
+                 batch_size: int = 128):
+        self.model = model
+        self.preprocess = preprocess
+        self.postprocess = postprocess or (
+            lambda out: np.argmax(out, axis=-1))
+        self._predictor = Predictor(model, batch_size=batch_size)
+
+    def __call__(self, rows) -> np.ndarray:
+        if hasattr(rows, "to_numpy"):  # pandas Series
+            rows = rows.to_numpy()
+        feats = (np.stack([np.asarray(self.preprocess(r), np.float32)
+                           for r in rows])
+                 if self.preprocess is not None
+                 else np.asarray(rows, np.float32))
+        bs = self._predictor.batch_size
+        # chunk host-side: one XLA call per batch, never one giant buffer
+        out = np.concatenate(
+            [np.asarray(self._predictor.predict(feats[i:i + bs]))
+             for i in range(0, len(feats), bs)], axis=0)
+        return self.postprocess(out)
+
+    def register(self, namespace: dict, name: str) -> "UDFPredictor":
+        """Install the UDF under `name` (the Spark `udf.register` analog —
+        the namespace is any dict, e.g. globals() or a query-engine
+        function registry)."""
+        namespace[name] = self
+        return self
+
+
+class TextClassifierUDF(UDFPredictor):
+    """The reference example end-to-end: raw text -> class id
+    (example/udfpredictor/Utils.scala getTextClassifierUDF).
+
+    dictionary: dataset.text.Dictionary (word -> index, 0-based).
+    embeddings: (>= vocab+1, embed_dim) lookup table; the LAST row is the
+      padding row (conventionally zeros) — Dictionary assigns index 0 to a
+      real word, so padding must not alias it.
+    seq_len: fixed token length (pad/crop) so shapes stay static under jit.
+    """
+
+    def __init__(self, model: Module, dictionary, embeddings: np.ndarray,
+                 seq_len: int = 500, batch_size: int = 128,
+                 tokenizer: Optional[Callable] = None,
+                 pad_index: Optional[int] = None):
+        self.dictionary = dictionary
+        self.embeddings = np.asarray(embeddings, np.float32)
+        self.seq_len = seq_len
+        self.tokenizer = tokenizer or (lambda s: s.lower().split())
+        self.pad_index = (len(self.embeddings) - 1 if pad_index is None
+                          else pad_index)
+        super().__init__(model, preprocess=self._embed,
+                         batch_size=batch_size)
+
+    def _embed(self, text: str) -> np.ndarray:
+        toks = self.tokenizer(str(text))[:self.seq_len]
+        idx = np.full((self.seq_len,), self.pad_index, np.int64)
+        for i, t in enumerate(toks):
+            j = self.dictionary.get_index(t)
+            if not 0 <= j < len(self.embeddings):
+                raise IndexError(
+                    f"dictionary index {j} for {t!r} outside the embedding "
+                    f"table ({len(self.embeddings)} rows)")
+            idx[i] = j
+        return self.embeddings[idx]
