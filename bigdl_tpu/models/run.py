@@ -118,12 +118,22 @@ def train(args) -> None:
     ds = DataSet.array(samples).transform(
         SampleToMiniBatch(args.batch_size, drop_last=True))
     method = (Adam(args.learning_rate) if args.optim == "adam"
-              else SGD(args.learning_rate, momentum=0.9))
+              else SGD(args.learning_rate, momentum=args.momentum,
+                       weight_decay=args.weight_decay))
+    end = Trigger.max_epoch(args.max_epoch)
+    if args.max_iteration:
+        end = Trigger.or_(end, Trigger.max_iteration(args.max_iteration))
     opt = (Optimizer(model, ds, criterion)
            .set_optim_method(method)
-           .set_end_when(Trigger.max_epoch(args.max_epoch)))
+           .set_end_when(end))
+    if args.model_snapshot:
+        # reference: --model/--state resume (models/lenet/Train.scala:48-59)
+        opt.resume_from(args.model_snapshot, args.state_snapshot)
     if args.checkpoint:
-        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        trig = (Trigger.several_iteration(args.checkpoint_iteration)
+                if args.checkpoint_iteration else Trigger.every_epoch())
+        opt.set_checkpoint(args.checkpoint, trig,
+                           is_overwrite=args.overwrite)
     if args.summary_dir:
         opt.set_train_summary(TrainSummary(args.summary_dir, args.app_name))
     if crit != "mse" and (args.validate or args.synthetic):
@@ -140,6 +150,7 @@ def train(args) -> None:
     if args.model_save:
         trained.save(args.model_save)
         logger.info("model saved -> %s", args.model_save)
+    return opt  # post-run introspection (tests assert resume continuation)
 
 
 def test(args) -> None:
@@ -171,11 +182,28 @@ def main(argv=None):
         p.add_argument("--batch-size", type=int, default=128)
         p.add_argument("--class-num", type=int, default=10)
         if cmd == "train":
+            # scopt-option parity with the reference Train CLIs
+            # (models/lenet/Utils.scala, models/inception/Options.scala)
             p.add_argument("--max-epoch", type=int, default=5)
+            p.add_argument("--max-iteration", type=int, default=0,
+                           help="also stop after N iterations (-i)")
             p.add_argument("--learning-rate", type=float, default=0.01)
+            p.add_argument("--momentum", type=float, default=0.9)
+            p.add_argument("--weight-decay", type=float, default=0.0)
             p.add_argument("--optim", choices=("sgd", "adam"),
                            default="sgd")
             p.add_argument("--checkpoint")
+            p.add_argument("--checkpoint-iteration", type=int, default=0,
+                           help="checkpoint every N iterations instead of "
+                                "every epoch")
+            p.add_argument("--overwrite", action="store_true",
+                           help="overwrite checkpoint files "
+                                "(--overwriteCheckpoint)")
+            p.add_argument("--model-snapshot",
+                           help="resume model from model.<n> (--model)")
+            p.add_argument("--state-snapshot",
+                           help="resume optim state from optimMethod.<n> "
+                                "(--state)")
             p.add_argument("--summary-dir")
             p.add_argument("--app-name", default="bigdl_tpu")
             p.add_argument("--validate", help="validation BDRecord path")
@@ -188,7 +216,7 @@ def main(argv=None):
                         format="%(asctime)s %(levelname)s %(message)s")
     if not args.synthetic and not args.data:
         ap.error("need --data or --synthetic")
-    (train if args.cmd == "train" else test)(args)
+    return (train if args.cmd == "train" else test)(args)
 
 
 if __name__ == "__main__":

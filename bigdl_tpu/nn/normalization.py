@@ -73,9 +73,20 @@ class BatchNormalization(Module):
                 mean = lax.pmean(mean, self.sync_axis)
                 var = lax.pmean(var, self.sync_axis)
             m = self.momentum
+            # Torch-lineage convention (reference BatchNormalization.scala,
+            # torch BN): normalize with the BIASED batch var, but accumulate
+            # the UNBIASED one into the running EMA
+            n = 1
+            for ax in axes:
+                n *= x.shape[ax]
+            if self.sync_axis is not None:
+                n = n * lax.psum(1, self.sync_axis)  # global element count
+                unbiased = var * (n / jnp.maximum(n - 1, 1))
+            else:
+                unbiased = var * (n / max(n - 1, 1))
             new_state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,
-                "running_var": (1 - m) * state["running_var"] + m * var,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
             }
         else:
             mean = state["running_mean"]

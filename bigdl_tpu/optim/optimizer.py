@@ -338,19 +338,29 @@ class Optimizer:
                     retries, max_retries)
                 self._recover_from_checkpoint()
 
+    def resume_from(self, model_path: str,
+                    optim_path: Optional[str] = None) -> "Optimizer":
+        """Resume from explicit snapshot files — the reference's
+        `--model model.<n> --state optimMethod.<n>` CLI contract
+        (models/lenet/Train.scala:48-59).  With only a model snapshot the
+        optimizer restarts fresh on the loaded weights."""
+        blob = file_io.load(model_path)
+        self.model.params = blob["params"]
+        self.model.state = blob["state"]
+        if optim_path is not None:
+            oblob = file_io.load(optim_path)
+            self.optim_method.load_state_dict(oblob["method"])
+            self._resume_state = oblob["driver_state"]
+            self._resume_opt_state = oblob.get("opt_state")
+        self._compiled = None
+        return self
+
     def _recover_from_checkpoint(self):
         latest = file_io.latest_checkpoint(self.checkpoint_path)
         if latest is None:
             return
         model_path, optim_path, neval = latest
-        blob = file_io.load(model_path)
-        self.model.params = blob["params"]
-        self.model.state = blob["state"]
-        oblob = file_io.load(optim_path)
-        self.optim_method.load_state_dict(oblob["method"])
-        self._resume_state = oblob["driver_state"]
-        self._resume_opt_state = oblob.get("opt_state")
-        self._compiled = None
+        self.resume_from(model_path, optim_path)
 
     def _optimize_impl(self) -> Module:
         mesh = Engine.mesh()

@@ -84,9 +84,15 @@ class Trigger:
         without a counter can pass counter=None, falling back to
         value-change detection (which cannot see exact plateaus)."""
         sign = 1.0 if mode == "min" else -1.0
-        box = {"best": None, "bad": 0, "last": None, "tick": None}
+        box = {"best": None, "bad": 0, "last": None, "tick": None,
+               "fired": False}
 
         def fn(state):
+            if box["fired"]:
+                # latched: the driver checks end triggers at several points
+                # (inner loop + outer while); a one-shot True could be
+                # consumed by the inner check and training would continue
+                return True
             v = state.get(monitor)
             if v is None:
                 return False
@@ -102,7 +108,8 @@ class Trigger:
                 box["bad"] = 0
                 return False
             box["bad"] += 1
-            return box["bad"] >= patience
+            box["fired"] = box["bad"] >= patience
+            return box["fired"]
 
         return Trigger(fn, f"plateau({monitor},{patience})")
 

@@ -46,16 +46,25 @@ class UDFPredictor:
     def __call__(self, rows) -> np.ndarray:
         if hasattr(rows, "to_numpy"):  # pandas Series
             rows = rows.to_numpy()
+        if len(rows) == 0:  # empty filter result: empty predictions
+            return np.empty((0,), np.int64)
         feats = (np.stack([np.asarray(self.preprocess(r), np.float32)
                            for r in rows])
                  if self.preprocess is not None
                  else np.asarray(rows, np.float32))
         bs = self._predictor.batch_size
-        # chunk host-side: one XLA call per batch, never one giant buffer
-        out = np.concatenate(
-            [np.asarray(self._predictor.predict(feats[i:i + bs]))
-             for i in range(0, len(feats), bs)], axis=0)
-        return self.postprocess(out)
+        # chunk host-side (one XLA call per batch, never one giant buffer),
+        # padding the trailing chunk to the full batch shape so jit never
+        # sees a new shape (no per-remainder recompiles)
+        outs = []
+        for i in range(0, len(feats), bs):
+            chunk = feats[i:i + bs]
+            n = len(chunk)
+            if n < bs:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], bs - n, axis=0)])
+            outs.append(np.asarray(self._predictor.predict(chunk))[:n])
+        return self.postprocess(np.concatenate(outs, axis=0))
 
     def register(self, namespace: dict, name: str) -> "UDFPredictor":
         """Install the UDF under `name` (the Spark `udf.register` analog —

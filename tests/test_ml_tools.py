@@ -270,3 +270,15 @@ def test_plateau_trigger_semantics():
     assert not t3({"score": 0.5})
     assert not t3({"score": 0.9})
     assert t3({"score": 0.8})
+
+
+def test_plateau_trigger_latches_after_firing():
+    """Once fired, plateau stays True: the driver polls end triggers at
+    several points and a one-shot True could be consumed by the inner-loop
+    check without ending training."""
+    from bigdl_tpu.optim import Trigger
+    t = Trigger.plateau("val_loss", patience=1)
+    assert not t({"val_loss": 1.0, "val_obs": 1})
+    assert t({"val_loss": 1.0, "val_obs": 2})   # fires
+    assert t({"val_loss": 1.0, "val_obs": 2})   # latched, same tick
+    assert t({"val_loss": 0.1, "val_obs": 3})   # latched even on improvement
