@@ -48,6 +48,7 @@ import threading
 import time
 
 MFU_TARGET = 0.45  # BASELINE.md: ResNet-50 >= 45% MFU on v5e
+_SCALING_TIMEOUT = 420  # seconds for the CPU scaling subprocess
 
 # bf16 peak FLOP/s per *jax device* (v2/v3 devices are single cores).
 _PEAK_BF16 = (
@@ -298,7 +299,12 @@ def main(argv=None):
     ap.add_argument("--roofline-n", type=int, default=8192)
     ap.add_argument("--no-scaling", action="store_true",
                     help="skip the virtual-mesh scaling table")
+    ap.add_argument("--budget-seconds", type=float, default=1500.0,
+                    help="soft wall-clock budget: remaining configs are "
+                         "skipped (recorded, not failed) once exceeded so "
+                         "one JSON line is always produced")
     args = ap.parse_args(argv)
+    t_start = time.perf_counter()
 
     if args.platform:
         import jax as _jax
@@ -333,8 +339,16 @@ def main(argv=None):
                  f"(table: {table_peak and table_peak/1e12} TFLOP/s)")
     peak = max(filter(None, (table_peak, measured_peak)), default=None)
 
-    results, errors = {}, {}
+    results, errors, skipped = {}, {}, []
     for name in args.configs:
+        elapsed = time.perf_counter() - t_start
+        if (results or errors) and elapsed > args.budget_seconds:
+            # something already concluded (success OR error): prefer a
+            # partial-but-valid JSON line over being killed by the driver's
+            # timeout mid-config
+            skipped.append(name)
+            _log(f"budget exceeded ({elapsed:.0f}s): skipping {name}")
+            continue
         try:
             results[name] = _bench_config(name, CONFIGS[name], peak)
         except Exception as e:  # noqa: BLE001 — recorded per config
@@ -366,8 +380,17 @@ def main(argv=None):
            "configs": results}
     if errors:
         out["config_errors"] = errors
+    if skipped:
+        out["configs_skipped_budget"] = skipped
     if not args.no_scaling:
-        out["scaling_virtual_cpu"] = _scaling_table()
+        # headroom for the scaling subprocess's own timeout so the total
+        # stays inside the budget the driver is assumed to allow
+        if time.perf_counter() - t_start < args.budget_seconds - \
+                _SCALING_TIMEOUT:
+            out["scaling_virtual_cpu"] = _scaling_table()
+        else:
+            out["scaling_skipped_budget"] = True
+            _log("budget: skipping virtual-mesh scaling table")
     print(json.dumps(out))
 
 
@@ -383,7 +406,7 @@ def _scaling_table():
                filter(None, [repo_dir, os.environ.get("PYTHONPATH")]))}
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=420, env=env)
+                             timeout=_SCALING_TIMEOUT, env=env)
         line = [l for l in res.stdout.splitlines() if l.startswith("{")]
         if res.returncode == 0 and line:
             return json.loads(line[-1])
