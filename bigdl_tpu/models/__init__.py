@@ -1,8 +1,10 @@
 """Model zoo (reference: BigDL models/ + example/, SURVEY.md §2.11)."""
 
+from .alexnet import AlexNet
 from .autoencoder import Autoencoder
-from .inception import (Inception_Layer_v1, Inception_v1,
-                        Inception_v1_NoAuxClassifier)
+from .inception import (Inception_Layer_v1, Inception_Layer_v2,
+                        Inception_v1, Inception_v1_NoAuxClassifier,
+                        Inception_v2, Inception_v2_NoAuxClassifier)
 from .lenet import LeNet5
 from .resnet import ResNet, ShortcutType
 from .rnn import PTBModel, SimpleRNN
@@ -11,8 +13,9 @@ from .treelstm_sentiment import TreeLSTMSentiment, encode_tree
 from .vgg import Vgg_16, Vgg_19, VggForCifar10
 
 __all__ = [
-    "Autoencoder", "Inception_Layer_v1", "Inception_v1",
-    "Inception_v1_NoAuxClassifier", "LeNet5", "PTBModel", "ResNet",
+    "AlexNet", "Autoencoder", "Inception_Layer_v1", "Inception_Layer_v2",
+    "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_v2",
+    "Inception_v2_NoAuxClassifier", "LeNet5", "PTBModel", "ResNet",
     "ShortcutType", "SimpleRNN", "TextClassifier", "TreeLSTMSentiment",
     "encode_tree", "Vgg_16", "Vgg_19", "VggForCifar10",
 ]

@@ -16,7 +16,9 @@ from ..nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Reshape,
                   Sequential, SpatialAveragePooling, SpatialConvolution,
                   SpatialCrossMapLRN, SpatialMaxPooling, Xavier, Zeros)
 
-__all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier"]
+__all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier",
+           "Inception_Layer_v2", "Inception_v2",
+           "Inception_v2_NoAuxClassifier"]
 
 
 def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
@@ -131,6 +133,170 @@ def Inception_v1(class_num: int = 1000):
     output3.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
     output3.add(SpatialAveragePooling(7, 7, 1, 1))
     output3.add(Dropout(0.4))
+    output3.add(Reshape((1024,)))
+    fc = Linear(1024, class_num).set_name("loss3/classifier")
+    fc.set_init_method(Xavier(), Zeros())
+    output3.add(fc)
+    output3.add(LogSoftMax())
+
+    split2 = Concat(-1).add(output3).add(output2)
+    main_branch = Sequential().add(feature2).add(split2)
+    split1 = Concat(-1).add(main_branch).add(output1)
+    return Sequential().add(feature1).add(split1)
+
+
+# ---------------------------------------------------------------------------
+# Inception-v2 (BN-Inception) — reference: models/inception/Inception_v2.scala
+# ---------------------------------------------------------------------------
+
+def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name="",
+             with_bias=True):
+    """conv + SpatialBatchNormalization(eps=1e-3) + ReLU, matching the
+    reference's per-conv BN triplets (Inception_v2.scala:30-36 et al.)."""
+    from ..nn import SpatialBatchNormalization
+    c = SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                           with_bias=with_bias)
+    c.set_init_method(Xavier(), Zeros())
+    return [
+        c.set_name(name),
+        SpatialBatchNormalization(n_out, eps=1e-3).set_name(name + "/bn"),
+        ReLU(),
+    ]
+
+
+def Inception_Layer_v2(input_size: int, config, name_prefix: str = ""):
+    """BN-Inception block (Inception_v2.scala:28-104): 4 towers —
+    [1x1] | [3x3 reduce + 3x3] | [double-3x3 reduce + 3x3 + 3x3] |
+    [pool + optional proj].
+
+    config = ((n1x1,), (n3x3r, n3x3), (nd3x3r, nd3x3), (pool_kind, npool))
+    with pool_kind in {"avg", "max"}; the double tower's both 3x3 convs
+    output nd3x3.  config[3] == ("max", 0) marks the stride-2 reduction
+    block (reference :45,70,83-93 key every stride decision on exactly this
+    condition); config[0][0] == 0 omits the 1x1 tower (:29)."""
+    pool_kind, npool = config[3]
+    reduction = pool_kind == "max" and npool == 0
+    stride = 2 if reduction else 1
+    concat = Concat(-1)
+    if config[0][0] != 0:
+        t1 = Sequential()
+        for m in _conv_bn(input_size, config[0][0], 1, 1,
+                          name=name_prefix + "1x1"):
+            t1.add(m)
+        concat.add(t1)
+    t2 = Sequential()
+    for m in _conv_bn(input_size, config[1][0], 1, 1,
+                      name=name_prefix + "3x3_reduce"):
+        t2.add(m)
+    for m in _conv_bn(config[1][0], config[1][1], 3, 3, stride, stride, 1, 1,
+                      name=name_prefix + "3x3"):
+        t2.add(m)
+    concat.add(t2)
+    t3 = Sequential()
+    for m in _conv_bn(input_size, config[2][0], 1, 1,
+                      name=name_prefix + "double3x3_reduce"):
+        t3.add(m)
+    for m in _conv_bn(config[2][0], config[2][1], 3, 3, 1, 1, 1, 1,
+                      name=name_prefix + "double3x3a"):
+        t3.add(m)
+    for m in _conv_bn(config[2][1], config[2][1], 3, 3, stride, stride, 1, 1,
+                      name=name_prefix + "double3x3b"):
+        t3.add(m)
+    concat.add(t3)
+    t4 = Sequential()
+    if pool_kind == "avg":
+        t4.add(SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil())
+    elif reduction:
+        t4.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    else:
+        t4.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+    if npool:
+        for m in _conv_bn(input_size, npool, 1, 1,
+                          name=name_prefix + "pool_proj"):
+            t4.add(m)
+    concat.add(t4)
+    return concat.set_name(name_prefix + "output")
+
+
+#: (input_size, config, prefix) — exactly Inception_v2.scala:122-141
+_V2_BLOCKS = [
+    (192, ((64,), (64, 64), (64, 96), ("avg", 32)), "inception_3a/"),
+    (256, ((64,), (64, 96), (64, 96), ("avg", 64)), "inception_3b/"),
+    (320, ((0,), (128, 160), (64, 96), ("max", 0)), "inception_3c/"),
+    (576, ((224,), (64, 96), (96, 128), ("avg", 128)), "inception_4a/"),
+    (576, ((192,), (96, 128), (96, 128), ("avg", 128)), "inception_4b/"),
+    (576, ((160,), (128, 160), (128, 160), ("avg", 96)), "inception_4c/"),
+    (576, ((96,), (128, 192), (160, 192), ("avg", 96)), "inception_4d/"),
+    (576, ((0,), (128, 192), (192, 256), ("max", 0)), "inception_4e/"),
+    (1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "inception_5a/"),
+    (1024, ((352,), (192, 320), (192, 224), ("max", 128)), "inception_5b/"),
+]
+
+
+def _v2_stem():
+    mods = _conv_bn(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2",
+                    with_bias=False)  # reference builds conv1 bias-free
+    mods.append(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    mods += _conv_bn(64, 64, 1, 1, name="conv2/3x3_reduce")
+    mods += _conv_bn(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    mods.append(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    return mods
+
+
+def Inception_v2_NoAuxClassifier(class_num: int = 1000):
+    """BN-Inception tower without aux heads (Inception_v2.scala:107-150)."""
+    model = Sequential()
+    for m in _v2_stem():
+        model.add(m)
+    for n_in, cfg, prefix in _V2_BLOCKS:
+        model.add(Inception_Layer_v2(n_in, cfg, prefix))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).ceil())
+    model.add(Reshape((1024,)))
+    fc = Linear(1024, class_num).set_name("loss3/classifier")
+    fc.set_init_method(Xavier(), Zeros())
+    model.add(fc)
+    model.add(LogSoftMax())
+    return model
+
+
+def _v2_aux_head(n_in: int, spatial: int, class_num: int, prefix: str):
+    """v2 aux classifier (Inception_v2.scala:175-183, :200-208): avgpool
+    5x5/3 ceil -> conv 1x1 -> BN -> ReLU -> fc 1024 -> classifier; BN after
+    the conv and no dropout (unlike v1's heads)."""
+    head = Sequential().add(SpatialAveragePooling(5, 5, 3, 3).ceil())
+    for m in _conv_bn(n_in, 128, 1, 1, name=prefix + "conv"):
+        head.add(m)
+    return (head
+            .add(Reshape((128 * spatial * spatial,)))
+            .add(Linear(128 * spatial * spatial, 1024)
+                 .set_name(prefix + "fc"))
+            .add(ReLU())
+            .add(Linear(1024, class_num).set_name(prefix + "classifier"))
+            .add(LogSoftMax()))
+
+
+def Inception_v2(class_num: int = 1000):
+    """BN-Inception with the two auxiliary heads, output concatenated
+    [main | aux2 | aux1] (Inception_v2.scala:153-230): aux1 taps the 576-ch
+    14x14 map after 3c, aux2 the 1024-ch 7x7 map after 4e."""
+    feature1 = Sequential()
+    for m in _v2_stem():
+        feature1.add(m)
+    for n_in, cfg, prefix in _V2_BLOCKS[:3]:   # 3a, 3b, 3c
+        feature1.add(Inception_Layer_v2(n_in, cfg, prefix))
+
+    output1 = _v2_aux_head(576, 4, class_num, "loss1/")
+
+    feature2 = Sequential()
+    for n_in, cfg, prefix in _V2_BLOCKS[3:8]:  # 4a..4e (incl. reduction)
+        feature2.add(Inception_Layer_v2(n_in, cfg, prefix))
+
+    output2 = _v2_aux_head(1024, 2, class_num, "loss2/")
+
+    output3 = Sequential()
+    for n_in, cfg, prefix in _V2_BLOCKS[8:]:   # 5a, 5b
+        output3.add(Inception_Layer_v2(n_in, cfg, prefix))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1).ceil())
     output3.add(Reshape((1024,)))
     fc = Linear(1024, class_num).set_name("loss3/classifier")
     fc.set_init_method(Xavier(), Zeros())

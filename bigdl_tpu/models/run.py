@@ -55,6 +55,13 @@ def _build_model(name: str, class_num: int):
         from .inception import Inception_v1_NoAuxClassifier
         return (Inception_v1_NoAuxClassifier(class_num), (224, 224, 3),
                 "nll")
+    if name == "inception_v2":
+        from .inception import Inception_v2_NoAuxClassifier
+        return (Inception_v2_NoAuxClassifier(class_num), (224, 224, 3),
+                "nll")
+    if name == "alexnet":
+        from .alexnet import AlexNet
+        return AlexNet(class_num), (227, 227, 3), "nll"
     if name == "autoencoder":
         from .autoencoder import Autoencoder
         return Autoencoder(32), (28, 28, 1), "mse"

@@ -95,3 +95,49 @@ def test_resnet_trains_one_step():
     assert np.isfinite(float(loss))
     gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
     assert gnorm > 0.0
+
+
+def test_inception_v2_shapes():
+    """BN-Inception (reference: models/inception/Inception_v2.scala)."""
+    m = M.Inception_v2_NoAuxClassifier(10).build(jax.random.key(0))
+    m.evaluate()
+    out = m.forward(jnp.zeros((1, 224, 224, 3), jnp.float32))
+    assert out.shape == (1, 10)
+    m2 = M.Inception_v2(10).build(jax.random.key(0))
+    m2.evaluate()
+    out2 = m2.forward(jnp.zeros((1, 224, 224, 3), jnp.float32))
+    assert out2.shape == (1, 30)  # [main | aux2 | aux1]
+
+
+def test_inception_v2_block_trains():
+    """One BN-Inception block: gradients flow through all four towers,
+    including the stride-2 reduction variant."""
+    from bigdl_tpu.models.inception import Inception_Layer_v2
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 14, 14, 192),
+                    jnp.float32)
+    for cfg, out_ch in (
+            (((64,), (64, 64), (64, 96), ("avg", 32)), 256),
+            (((0,), (128, 160), (64, 96), ("max", 0)), 448),
+    ):
+        m = Inception_Layer_v2(192, cfg).build(jax.random.key(0))
+
+        def loss_fn(p):
+            out, _ = m.apply(p, m.state, x, training=True,
+                             rng=jax.random.key(1))
+            return jnp.sum(jnp.square(out))
+
+        loss, grads = jax.value_and_grad(loss_fn)(m.params)
+        assert np.isfinite(float(loss))
+        out, _ = m.apply(m.params, m.state, x)
+        assert out.shape[-1] == out_ch
+
+
+def test_alexnet_shape():
+    """reference: example/loadmodel/AlexNet.scala (caffe grouped-conv
+    variant, 227x227 crop)."""
+    m = M.AlexNet(10).build(jax.random.key(0))
+    m.evaluate()
+    out = m.forward(jnp.zeros((2, 227, 227, 3), jnp.float32))
+    assert out.shape == (2, 10)
+    # log-probabilities (LogSoftMax head)
+    assert np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
