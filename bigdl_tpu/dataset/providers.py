@@ -1,0 +1,114 @@
+"""Dataset providers: parsers for the standard benchmark dataset formats.
+
+Reference: `pyspark/bigdl/dataset/{mnist,news20,movielens}.py` — numpy
+loaders (IDX parsing in mnist.py:33-74, tar/text handling in news20) plus
+download helpers in base.py.  This image has no egress, so the download
+half is out of scope by design: these providers parse LOCAL copies of the
+standard files (idx/gz for MNIST, the CIFAR binary batches, news20-style
+labeled text directories) into `Sample` lists that plug straight into
+`DataSet.array(...)`.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import os
+import struct
+import tarfile
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .sample import Sample
+
+__all__ = ["load_mnist", "load_cifar10_binary", "load_labeled_text_dir"]
+
+
+def _open_maybe_gz(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (the MNIST container format; mnist.py:33-74)."""
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        dtype_code = (magic >> 8) & 0xFF
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
+                  0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"),
+                  0x0E: np.dtype(">f8")}
+        if dtype_code not in dtypes:
+            raise ValueError(f"bad IDX magic {magic:#x} in {path}")
+        data = np.frombuffer(f.read(), dtype=dtypes[dtype_code])
+    return data.reshape(dims)
+
+
+def load_mnist(directory: str, data_type: str = "train",
+               normalize: bool = True) -> List[Sample]:
+    """MNIST from the standard idx(.gz) pairs in `directory`
+    (mnist.py:76 read_data_sets role).  Returns Samples with (28,28,1)
+    float features and int labels."""
+    prefix = "train" if data_type == "train" else "t10k"
+    def find(kind):
+        for pat in (f"{prefix}-{kind}-idx?-ubyte", f"{prefix}-{kind}*ubyte*"):
+            hits = sorted(glob.glob(os.path.join(directory, pat)))
+            if hits:
+                return hits[0]
+        raise FileNotFoundError(
+            f"no {prefix} {kind} idx file under {directory}")
+    images = _read_idx(find("images")).astype(np.float32)[..., None]
+    labels = _read_idx(find("labels")).astype(np.int32)
+    if normalize:
+        images /= 255.0
+    return [Sample(images[i], labels[i]) for i in range(len(labels))]
+
+
+def load_cifar10_binary(directory: str, train: bool = True,
+                        normalize: bool = True) -> List[Sample]:
+    """CIFAR-10 from the binary-version batches (data_batch_*.bin /
+    test_batch.bin): rows of [label u8 | 3072 u8 CHW pixels] -> NHWC."""
+    pats = (["data_batch_*.bin"] if train else ["test_batch.bin"])
+    files: List[str] = []
+    for p in pats:
+        files += sorted(glob.glob(os.path.join(directory, p)))
+    if not files:
+        raise FileNotFoundError(f"no CIFAR binary batches under {directory}")
+    samples: List[Sample] = []
+    for path in files:
+        raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3073)
+        labels = raw[:, 0].astype(np.int32)
+        imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        imgs = imgs.astype(np.float32)
+        if normalize:
+            imgs /= 255.0
+        samples += [Sample(imgs[i], labels[i]) for i in range(len(labels))]
+    return samples
+
+
+def load_labeled_text_dir(directory: str,
+                          categories: Optional[List[str]] = None
+                          ) -> Tuple[List[Tuple[str, int]], List[str]]:
+    """news20-style corpus: one subdirectory per category, one text file per
+    document (news20.py get_news20 layout; also accepts a .tar.gz of that
+    tree next to `directory`).  Returns ([(text, label_index)], categories)."""
+    if not os.path.isdir(directory) and os.path.exists(directory):
+        # a tarball: extract in place next to it (news20.py's extract step)
+        dest = os.path.splitext(os.path.splitext(directory)[0])[0]
+        with tarfile.open(directory) as tf:
+            tf.extractall(os.path.dirname(directory) or ".")
+        directory = dest
+    cats = categories or sorted(
+        d for d in os.listdir(directory)
+        if os.path.isdir(os.path.join(directory, d)))
+    if not cats:
+        raise FileNotFoundError(f"no category directories under {directory}")
+    out: List[Tuple[str, int]] = []
+    for label, cat in enumerate(cats):
+        for name in sorted(os.listdir(os.path.join(directory, cat))):
+            path = os.path.join(directory, cat, name)
+            if os.path.isfile(path):
+                with open(path, "r", errors="replace") as f:
+                    out.append((f.read(), label))
+    return out, cats

@@ -13,7 +13,8 @@ NHWC layout; `dimension` on Concat is the channel axis (-1).
 from __future__ import annotations
 
 from ..nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Reshape,
-                  Sequential, SpatialAveragePooling, SpatialConvolution,
+                  Sequential, SpatialAveragePooling,
+                  SpatialBatchNormalization, SpatialConvolution,
                   SpatialCrossMapLRN, SpatialMaxPooling, Xavier, Zeros)
 
 __all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier",
@@ -149,13 +150,13 @@ def Inception_v1(class_num: int = 1000):
 # Inception-v2 (BN-Inception) — reference: models/inception/Inception_v2.scala
 # ---------------------------------------------------------------------------
 
-def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name="",
-             with_bias=True):
+def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
     """conv + SpatialBatchNormalization(eps=1e-3) + ReLU, matching the
-    reference's per-conv BN triplets (Inception_v2.scala:30-36 et al.)."""
-    from ..nn import SpatialBatchNormalization
-    c = SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
-                           with_bias=with_bias)
+    reference's per-conv BN triplets (Inception_v2.scala:30-36 et al.).
+    All convs keep their bias like the reference — its conv1's trailing
+    `false` is propagateBack (skip input grads for the first layer, an
+    optimization XLA performs automatically via DCE), NOT withBias."""
+    c = SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph)
     c.set_init_method(Xavier(), Zeros())
     return [
         c.set_name(name),
@@ -234,8 +235,7 @@ _V2_BLOCKS = [
 
 
 def _v2_stem():
-    mods = _conv_bn(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2",
-                    with_bias=False)  # reference builds conv1 bias-free
+    mods = _conv_bn(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2")
     mods.append(SpatialMaxPooling(3, 3, 2, 2).ceil())
     mods += _conv_bn(64, 64, 1, 1, name="conv2/3x3_reduce")
     mods += _conv_bn(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")

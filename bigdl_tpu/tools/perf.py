@@ -25,9 +25,11 @@ from ..utils.timing import fetch_scalar, measure_step_seconds
 # zoo names, resolved through models/run._build_model so the benched step
 # uses the SAME model/criterion pairing as real training (LogSoftMax heads
 # pair with ClassNLL, logits heads with CrossEntropy)
-_MODELS = {"inception_v1": ("inception", 1000), "vgg16": ("vgg16", 1000),
+_MODELS = {"inception_v1": ("inception", 1000),
+           "inception_v2": ("inception_v2", 1000),
+           "vgg16": ("vgg16", 1000),
            "vgg19": ("vgg19", 1000), "resnet50": ("resnet50", 1000),
-           "lenet": ("lenet", 10)}
+           "alexnet": ("alexnet", 1000), "lenet": ("lenet", 10)}
 
 
 def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,

@@ -1,0 +1,71 @@
+"""Dataset providers (dataset/providers.py): IDX/CIFAR-binary/news20-dir
+parsers against synthesized files in the genuine formats (reference:
+pyspark/bigdl/dataset/{mnist,news20}.py parsing halves)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.dataset.providers import (load_cifar10_binary,
+                                         load_labeled_text_dir, load_mnist)
+
+
+def _write_idx_images(path, arr: np.ndarray, gz=False):
+    header = struct.pack(">I", 0x0803) + b"".join(
+        struct.pack(">I", d) for d in arr.shape)
+    data = header + arr.astype(np.uint8).tobytes()
+    (gzip.open(path, "wb") if gz else open(path, "wb")).write(data)
+
+
+def _write_idx_labels(path, labels: np.ndarray, gz=False):
+    data = struct.pack(">I", 0x0801) + struct.pack(">I", len(labels)) + \
+        labels.astype(np.uint8).tobytes()
+    (gzip.open(path, "wb") if gz else open(path, "wb")).write(data)
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    r = np.random.default_rng(0)
+    imgs = r.integers(0, 256, size=(10, 28, 28)).astype(np.uint8)
+    labels = r.integers(0, 10, size=10).astype(np.uint8)
+    _write_idx_images(str(tmp_path / "train-images-idx3-ubyte.gz"), imgs,
+                      gz=True)
+    _write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte.gz"), labels,
+                      gz=True)
+    samples = load_mnist(str(tmp_path), "train")
+    assert len(samples) == 10
+    assert samples[0].feature.shape == (28, 28, 1)
+    np.testing.assert_allclose(samples[3].feature[..., 0],
+                               imgs[3] / 255.0, rtol=1e-6)
+    assert int(samples[3].label) == int(labels[3])
+
+
+def test_cifar10_binary(tmp_path):
+    r = np.random.default_rng(1)
+    n = 6
+    rows = np.zeros((n, 3073), np.uint8)
+    rows[:, 0] = r.integers(0, 10, size=n)
+    rows[:, 1:] = r.integers(0, 256, size=(n, 3072))
+    rows[:3].tofile(str(tmp_path / "data_batch_1.bin"))
+    rows[3:].tofile(str(tmp_path / "data_batch_2.bin"))
+    samples = load_cifar10_binary(str(tmp_path), train=True)
+    assert len(samples) == 6
+    assert samples[0].feature.shape == (32, 32, 3)
+    # CHW -> HWC: red channel of row 4 is bytes 1..1024
+    expect_red = rows[4, 1:1025].reshape(32, 32) / 255.0
+    np.testing.assert_allclose(samples[4].feature[..., 0], expect_red,
+                               rtol=1e-6)
+    assert int(samples[4].label) == int(rows[4, 0])
+
+
+def test_labeled_text_dir(tmp_path):
+    for cat, texts in (("alt.atheism", ["doc a", "doc b"]),
+                       ("sci.space", ["rockets"])):
+        os.makedirs(tmp_path / "news" / cat)
+        for i, t in enumerate(texts):
+            (tmp_path / "news" / cat / f"{i}.txt").write_text(t)
+    docs, cats = load_labeled_text_dir(str(tmp_path / "news"))
+    assert cats == ["alt.atheism", "sci.space"]
+    assert ("rockets", 1) in docs and ("doc a", 0) in docs
+    assert len(docs) == 3
