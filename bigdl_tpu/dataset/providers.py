@@ -94,10 +94,20 @@ def load_labeled_text_dir(directory: str,
     document (news20.py get_news20 layout; also accepts a .tar.gz of that
     tree next to `directory`).  Returns ([(text, label_index)], categories)."""
     if not os.path.isdir(directory) and os.path.exists(directory):
-        # a tarball: extract in place next to it (news20.py's extract step)
-        dest = os.path.splitext(os.path.splitext(directory)[0])[0]
+        # a tarball: extract next to it (news20.py's extract step); the
+        # top-level directory comes from the archive itself (e.g. news20's
+        # tarball extracts to 20news-18828/, not the archive's basename)
+        parent = os.path.dirname(os.path.abspath(directory))
         with tarfile.open(directory) as tf:
-            tf.extractall(os.path.dirname(directory) or ".")
+            tops = {m.name.split("/", 1)[0] for m in tf.getmembers()
+                    if m.name and not m.name.startswith(("/", ".."))}
+            if len(tops) != 1:
+                raise ValueError(
+                    f"expected one top-level directory in {directory}, "
+                    f"found {sorted(tops)}")
+            dest = os.path.join(parent, next(iter(tops)))
+            if not os.path.isdir(dest):  # don't re-extract on every call
+                tf.extractall(parent, filter="data")
         directory = dest
     cats = categories or sorted(
         d for d in os.listdir(directory)

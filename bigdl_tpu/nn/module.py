@@ -283,9 +283,27 @@ class Module:
         self.training_mode = True
         return self
 
-    def evaluate(self):
+    def evaluate(self, dataset=None, methods=None, batch_size=None):
+        """No args: switch to eval mode (Torch semantics).  With a dataset
+        and validation methods: bulk mesh-sharded evaluation — the
+        reference's `model.evaluate(rdd, vMethods, batchSize)` overload
+        (AbstractModule.scala:571 -> Evaluator, SURVEY.md §3.4)."""
+        if dataset is None:
+            self.training_mode = False
+            return self
+        if not methods:
+            raise ValueError(
+                "evaluate(dataset, ...) needs validation methods, e.g. "
+                "[Top1Accuracy()] (AbstractModule.evaluate vMethods)")
+        from ..optim.optimizer import Evaluator
         self.training_mode = False
-        return self
+        if batch_size is None:
+            # un-batched Sample datasets need batching (the reference's
+            # batchSize parameter has a cluster-derived default)
+            first = next(iter(dataset.data(train=False)), None)
+            if first is not None and not hasattr(first, "get_input"):
+                batch_size = 128
+        return Evaluator(self).test(dataset, methods, batch_size=batch_size)
 
     def is_training(self) -> bool:
         return self.training_mode

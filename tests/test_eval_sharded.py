@@ -79,3 +79,31 @@ def test_evaluator_sees_updated_weights():
     model.params = jax.tree.map(lambda t: t + 1.0, model.params)
     out2, _ = ev._engine(x)
     assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_module_evaluate_overload():
+    """model.evaluate(dataset, methods) bulk evaluation — the reference's
+    AbstractModule.evaluate(rdd, vMethods, batchSize) entry (SURVEY §3.4)."""
+    from bigdl_tpu.optim import Top1Accuracy
+    Engine.init()
+    model = LeNet5(10).build(jax.random.key(0))
+    res = model.evaluate(DataSet.array(_samples(64)), [Top1Accuracy()],
+                         batch_size=32)
+    _, n = res[0][1].result()
+    assert n == 64
+    # no-arg form still toggles training mode and chains
+    assert model.evaluate() is model
+    assert not model.is_training()
+
+
+def test_module_evaluate_defaults_and_validation():
+    from bigdl_tpu.optim import Top1Accuracy
+    import pytest as _pytest
+    Engine.init()
+    model = LeNet5(10).build(jax.random.key(0))
+    # batch_size omitted on an un-batched Sample dataset: defaulted, works
+    res = model.evaluate(DataSet.array(_samples(40)), [Top1Accuracy()])
+    _, n = res[0][1].result()
+    assert n == 40
+    with _pytest.raises(ValueError):
+        model.evaluate(DataSet.array(_samples(8)))  # no methods

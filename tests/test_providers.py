@@ -69,3 +69,21 @@ def test_labeled_text_dir(tmp_path):
     assert cats == ["alt.atheism", "sci.space"]
     assert ("rockets", 1) in docs and ("doc a", 0) in docs
     assert len(docs) == 3
+
+
+def test_labeled_text_tarball(tmp_path):
+    """Tarball whose top-level dir differs from the archive basename (the
+    real news20 case) extracts once and loads."""
+    import tarfile
+    src = tmp_path / "corpus-src" / "20news-tiny"
+    for cat, text in (("a", "alpha"), ("b", "beta")):
+        os.makedirs(src / cat)
+        (src / cat / "0.txt").write_text(text)
+    tar_path = tmp_path / "news20.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(src, arcname="20news-tiny")
+    docs, cats = load_labeled_text_dir(str(tar_path))
+    assert cats == ["a", "b"] and len(docs) == 2
+    # second call reuses the extraction (no error, same result)
+    docs2, _ = load_labeled_text_dir(str(tar_path))
+    assert docs2 == docs
