@@ -99,15 +99,26 @@ def load_labeled_text_dir(directory: str,
         # tarball extracts to 20news-18828/, not the archive's basename)
         parent = os.path.dirname(os.path.abspath(directory))
         with tarfile.open(directory) as tf:
-            tops = {m.name.split("/", 1)[0] for m in tf.getmembers()
-                    if m.name and not m.name.startswith(("/", ".."))}
+            tops = set()
+            for m in tf.getmembers():
+                name = m.name
+                # GNU tar often stores './dir/...' members; normalize
+                while name.startswith("./"):
+                    name = name[2:]
+                if not name or name in (".",) or \
+                        name.startswith(("/", "..")):
+                    continue
+                tops.add(name.split("/", 1)[0])
             if len(tops) != 1:
                 raise ValueError(
                     f"expected one top-level directory in {directory}, "
                     f"found {sorted(tops)}")
             dest = os.path.join(parent, next(iter(tops)))
             if not os.path.isdir(dest):  # don't re-extract on every call
-                tf.extractall(parent, filter="data")
+                try:
+                    tf.extractall(parent, filter="data")
+                except TypeError:  # Python < 3.10.12: no filter kwarg
+                    tf.extractall(parent)
         directory = dest
     cats = categories or sorted(
         d for d in os.listdir(directory)

@@ -608,6 +608,16 @@ def _const_node(name: str, arr: np.ndarray, dt: int = DT_FLOAT) -> bytes:
         "value": pbwire.field_bytes(8, _tensor_proto(arr))})
 
 
+def _t_attr(extra: Dict[str, bytes] = None) -> Dict[str, bytes]:
+    """Required dtype attrs for float ops: real TF refuses to import a
+    NodeDef missing a no-default attr like Conv2D's T (caught by the
+    execute-in-tensorflow oracle, tests/test_interop.py)."""
+    d = {"T": pbwire.field_varint(6, DT_FLOAT)}
+    if extra:
+        d.update(extra)
+    return d
+
+
 class TensorflowSaver:
     """Emit a frozen GraphDef for a Sequential of supported layers
     (reference: TensorflowSaver/BigDLToTensorflow.scala)."""
@@ -629,13 +639,13 @@ class TensorflowSaver:
                 wname, bname = name + "/weight", name + "/bias"
                 out += _const_node(wname,
                                    np.asarray(p["weight"], np.float32).T)
-                out += _node_def(name, "MatMul", [prev, wname])
+                out += _node_def(name, "MatMul", [prev, wname], _t_attr())
                 prev = name
                 if "bias" in p:
                     out += _const_node(bname,
                                        np.asarray(p["bias"], np.float32))
                     out += _node_def(name + "/badd", "BiasAdd",
-                                     [name, bname])
+                                     [name, bname], _t_attr())
                     prev = name + "/badd"
             elif isinstance(mod, nn.SpatialConvolution):
                 wname = name + "/weight"
@@ -658,16 +668,16 @@ class TensorflowSaver:
                     raise ValueError(
                         f"TensorflowSaver: conv padding {mod.pad} with "
                         f"stride {mod.stride} has no SAME/VALID equivalent")
-                out += _node_def(name, "Conv2D", [prev, wname], {
+                out += _node_def(name, "Conv2D", [prev, wname], _t_attr({
                     "strides": strides,
-                    "padding": pbwire.field_bytes(2, pad)})
+                    "padding": pbwire.field_bytes(2, pad)}))
                 prev = name
                 if "bias" in p:
                     bname = name + "/bias"
                     out += _const_node(bname,
                                        np.asarray(p["bias"], np.float32))
                     out += _node_def(name + "/badd", "BiasAdd",
-                                     [name, bname])
+                                     [name, bname], _t_attr())
                     prev = name + "/badd"
             elif isinstance(mod, nn.BatchNormalization):
                 if s is None:
@@ -687,22 +697,27 @@ class TensorflowSaver:
                 out += _node_def(name, "FusedBatchNormV3",
                                  [prev, name + "/gamma", name + "/beta",
                                   name + "/mean", name + "/var"],
-                                 {"epsilon": pbwire.field_float(4, mod.eps)})
+                                 _t_attr({
+                                     "U": pbwire.field_varint(6, DT_FLOAT),
+                                     "epsilon": pbwire.field_float(
+                                         4, mod.eps),
+                                     "is_training": pbwire.field_varint(
+                                         5, 0)}))
                 prev = name
             elif isinstance(mod, nn.ReLU):
-                out += _node_def(name, "Relu", [prev])
+                out += _node_def(name, "Relu", [prev], _t_attr())
                 prev = name
             elif isinstance(mod, nn.Tanh):
-                out += _node_def(name, "Tanh", [prev])
+                out += _node_def(name, "Tanh", [prev], _t_attr())
                 prev = name
             elif isinstance(mod, nn.Sigmoid):
-                out += _node_def(name, "Sigmoid", [prev])
+                out += _node_def(name, "Sigmoid", [prev], _t_attr())
                 prev = name
             elif isinstance(mod, nn.LogSoftMax):
-                out += _node_def(name, "LogSoftmax", [prev])
+                out += _node_def(name, "LogSoftmax", [prev], _t_attr())
                 prev = name
             elif isinstance(mod, (nn.SoftMax,)):
-                out += _node_def(name, "Softmax", [prev])
+                out += _node_def(name, "Softmax", [prev], _t_attr())
                 prev = name
             elif isinstance(mod, nn.Dropout):
                 pass  # inference graph: dropout is identity when frozen
@@ -713,12 +728,12 @@ class TensorflowSaver:
                 pad = b"SAME" if -1 in mod.pad else b"VALID"
                 op_name = ("MaxPool" if isinstance(mod, nn.SpatialMaxPooling)
                            else "AvgPool")
-                out += _node_def(name, op_name, [prev], {
+                out += _node_def(name, op_name, [prev], _t_attr({
                     "ksize": pbwire.field_bytes(
                         1, pbwire.field_packed_varints(3, [1, kh, kw, 1])),
                     "strides": pbwire.field_bytes(
                         1, pbwire.field_packed_varints(3, [1, sh, sw, 1])),
-                    "padding": pbwire.field_bytes(2, pad)})
+                    "padding": pbwire.field_bytes(2, pad)}))
                 prev = name
             elif isinstance(mod, (nn.Reshape, nn.InferReshape, nn.View)):
                 # our Reshape sizes are per-sample; TF shapes carry the
@@ -728,7 +743,7 @@ class TensorflowSaver:
                 sname = name + "/shape"
                 out += _const_node(sname, np.array(
                     [-1] + [int(s_) for s_ in shp], np.int32), DT_INT32)
-                out += _node_def(name, "Reshape", [prev, sname])
+                out += _node_def(name, "Reshape", [prev, sname], _t_attr({"Tshape": pbwire.field_varint(6, DT_INT32)}))
                 prev = name
             else:
                 raise ValueError(

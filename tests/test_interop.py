@@ -589,3 +589,35 @@ def test_tf_unsupported_raises_unless_permissive(tmp_path):
     with pytest.raises(ValueError):
         load_tf(path)
     loaded, _ = load_tf(path, permissive=True)
+
+
+def test_tf_saved_graph_executes_in_real_tensorflow(tmp_path):
+    """Our GraphDef must not just parse — real TensorFlow must EXECUTE it
+    with numeric parity (the true saver contract: the reference's saved
+    graphs run under TF, utils/tf/TensorflowSaver.scala)."""
+    tf = pytest.importorskip("tensorflow")
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1))
+         .add(nn.ReLU())
+         .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+         .add(nn.Reshape((4 * 4 * 4,)))
+         .add(nn.Linear(4 * 4 * 4, 5))
+         .add(nn.SoftMax()))
+    params, state = m.init(jax.random.key(11))
+    x = np.random.default_rng(11).standard_normal((2, 8, 8, 2)) \
+        .astype(np.float32)
+    ref = np.asarray(_forward(m, params, state, jnp.asarray(x)))
+    path = str(tmp_path / "convnet.pb")
+    save_tf(m, params, path)
+
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(open(path, "rb").read())
+    g = tf.Graph()
+    with g.as_default():
+        tf.import_graph_def(gd, name="")
+        inp = g.get_tensor_by_name("input:0")
+        # last op's first output is the model head (saver emits topo order)
+        out_t = g.get_operations()[-1].outputs[0]
+        with tf.compat.v1.Session(graph=g) as sess:
+            got = sess.run(out_t, {inp: x})
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -87,3 +87,17 @@ def test_labeled_text_tarball(tmp_path):
     # second call reuses the extraction (no error, same result)
     docs2, _ = load_labeled_text_dir(str(tar_path))
     assert docs2 == docs
+
+
+def test_labeled_text_tarball_dot_prefixed_members(tmp_path):
+    """GNU tar's './dir/...' member naming must not defeat top-dir
+    detection or skip extraction."""
+    import tarfile
+    src = tmp_path / "src" / "corpus"
+    os.makedirs(src / "x")
+    (src / "x" / "0.txt").write_text("hello")
+    tar_path = tmp_path / "c.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(src, arcname="./corpus")
+    docs, cats = load_labeled_text_dir(str(tar_path))
+    assert cats == ["x"] and docs == [("hello", 0)]
