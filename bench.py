@@ -133,6 +133,35 @@ def _step_flops(jitted, compiled, example_args):
     return analytic or xla, {"flops_analytic": analytic, "flops_xla": xla}
 
 
+def _make_record(name, batch, dt, timing, compile_s, flops_step,
+                 flops_detail, peak_flops, compute_dtype, **extra):
+    """Shared MFU gate + result-record assembly for train and inference
+    benches: refuses any MFU outside (0,1] with full diagnostics."""
+    mfu = mfu_raw = mfu_error = None
+    if flops_step and peak_flops:
+        mfu_raw = flops_step / dt / peak_flops
+        if 0.0 < mfu_raw <= 1.0:
+            mfu = round(mfu_raw, 4)
+        else:
+            mfu_error = (
+                f"raw MFU {mfu_raw:.3f} outside (0,1]: flops/step="
+                f"{flops_step:.3e}, dt={dt:.6f}s, peak={peak_flops:.3e} — "
+                "timing and FLOPs disagree; refusing to report")
+            _log(f"{name}: {mfu_error}")
+    rec = {"name": name, "images_per_sec": round(batch / dt, 2),
+           "step_seconds": round(dt, 6),
+           "step_seconds_sync": round(timing["step_seconds_sync"], 6),
+           "batch_size": batch,
+           "compute_dtype": compute_dtype,
+           "compile_seconds": round(compile_s, 2),
+           "model_flops_per_step": flops_step,
+           "mfu": mfu, "timing": timing, **flops_detail, **extra}
+    if mfu_error:
+        rec["mfu_raw"] = round(mfu_raw, 4)
+        rec["mfu_error"] = mfu_error
+    return rec
+
+
 def _bench_config(name, build, peak_flops):
     """Time the REAL compiled train step (Optimizer._build_step) on a 1-chip
     mesh; returns images/sec + flops/step + mfu."""
@@ -183,32 +212,53 @@ def _bench_config(name, build, peak_flops):
     from bigdl_tpu.utils.timing import measure_step_seconds
     dt, timing = measure_step_seconds(
         run, log=lambda m: _log(f"{name}: {m}"))
-    dt_sync = timing["step_seconds_sync"]
+    return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
+                        flops_step, flops_detail, peak_flops,
+                        jnp.dtype(policy.compute_dtype).name)
 
-    batch = int(inp.shape[0])
-    mfu = mfu_raw = mfu_error = None
-    if flops_step and peak_flops:
-        mfu_raw = flops_step / dt / peak_flops
-        if 0.0 < mfu_raw <= 1.0:
-            mfu = round(mfu_raw, 4)
-        else:
-            mfu_error = (
-                f"raw MFU {mfu_raw:.3f} outside (0,1]: flops/step="
-                f"{flops_step:.3e}, dt={dt:.6f}s, peak={peak_flops:.3e} — "
-                "timing and FLOPs disagree; refusing to report")
-            _log(f"{name}: {mfu_error}")
-    rec = {"name": name, "images_per_sec": round(batch / dt, 2),
-           "step_seconds": round(dt, 6),
-           "step_seconds_sync": round(dt_sync, 6),
-           "batch_size": batch,
-           "compute_dtype": jnp.dtype(policy.compute_dtype).name,
-           "compile_seconds": round(compile_s, 2),
-           "model_flops_per_step": flops_step,
-           "mfu": mfu, "timing": timing, **flops_detail}
-    if mfu_error:
-        rec["mfu_raw"] = round(mfu_raw, 4)
-        rec["mfu_error"] = mfu_error
-    return rec
+
+def _bench_infer(name, build, peak_flops):
+    """Time the compiled INFERENCE forward (the Predictor/Evaluator hot path,
+    reference AbstractModule.evaluate -> Evaluator.test, SURVEY.md §3.4) on
+    one chip: batched apply(training=False), fwd-only FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.common import DTypePolicy, get_policy, set_policy
+    from bigdl_tpu.utils.timing import measure_step_seconds
+
+    set_policy(DTypePolicy())
+    model, _criterion, inp, _tgt, _lr = build()
+    policy = get_policy()
+    model.build(jax.random.key(0))
+    params, net_state = model.params, model.state
+
+    # `tok` chains call i to call i-1's output so measure_chain's
+    # all-prior-calls dependency contract holds (the broadcast-add
+    # materializes one extra copy of x — a small, conservative overcount)
+    def forward(p, x, tok):
+        out, _ = model.apply(p, net_state, x + tok * 0, training=False,
+                             rng=None)
+        return out, jnp.mean(out.astype(jnp.float32)) * 0
+
+    tok0 = jnp.float32(0)
+    t0 = time.perf_counter()
+    compiled = jax.jit(forward).lower(params, inp, tok0).compile()
+    compile_s = time.perf_counter() - t0
+    flops_step, flops_detail = _step_flops(forward, compiled,
+                                           (params, inp, tok0))
+
+    box = {"tok": tok0}
+
+    def run():
+        out, box["tok"] = compiled(params, inp, box["tok"])
+        return out
+
+    dt, timing = measure_step_seconds(run, log=lambda m: _log(f"{name}: {m}"))
+    return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
+                        flops_step, flops_detail, peak_flops,
+                        jnp.dtype(policy.compute_dtype).name,
+                        mode="inference")
 
 
 # ---------------------------------------------------------------- configs
@@ -284,8 +334,11 @@ def _cfg_lstm():
 
 
 CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
+           # inference (Predictor/Evaluator path, fwd-only MFU)
+           "resnet50_infer_bf16": _cfg_resnet50_bf16,
            "lenet": _cfg_lenet, "inception_v1": _cfg_inception_v1,
            "textcnn": _cfg_textcnn, "lstm": _cfg_lstm}
+INFER_CONFIGS = {"resnet50_infer_bf16"}
 
 
 def main(argv=None):
@@ -350,24 +403,34 @@ def main(argv=None):
             _log(f"budget exceeded ({elapsed:.0f}s): skipping {name}")
             continue
         try:
-            results[name] = _bench_config(name, CONFIGS[name], peak)
+            bench_fn = (_bench_infer if name in INFER_CONFIGS
+                        else _bench_config)
+            results[name] = bench_fn(name, CONFIGS[name], peak)
         except Exception as e:  # noqa: BLE001 — recorded per config
             errors[name] = f"{type(e).__name__}: {e}"
             _log(f"config {name} failed: {errors[name]}")
 
     primary = (results.get("resnet50_bf16") or results.get("resnet50") or
+               # prefer any TRAIN config as the headline; infer-only last
+               next((r for k, r in results.items()
+                     if k not in INFER_CONFIGS), None) or
                next(iter(results.values()), None))
     if primary is None:
         _fail("; ".join(f"{k}: {v}" for k, v in errors.items()) or
               "no configs ran", "bench")
 
+    primary_is_train = primary.get("mode") != "inference"
     mfu = primary.get("mfu")
-    if mfu is not None and primary["name"].startswith("resnet50"):
-        # the >=45%-MFU target is the ResNet-50 north star (BASELINE.md)
+    if mfu is not None and primary_is_train and \
+            primary["name"].startswith("resnet50"):
+        # the >=45%-MFU target is the ResNet-50 TRAIN north star (BASELINE.md)
         vs_baseline = round(mfu / MFU_TARGET, 3)
     else:
         vs_baseline = None  # no real published baseline exists (BASELINE.md)
-    out = {"metric": f"{primary['name']}_train_images_per_sec_per_chip",
+    mode = "train" if primary_is_train else "infer"
+    # config names may already carry the mode token (resnet50_infer_bf16)
+    metric_base = primary["name"].replace("_infer", "")
+    out = {"metric": f"{metric_base}_{mode}_images_per_sec_per_chip",
            "value": primary["images_per_sec"], "unit": "images/sec",
            "vs_baseline": vs_baseline,
            "mfu": mfu, "mfu_target": MFU_TARGET,

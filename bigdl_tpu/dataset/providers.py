@@ -117,7 +117,15 @@ def load_labeled_text_dir(directory: str,
             if not os.path.isdir(dest):  # don't re-extract on every call
                 try:
                     tf.extractall(parent, filter="data")
-                except TypeError:  # Python < 3.10.12: no filter kwarg
+                except TypeError:  # Python < 3.10.12: no filter kwarg —
+                    # reject traversal/absolute/link members ourselves
+                    for m in tf.getmembers():
+                        parts = m.name.replace("\\", "/").split("/")
+                        if m.name.startswith("/") or ".." in parts or \
+                                m.islnk() or m.issym() or m.isdev():
+                            raise ValueError(
+                                f"unsafe tar member {m.name!r} in "
+                                f"{directory}")
                     tf.extractall(parent)
         directory = dest
     cats = categories or sorted(
