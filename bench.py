@@ -334,10 +334,11 @@ def _cfg_lstm():
 
 
 CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
-           # inference (Predictor/Evaluator path, fwd-only MFU)
-           "resnet50_infer_bf16": _cfg_resnet50_bf16,
            "lenet": _cfg_lenet, "inception_v1": _cfg_inception_v1,
-           "textcnn": _cfg_textcnn, "lstm": _cfg_lstm}
+           "textcnn": _cfg_textcnn, "lstm": _cfg_lstm,
+           # inference (Predictor/Evaluator path, fwd-only MFU); last so the
+           # soft budget never skips a train config in its favor
+           "resnet50_infer_bf16": _cfg_resnet50_bf16}
 INFER_CONFIGS = {"resnet50_infer_bf16"}
 
 

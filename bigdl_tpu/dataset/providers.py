@@ -118,14 +118,26 @@ def load_labeled_text_dir(directory: str,
                 try:
                     tf.extractall(parent, filter="data")
                 except TypeError:  # Python < 3.10.12: no filter kwarg —
-                    # reject traversal/absolute/link members ourselves
+                    # mirror filter="data": reject traversal/absolute/device
+                    # members and links escaping the archive root
                     for m in tf.getmembers():
                         parts = m.name.replace("\\", "/").split("/")
                         if m.name.startswith("/") or ".." in parts or \
-                                m.islnk() or m.issym() or m.isdev():
+                                m.isdev():
                             raise ValueError(
                                 f"unsafe tar member {m.name!r} in "
                                 f"{directory}")
+                        if m.islnk() or m.issym():
+                            tgt = m.linkname.replace("\\", "/")
+                            base = (os.path.dirname(m.name)
+                                    if m.issym() else "")
+                            resolved = os.path.normpath(
+                                os.path.join(base, tgt))
+                            if tgt.startswith("/") or \
+                                    resolved.split("/")[0] == "..":
+                                raise ValueError(
+                                    f"tar link {m.name!r} -> {tgt!r} "
+                                    f"escapes the archive in {directory}")
                     tf.extractall(parent)
         directory = dest
     cats = categories or sorted(

@@ -47,6 +47,22 @@ class OptimMethod:
         """Current scalar LR for this iteration (schedule-aware in SGD)."""
         return self.learning_rate
 
+    def optimize(self, feval, x):
+        """Host-side single optimization step mirroring the reference's
+        `OptimMethod.optimize(feval, x)` entry (optim/OptimMethod.scala:38):
+        `feval(params) -> (loss, grads)` with params/grads pytrees; returns
+        `(new_params, [loss])`.  State is kept on the instance so repeated
+        calls continue the trajectory — for custom host loops outside the
+        compiled train step (which uses the pure `update` directly)."""
+        loss, grads = feval(x)
+        if not hasattr(self, "_opt_state"):
+            self._opt_state = self.init_state(x)
+        lr = self.get_learning_rate(self.hyper)
+        new_x, self._opt_state = self.update(grads, x, self._opt_state,
+                                             jnp.float32(lr))
+        self.hyper["evalCounter"] = self.hyper.get("evalCounter", 0) + 1
+        return new_x, [float(loss)]
+
     def get_hyper_parameter(self):
         return {"learningRate": self.get_learning_rate()}
 
@@ -54,12 +70,24 @@ class OptimMethod:
         self.hyper.update(d)
 
     def state_dict(self):
-        return {"hyper": dict(self.hyper),
-                "learning_rate": self.learning_rate}
+        import numpy as np
+        d = {"hyper": dict(self.hyper),
+             "learning_rate": self.learning_rate}
+        # host-side optimize() trajectory state (momentum, L-BFGS history)
+        # must survive checkpoint/resume like the reference's state Table
+        if hasattr(self, "_opt_state"):
+            d["opt_state"] = jax.tree.map(np.asarray, self._opt_state)
+        if hasattr(self, "_ls_state"):
+            d["ls_state"] = self._ls_state
+        return d
 
     def load_state_dict(self, d):
         self.hyper = dict(d["hyper"])
         self.learning_rate = d["learning_rate"]
+        if "opt_state" in d:
+            self._opt_state = jax.tree.map(jnp.asarray, d["opt_state"])
+        if "ls_state" in d:
+            self._ls_state = d["ls_state"]
 
 
 class SGD(OptimMethod):
@@ -270,6 +298,7 @@ class LBFGS(OptimMethod):
                  history_size: int = 10, tolerance_grad: float = 1e-7):
         super().__init__(learning_rate)
         self.m = history_size
+        self.max_iter = max_iter
         self.tolerance_grad = tolerance_grad
 
     def init_state(self, params):
@@ -318,3 +347,141 @@ class LBFGS(OptimMethod):
         new_state = {"s": s, "y": y, "rho": rho, "count": count + 1,
                      "prev_flat": flat, "prev_grad": gflat}
         return unravel(new_flat), new_state
+
+    # -- host-side optimize() with strong-Wolfe line search -------------
+    #
+    # Reference: LBFGS.scala drives torch-lineage lbfgs with an optional
+    # `lineSearch` (LineSearch.scala `lswolfe`).  The compiled-train-step
+    # path above keeps a fixed step (data-dependent trial evaluations can't
+    # live inside one XLA program); this host entry point evaluates the
+    # compiled `feval` at trial points instead, which is exactly the
+    # reference's execution shape (feval per line-search probe).
+
+    def optimize(self, feval, x):
+        """Full L-BFGS step: up to `max_iter` iterations of two-loop
+        direction + strong-Wolfe line search, each probing `feval`.
+        Returns (new_params, losses_at_each_feval)."""
+        import numpy as np
+
+        flat0, unravel = jax.flatten_util.ravel_pytree(x)
+
+        def fg(flat):
+            loss, grads = feval(unravel(flat))
+            g, _ = jax.flatten_util.ravel_pytree(grads)
+            self.hyper["evalCounter"] = self.hyper.get("evalCounter", 0) + 1
+            return float(loss), np.asarray(g, np.float64)
+
+        if not hasattr(self, "_ls_state"):
+            self._ls_state = {"s": [], "y": [], "first": True}
+        st = self._ls_state
+        flat = np.asarray(flat0, np.float64)
+        f, g = fg(flat)
+        losses = [f]
+        for _ in range(self.max_iter):
+            if np.abs(g).max() <= self.tolerance_grad:
+                break
+            d = -self._host_two_loop(st["s"], st["y"], g)
+            gtd = float(g @ d)
+            if gtd > -1e-12:  # not a descent direction: reset history
+                st["s"], st["y"] = [], []
+                d, gtd = -g, -float(g @ g)
+            # first-ever step is scaled like the reference's lbfgs init
+            t0 = (min(1.0, 1.0 / np.abs(g).sum()) * self.learning_rate
+                  if st["first"] else self.learning_rate)
+            st["first"] = False
+            t, f_new, g_new = _strong_wolfe(
+                lambda tt: fg(flat + tt * d), d, f, gtd, t0)
+            losses.append(f_new)
+            s_new = t * d
+            y_new = g_new - g
+            if float(y_new @ s_new) > 1e-10:
+                st["s"].append(s_new)
+                st["y"].append(y_new)
+                if len(st["s"]) > self.m:
+                    st["s"].pop(0)
+                    st["y"].pop(0)
+            flat, f, g = flat + s_new, f_new, g_new
+            if np.abs(s_new).max() <= 1e-9:
+                break
+        return unravel(jnp.asarray(flat, flat0.dtype)), losses
+
+    def _host_two_loop(self, ss, ys, g):
+        import numpy as np
+        q = g.copy()
+        alphas = []
+        for s, y in zip(reversed(ss), reversed(ys)):
+            rho = 1.0 / float(y @ s)
+            a = rho * float(s @ q)
+            q -= a * y
+            alphas.append((s, y, rho, a))
+        if ss:
+            s_l, y_l = ss[-1], ys[-1]
+            q *= float(y_l @ s_l) / float(y_l @ y_l)
+        for s, y, rho, a in reversed(alphas):
+            b = rho * float(y @ q)
+            q += s * (a - b)
+        return q
+
+
+def _cubic_min(a, fa, dfa, b, fb, dfb):
+    """Minimizer of the cubic through (a,fa,dfa),(b,fb,dfb); midpoint on
+    degenerate geometry (standard line-search interpolation formula)."""
+    d1 = dfa + dfb - 3 * (fa - fb) / (a - b)
+    sq = d1 * d1 - dfa * dfb
+    if sq < 0:
+        return (a + b) / 2.0
+    d2 = sq ** 0.5 * (1 if b >= a else -1)
+    t = b - (b - a) * ((dfb + d2 - d1) / (dfb - dfa + 2 * d2 + 1e-300))
+    lo, hi = min(a, b), max(a, b)
+    if not (lo < t < hi):
+        return (a + b) / 2.0
+    return t
+
+
+def _strong_wolfe(phi, d, f0, df0, t0, c1=1e-4, c2=0.9, max_ls=25):
+    """Strong-Wolfe line search (reference: LineSearch.scala `lswolfe` role —
+    bracket then zoom with cubic interpolation).  `phi(t) -> (f, g_vec)`
+    evaluates the objective along the ray; `d` is the search direction so
+    the directional derivative is g·d.  Returns (t, f, g) at an acceptable
+    point (sufficient decrease + curvature), or the best point seen."""
+    t_prev, f_prev, df_prev = 0.0, f0, df0
+    g_prev = None
+    t = t0
+    bracket = None
+    f, g = phi(t)
+    df = float(g @ d)
+    for it in range(max_ls):
+        if f > f0 + c1 * t * df0 or (it > 0 and f >= f_prev):
+            bracket = (t_prev, f_prev, df_prev, g_prev, t, f, df, g)
+            break
+        if abs(df) <= -c2 * df0:
+            return t, f, g
+        if df >= 0:
+            bracket = (t, f, df, g, t_prev, f_prev, df_prev, g_prev)
+            break
+        t_prev, f_prev, df_prev, g_prev = t, f, df, g
+        t = min(t * 2.0, 1e10)
+        f, g = phi(t)
+        df = float(g @ d)
+    if bracket is None:
+        return t, f, g
+    lo_t, lo_f, lo_df, lo_g, hi_t, hi_f, hi_df, hi_g = bracket
+    if lo_g is None and lo_t > 0:  # bracket endpoint never evaluated
+        _, lo_g = phi(lo_t)
+    for _ in range(max_ls):
+        t = _cubic_min(lo_t, lo_f, lo_df, hi_t, hi_f, hi_df)
+        f, g = phi(t)
+        df = float(g @ d)
+        if f > f0 + c1 * t * df0 or f >= lo_f:
+            hi_t, hi_f, hi_df = t, f, df
+        else:
+            if abs(df) <= -c2 * df0:
+                return t, f, g
+            if df * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_df = lo_t, lo_f, lo_df
+            lo_t, lo_f, lo_df, lo_g = t, f, df, g
+        if abs(hi_t - lo_t) < 1e-9:
+            break
+    if lo_g is not None and lo_t > 0:
+        return lo_t, lo_f, lo_g
+    return t, f, g
