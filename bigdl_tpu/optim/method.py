@@ -70,24 +70,31 @@ class OptimMethod:
         self.hyper.update(d)
 
     def state_dict(self):
+        import copy
         import numpy as np
         d = {"hyper": dict(self.hyper),
              "learning_rate": self.learning_rate}
         # host-side optimize() trajectory state (momentum, L-BFGS history)
-        # must survive checkpoint/resume like the reference's state Table
+        # must survive checkpoint/resume like the reference's state Table;
+        # snapshots are decoupled from the live (mutated-in-place) state
         if hasattr(self, "_opt_state"):
             d["opt_state"] = jax.tree.map(np.asarray, self._opt_state)
         if hasattr(self, "_ls_state"):
-            d["ls_state"] = self._ls_state
+            d["ls_state"] = copy.deepcopy(self._ls_state)
         return d
 
     def load_state_dict(self, d):
+        import copy
         self.hyper = dict(d["hyper"])
         self.learning_rate = d["learning_rate"]
-        if "opt_state" in d:
-            self._opt_state = jax.tree.map(jnp.asarray, d["opt_state"])
-        if "ls_state" in d:
-            self._ls_state = d["ls_state"]
+        # restore EXACTLY the snapshot: stale live state must not survive
+        for attr, key, conv in (("_opt_state", "opt_state",
+                                 lambda v: jax.tree.map(jnp.asarray, v)),
+                                ("_ls_state", "ls_state", copy.deepcopy)):
+            if key in d:
+                setattr(self, attr, conv(d[key]))
+            elif hasattr(self, attr):
+                delattr(self, attr)
 
 
 class SGD(OptimMethod):
@@ -465,9 +472,7 @@ def _strong_wolfe(phi, d, f0, df0, t0, c1=1e-4, c2=0.9, max_ls=25):
         df = float(g @ d)
     if bracket is None:
         return t, f, g
-    lo_t, lo_f, lo_df, lo_g, hi_t, hi_f, hi_df, hi_g = bracket
-    if lo_g is None and lo_t > 0:  # bracket endpoint never evaluated
-        _, lo_g = phi(lo_t)
+    lo_t, lo_f, lo_df, lo_g, hi_t, hi_f, hi_df, _ = bracket
     for _ in range(max_ls):
         t = _cubic_min(lo_t, lo_f, lo_df, hi_t, hi_f, hi_df)
         f, g = phi(t)

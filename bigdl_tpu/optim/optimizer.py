@@ -58,7 +58,8 @@ from .validation import ValidationMethod
 logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
-           "Predictor", "Validator", "DistriValidator", "LocalValidator"]
+           "Predictor", "Validator", "DistriValidator", "LocalValidator",
+           "ConfigurationError"]
 
 
 def _trim(x, valid: int):
@@ -66,6 +67,18 @@ def _trim(x, valid: int):
     if isinstance(x, (list, tuple)):
         return [_trim(e, valid) for e in x]
     return np.asarray(x)[:valid]
+
+
+class ConfigurationError(ValueError):
+    """A deterministic setup error (empty validation set, bad shapes): the
+    fault-tolerance retry loop re-raises it immediately instead of burning
+    retries — transient-failure recovery cannot fix configuration."""
+
+
+def _any_deleted(tree) -> bool:
+    """True if any jax.Array leaf was donated to a compiled call (deleted)."""
+    return any(getattr(leaf, "is_deleted", lambda: False)()
+               for leaf in jax.tree.leaves(tree))
 
 
 def _put_batch(batch, sharding):
@@ -320,7 +333,7 @@ class Optimizer:
         while True:
             try:
                 return self._optimize_impl()
-            except KeyboardInterrupt:
+            except (KeyboardInterrupt, ConfigurationError):
                 raise
             except Exception:
                 now = time.monotonic()
@@ -358,6 +371,16 @@ class Optimizer:
     def _recover_from_checkpoint(self):
         latest = file_io.latest_checkpoint(self.checkpoint_path)
         if latest is None:
+            # failure before the first snapshot: the crashed attempt's
+            # buffers were donated to the compiled step (deleted), so a
+            # bare re-run would crash on device_put — restart from a fresh
+            # init instead (the reference restarts from the initial model
+            # when no snapshot exists yet, DistriOptimizer.scala:828-845)
+            if _any_deleted(self.model.params) or \
+                    _any_deleted(self.model.state):
+                logger.warning("no checkpoint yet; re-initializing model "
+                               "for the retry")
+                self.model.build()
             return
         model_path, optim_path, neval = latest
         self.resume_from(model_path, optim_path)
@@ -528,6 +551,11 @@ class Optimizer:
             for i, m in enumerate(self.validation_methods):
                 r = m(out_np, tgt_np)
                 totals[i] = r if totals[i] is None else totals[i] + r
+        if totals and totals[0] is None:
+            raise ConfigurationError(
+                "validation dataset produced no batches — fewer samples "
+                "than the batch size with drop_last=True? Use "
+                "SampleToMiniBatch(..., pad_last=True) for evaluation")
         return list(zip(self.validation_methods, totals))
 
     _forward_fn = None

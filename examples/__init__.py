@@ -1,0 +1,6 @@
+"""Runnable end-to-end examples mirroring the reference's `example/*` tree.
+
+Each script is self-contained (synthetic data, seconds-scale on CPU), has a
+`main(argv)` entry the test suite drives, and cites the reference example it
+re-creates.  Run from anywhere: each bootstraps the repo root onto sys.path.
+"""

@@ -1,0 +1,9 @@
+"""Put the repo root on sys.path so examples run from any cwd without an
+installed wheel (the reference's examples likewise run from the source tree
+via spark-submit --jars)."""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -1,0 +1,58 @@
+"""ML-pipeline example: DLClassifier on a pandas DataFrame with validation
+and early stopping.
+
+Reference: `example/MLPipeline/DLClassifierLeNet.scala` +
+`org/apache/spark/ml/DLEstimator.scala:53` (fit a DataFrame with feature and
+label columns, transform appends a prediction column).
+Run: python examples/ml_pipeline.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+if __package__ in (None, ""):  # run as a script from any cwd
+    import _bootstrap  # noqa: F401
+else:
+    from . import _bootstrap  # noqa: F401
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    import pandas as pd
+
+    from bigdl_tpu import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.ml import DLClassifier
+
+    Engine.init()
+    # two noisy Gaussian blobs -> flat feature arrays in a DataFrame column
+    r = np.random.default_rng(0)
+    label = r.integers(0, 2, size=args.n)
+    centers = np.asarray([[-1.5, -1.0], [1.5, 1.0]], np.float32)
+    x = (centers[label] + r.normal(0, 0.4, size=(args.n, 2))) \
+        .astype(np.float32)
+    df = pd.DataFrame({"features": list(x), "label": label.astype(np.float64)})
+    train, val = df.iloc[: args.n * 3 // 4], df.iloc[args.n * 3 // 4:]
+
+    model = nn.Sequential(nn.Linear(2, 32), nn.ReLU(), nn.Linear(32, 2),
+                          nn.LogSoftMax())
+    clf = DLClassifier(model, nn.ClassNLLCriterion(), feature_size=(2,),
+                       batch_size=64, max_epoch=40,
+                       features_col="features", label_col="label")
+    clf.set_validation(val, None, early_stopping_patience=5)
+    fitted = clf.fit(train)
+
+    out = fitted.transform(val)
+    acc = float((out["prediction"] == out["label"]).mean())
+    print(f"val accuracy={acc:.3f} over {len(out)} rows")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

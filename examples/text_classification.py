@@ -1,0 +1,76 @@
+"""Text-classification CNN: tokenizer -> Dictionary -> embedded sequences ->
+TemporalConvolution classifier (news20 shape, synthetic corpus).
+
+Reference: `example/textclassification/TextClassifier.scala` (+ helpers in
+`example/utils/`): GloVe embeddings + TemporalConvolution + max-over-time.
+Run: python examples/text_classification.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+if __package__ in (None, ""):  # run as a script from any cwd
+    import _bootstrap  # noqa: F401
+else:
+    from . import _bootstrap  # noqa: F401
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=384)
+    ap.add_argument("--classes", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+    from bigdl_tpu.optim import Adam, Optimizer, Top1Accuracy, Trigger
+
+    Engine.init()
+    # synthetic corpus: class k draws from its own keyword pool
+    r = np.random.default_rng(0)
+    pools = [[f"w{k}_{i}" for i in range(12)] for k in range(args.classes)]
+    texts = []
+    labels = r.integers(0, args.classes, size=args.n)
+    for lbl in labels:
+        texts.append(" ".join(r.choice(pools[int(lbl)], size=10)))
+
+    tok = SentenceTokenizer()
+    tokens = [list(tok([t]))[0] for t in texts]
+    vocab = Dictionary(tokens, vocab_size=100)
+    seq_len, embed = 10, 20
+    table = r.normal(0, 0.3, size=(vocab.vocab_size() + 2, embed)) \
+        .astype(np.float32)
+    table[-1] = 0.0
+    pad = len(table) - 1
+
+    def encode(toks):
+        idx = np.full((seq_len,), pad, np.int64)
+        for i, t in enumerate(toks[:seq_len]):
+            idx[i] = vocab.get_index(t)
+        return table[idx]
+
+    samples = [Sample(encode(t), np.int32(l))
+               for t, l in zip(tokens, labels)]
+    split = args.n * 3 // 4
+    to_ds = lambda ss: DataSet.array(ss).transform(
+        SampleToMiniBatch(64, drop_last=True))
+
+    model = nn.Sequential(
+        nn.TemporalConvolution(embed, 48, 3), nn.ReLU(),
+        nn.Max(dim=1), nn.Linear(48, args.classes), nn.LogSoftMax())
+    Optimizer(model, to_ds(samples[:split]), nn.ClassNLLCriterion()) \
+        .set_optim_method(Adam(5e-3)) \
+        .set_end_when(Trigger.max_epoch(15)).optimize()
+
+    res = model.evaluate(to_ds(samples[split:]), [Top1Accuracy()])
+    print(f"held-out: {res}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Serving a trained text classifier as a DataFrame-filter UDF.
+
+Reference: `example/udfpredictor/DataframePredictor.scala` — register a
+trained model as a SQL UDF and filter rows by predicted class
+(`SELECT ... WHERE textClassifier(text) = k`), with `Utils.scala` doing the
+text -> embedded-tensor preprocessing (GloVe-style embeddings outside the
+model).  Here the query engine is pandas and the UDF is a vectorized
+callable (`bigdl_tpu.serving.TextClassifierUDF`).
+Run: python examples/udf_predictor.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+if __package__ in (None, ""):  # run as a script from any cwd
+    import _bootstrap  # noqa: F401
+else:
+    from . import _bootstrap  # noqa: F401
+
+SPORTS = ["match", "goal", "team", "score", "league", "coach", "win"]
+TECH = ["chip", "software", "compiler", "kernel", "gpu", "cloud", "api"]
+
+
+def synthetic_corpus(n, seed=0):
+    r = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        label = int(r.integers(0, 2))
+        vocab = SPORTS if label == 0 else TECH
+        texts.append(" ".join(r.choice(vocab, size=8)))
+        labels.append(label)
+    return texts, np.asarray(labels)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    import pandas as pd
+
+    from bigdl_tpu import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import Dictionary
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.serving import TextClassifierUDF
+
+    Engine.init()
+    texts, labels = synthetic_corpus(args.n)
+    tokens = [t.split() for t in texts]
+    vocab = Dictionary(tokens, vocab_size=64)
+    seq_len, embed = 8, 16
+    # fixed random embedding table (the reference example's GloVe role);
+    # last row = padding
+    r = np.random.default_rng(7)
+    table = r.normal(0, 0.3, size=(vocab.vocab_size() + 2, embed)) \
+        .astype(np.float32)
+    table[-1] = 0.0
+
+    model = nn.Sequential(
+        nn.TemporalConvolution(embed, 32, 3), nn.ReLU(),
+        nn.Max(dim=1), nn.Linear(32, 2), nn.LogSoftMax())
+
+    udf = TextClassifierUDF(model, dictionary=vocab, embeddings=table,
+                            seq_len=seq_len,
+                            tokenizer=lambda s: s.split())
+
+    def embed_text(t):
+        return udf._embed(t)  # same preprocessing for training and serving
+
+    samples = [Sample(embed_text(t), np.int32(l))
+               for t, l in zip(texts, labels)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(64,
+                                                            drop_last=True))
+    Optimizer(model, ds, nn.ClassNLLCriterion()) \
+        .set_optim_method(Adam(5e-3)) \
+        .set_end_when(Trigger.max_epoch(15)).optimize()
+
+    df = pd.DataFrame({"text": texts, "label": labels})
+    df["pred"] = udf(df["text"])
+    tech_rows = df[df["pred"] == 1]  # the WHERE-clause filter
+    acc = float((df["pred"] == df["label"]).mean())
+    print(f"UDF accuracy={acc:.3f}; tech rows={len(tech_rows)}/{len(df)}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Smoke tests for the runnable examples (reference: pyspark's
+simple_integration_test drives the shipped examples the same way)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.engine import Engine
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def test_lenet_local_example(tmp_path):
+    from examples import lenet_local
+    res = lenet_local.main(["--epochs", "3",
+                            "--checkpoint", str(tmp_path)])
+    (_, acc), = [(m, r.result()[0]) for m, r in res]
+    assert acc > 0.9
+    assert any(p.name.startswith("model.") for p in tmp_path.iterdir())
+
+
+def test_image_classification_example():
+    from examples import image_classification
+    acc, res = image_classification.main(["--n", "256"])
+    assert acc > 0.9
+
+
+def test_ml_pipeline_example():
+    pytest.importorskip("pandas")
+    from examples import ml_pipeline
+    assert ml_pipeline.main(["--n", "256"]) > 0.85
+
+
+def test_udf_predictor_example():
+    pytest.importorskip("pandas")
+    from examples import udf_predictor
+    assert udf_predictor.main(["--n", "192"]) > 0.9
+
+
+def test_tensorflow_interop_example():
+    from examples import tensorflow_interop
+    assert tensorflow_interop.main([]) < 1e-4
+
+
+def test_text_classification_example():
+    from examples import text_classification
+    res = text_classification.main(["--n", "256"])
+    (_, acc), = [(m, r.result()[0]) for m, r in res]
+    assert acc > 0.9
