@@ -178,3 +178,18 @@ class DataSet:
         the native BDRecord shard format (csrc/recordio.cpp, utils/recordio.py)."""
         from ..utils.recordio import read_records
         return DataSet.array(list(read_records(path)), distributed=distributed)
+
+    @staticmethod
+    def record_files(pattern, distributed: bool = False, seed: int = 1):
+        """A glob (or list) of BDRecord shards -> one dataset — the sharded
+        SeqFileFolder role (DataSet.scala:319): shard files concatenated in
+        sorted order, cached in memory like CachedDistriDataSet; under
+        `distributed=True` each process keeps its strided subset resident."""
+        import glob as _glob
+        from ..utils.recordio import read_records
+        paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
+                 else list(pattern))
+        if not paths:
+            raise FileNotFoundError(f"no record shards match {pattern!r}")
+        records = [rec for p in paths for rec in read_records(p)]
+        return DataSet.array(records, distributed=distributed, seed=seed)

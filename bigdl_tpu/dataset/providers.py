@@ -22,7 +22,8 @@ import numpy as np
 
 from .sample import Sample
 
-__all__ = ["load_mnist", "load_cifar10_binary", "load_labeled_text_dir"]
+__all__ = ["load_mnist", "load_cifar10_binary", "load_labeled_text_dir",
+           "load_movielens"]
 
 
 def _open_maybe_gz(path: str):
@@ -153,3 +154,33 @@ def load_labeled_text_dir(directory: str,
                 with open(path, "r", errors="replace") as f:
                     out.append((f.read(), label))
     return out, cats
+
+
+def load_movielens(directory: str, filename: str = "ratings.dat"
+                   ) -> np.ndarray:
+    """MovieLens ratings (movielens.py read_data_sets role): parses the
+    ml-1m `UserID::MovieID::Rating::Timestamp` format (also accepts
+    comma-separated ml-latest CSV, skipping a header row if present) into
+    an int32 (N, 3) array of [user_id, movie_id, rating]."""
+    path = os.path.join(directory, filename)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found; place the MovieLens ratings file there "
+            "(no downloads on a zero-egress host)")
+    rows: List[Tuple[int, int, int]] = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split("::") if "::" in line else line.split(",")
+            if len(parts) < 3:
+                continue
+            try:
+                rows.append((int(parts[0]), int(parts[1]),
+                             int(float(parts[2]))))
+            except ValueError:
+                continue  # header row ("userId,movieId,...")
+    if not rows:
+        raise ValueError(f"no ratings parsed from {path}")
+    return np.asarray(rows, dtype=np.int32)

@@ -373,14 +373,22 @@ class Optimizer:
         if latest is None:
             # failure before the first snapshot: the crashed attempt's
             # buffers were donated to the compiled step (deleted), so a
-            # bare re-run would crash on device_put — restart from a fresh
-            # init instead (the reference restarts from the initial model
-            # when no snapshot exists yet, DistriOptimizer.scala:828-845)
+            # bare re-run would crash on device_put — restore the starting
+            # weights captured at optimize() entry (the reference restarts
+            # from the initial model, DistriOptimizer.scala:828-845);
+            # fresh-init only if the model was never built by then
             if _any_deleted(self.model.params) or \
                     _any_deleted(self.model.state):
-                logger.warning("no checkpoint yet; re-initializing model "
-                               "for the retry")
-                self.model.build()
+                blob = getattr(self, "_initial_blob", None)
+                if blob is not None:
+                    logger.warning("no checkpoint yet; restoring the "
+                                   "initial weights for the retry")
+                    self.model.params = jax.tree.map(jnp.asarray, blob[0])
+                    self.model.state = jax.tree.map(jnp.asarray, blob[1])
+                else:
+                    logger.warning("no checkpoint yet; re-initializing "
+                                   "model for the retry")
+                    self.model.build()
             return
         model_path, optim_path, neval = latest
         self.resume_from(model_path, optim_path)
@@ -391,6 +399,13 @@ class Optimizer:
         model, optim = self.model, self.optim_method
         if model.params is None:
             model.build()
+        if getattr(self, "_initial_blob", None) is None:
+            # host-side copy of the STARTING weights: a failure before the
+            # first snapshot recovers to exactly these (the reference
+            # retries from the initial model, not a re-roll of the RNG) —
+            # the crashed attempt's donated device buffers are unusable
+            self._initial_blob = (jax.tree.map(np.asarray, model.params),
+                                  jax.tree.map(np.asarray, model.state))
 
         if self._compiled is None:
             self._compiled = self._build_step(mesh)

@@ -143,6 +143,32 @@ def test_dataset_record_file_builder(tmp_path):
     assert ds.size() == 6
 
 
+def test_dataset_record_files_glob(tmp_path):
+    """Sharded SeqFileFolder role: glob over BDRecord shards, sorted order."""
+    for shard in range(3):
+        recordio.write_records(str(tmp_path / f"part-{shard}.bdr"),
+                               samples(4))
+    ds = DataSet.record_files(str(tmp_path / "part-*.bdr"))
+    assert ds.size() == 12
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        DataSet.record_files(str(tmp_path / "nope-*.bdr"))
+
+
+def test_movielens_provider(tmp_path):
+    from bigdl_tpu.dataset.providers import load_movielens
+    (tmp_path / "ratings.dat").write_text(
+        "1::1193::5::978300760\n1::661::3::978302109\n2::1357::5::978298709\n")
+    r = load_movielens(str(tmp_path))
+    assert r.shape == (3, 3) and r.dtype.name == "int32"
+    assert r[0].tolist() == [1, 1193, 5]
+    # ml-latest CSV with header
+    (tmp_path / "ratings.csv").write_text(
+        "userId,movieId,rating,timestamp\n7,2,4.0,123\n8,3,3.5,456\n")
+    r2 = load_movielens(str(tmp_path), "ratings.csv")
+    assert r2.tolist() == [[7, 2, 4], [8, 3, 3]]
+
+
 def test_mt_sample_to_minibatch_matches_single_threaded():
     import numpy as np
     from bigdl_tpu.dataset import (MTSampleToMiniBatch, Sample,

@@ -48,6 +48,31 @@ def _dataset(fault=None, n=64, d=6):
     return ds.transform(fault) if fault is not None else ds
 
 
+def test_retry_before_first_checkpoint_restores_initial_weights(tmp_path):
+    """A failure BEFORE any snapshot exists must retry from the user's
+    starting weights (pretrained fine-tune case), not a fresh random init
+    (DistriOptimizer.scala:828-845 restarts from the initial model)."""
+    import jax
+    # fails on batch 2 of epoch 1: step 1 already DONATED the params, and
+    # no checkpoint exists yet
+    fault = ExceptionTest([2])
+    model = nn.Sequential().add(nn.Linear(6, 2)).build(jax.random.key(5))
+    pretrained = jax.tree.map(np.asarray, model.params)
+    opt = (Optimizer(model, _dataset(fault), nn.CrossEntropyCriterion())
+           .set_optim_method(Adam(1e-2))
+           .set_end_when(Trigger.max_epoch(1))
+           # checkpoint trigger that never fires before the fault
+           .set_checkpoint(str(tmp_path), Trigger.several_iteration(1000)))
+    trained = opt.optimize()
+    # completion proves recovery restored usable weights (device_put of the
+    # donated originals would have raised); the captured blob must be the
+    # USER's starting weights, not a re-rolled init
+    assert trained.params is not None
+    for a, b in zip(jax.tree.leaves(opt._initial_blob[0]),
+                    jax.tree.leaves(pretrained)):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_retry_recovers_from_checkpoint(tmp_path):
     fault = ExceptionTest([6])
     model = nn.Sequential().add(nn.Linear(6, 2))
