@@ -303,9 +303,23 @@ class Optimizer:
         rep = NamedSharding(mesh, P())
         data_sh = self.strategy.batch_sharding(mesh)
         param_sh = self.strategy.param_sharding(mesh, self.model.params)
+        # optimizer-slot shardings from the strategy (ZeRO slices under
+        # ShardedDataParallel), derived from the abstract opt_state shape
+        opt_state_shape = jax.eval_shape(optim.init_state, self.model.params)
+        opt_sh = self.strategy.opt_state_sharding(
+            mesh, opt_state_shape, self.model.params, param_sh)
+        # in/out shardings pin the threaded state to a stable layout: without
+        # them GSPMD may emit e.g. a column-parallel layer's bias 'model'-
+        # sharded or re-replicate ZeRO optimizer slices, and while
+        # single-host jit silently reshards the next call's input, a
+        # multi-host global array cannot be resharded implicitly
+        # (ValueError: sharding does not match); drifting shardings also
+        # force a recompile on the second call
         jitted = jax.jit(
             step,
-            in_shardings=(param_sh, rep, None, data_sh, data_sh, None, None),
+            in_shardings=(param_sh, rep, opt_sh, data_sh, data_sh,
+                          None, None),
+            out_shardings=(param_sh, rep, opt_sh, None),
             donate_argnums=(0, 1, 2),
         )
         return jitted, param_sh, data_sh
@@ -330,6 +344,10 @@ class Optimizer:
         max_retries = config.retry_times()  # bigdl.failure.retryTimes (:751)
         window = config.retry_time_interval()
         last_failure = None
+        # fresh per optimize() call: recovery must restore THIS run's
+        # starting weights, not a previous run's (the guard inside
+        # _optimize_impl keeps it stable across retry re-entries only)
+        self._initial_blob = None
         while True:
             try:
                 return self._optimize_impl()

@@ -301,3 +301,73 @@ def test_embedding_matches_torch():
         emb.weight.copy_(_t(w))
         ref = emb(torch.tensor(idx)).numpy()
     np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+
+def _run_optim_pair(ours_method, torch_opt_fn, steps=25, n=40):
+    """Drive our optimizer and torch.optim over IDENTICAL loss/grads
+    (deterministic quadratic with rotating data) and compare trajectories —
+    the optimizer analog of the layer goldens (reference: optim method
+    ports are torch-lineage, optim/SGD.scala:38 etc.)."""
+    import jax
+
+    r = np.random.default_rng(3)
+    w0 = r.normal(0, 0.5, size=(n,)).astype(np.float32)
+    a_all = r.normal(size=(steps, n)).astype(np.float32)
+
+    params = {"w": jnp.asarray(w0)}
+    state = ours_method.init_state(params)
+
+    wt = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch_opt_fn([wt])
+
+    for i in range(steps):
+        a = a_all[i]
+        grads = {"w": jnp.asarray(2 * a * (a * np.asarray(params["w"])))}
+        lr = jnp.float32(ours_method.get_learning_rate())
+        params, state = ours_method.update(grads, params, state, lr)
+
+        topt.zero_grad()
+        loss = ((torch.tensor(a) * wt) ** 2).sum()
+        loss.backward()
+        topt.step()
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               wt.detach().numpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_sgd_momentum_matches_torch_optim():
+    from bigdl_tpu.optim import SGD
+    # dampening pinned to 0: the Torch lineage (sgd.lua, SGD.scala) defaults
+    # dampening to `momentum`, pytorch defaults it to 0
+    _run_optim_pair(
+        SGD(learning_rate=0.05, momentum=0.9, weight_decay=1e-3,
+            dampening=0.0),
+        lambda p: torch.optim.SGD(p, lr=0.05, momentum=0.9,
+                                  weight_decay=1e-3))
+
+
+def test_sgd_nesterov_matches_torch_optim():
+    from bigdl_tpu.optim import SGD
+    _run_optim_pair(
+        SGD(learning_rate=0.03, momentum=0.8, nesterov=True, dampening=0.0),
+        lambda p: torch.optim.SGD(p, lr=0.03, momentum=0.8, nesterov=True))
+
+
+def test_adam_matches_torch_optim():
+    from bigdl_tpu.optim import Adam
+    _run_optim_pair(
+        Adam(learning_rate=0.01),
+        lambda p: torch.optim.Adam(p, lr=0.01))
+
+
+def test_adagrad_matches_torch_optim():
+    from bigdl_tpu.optim import Adagrad
+    _run_optim_pair(
+        Adagrad(learning_rate=0.05),
+        lambda p: torch.optim.Adagrad(p, lr=0.05))
+
+
+def test_rmsprop_matches_torch_optim():
+    from bigdl_tpu.optim import RMSprop
+    _run_optim_pair(
+        RMSprop(learning_rate=0.01, decay_rate=0.9),
+        lambda p: torch.optim.RMSprop(p, lr=0.01, alpha=0.9))
