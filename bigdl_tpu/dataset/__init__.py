@@ -62,10 +62,19 @@ class AbstractDataSet:
 
 
 class LocalArrayDataSet(AbstractDataSet):
-    """In-memory record list (reference: dataset/DataSet.scala:128)."""
+    """In-memory record list (reference: dataset/DataSet.scala:128).
 
-    def __init__(self, records: Sequence, seed: int = 1):
+    `group_size > 1` shuffles at GROUP granularity — consecutive records
+    stay adjacent, only group order is permuted.  This is the reference's
+    `isInOrder`/`groupSize` mode (CachedDistriDataSet, DataSet.scala:240):
+    records pre-sorted by length keep batches length-homogeneous under
+    shuffling, which both cuts padding waste and keeps padded shapes
+    stable across epochs (fewer XLA retraces for text workloads)."""
+
+    def __init__(self, records: Sequence, seed: int = 1,
+                 group_size: int = 1):
         self.records = list(records)
+        self.group_size = max(1, int(group_size))
         self._perm = np.arange(len(self.records))
         self._rng = np.random.default_rng(seed)
 
@@ -73,7 +82,16 @@ class LocalArrayDataSet(AbstractDataSet):
         return len(self.records)
 
     def shuffle(self) -> None:
-        self._rng.shuffle(self._perm)
+        if self.group_size == 1:
+            self._rng.shuffle(self._perm)
+            return
+        n = len(self.records)
+        if n == 0:
+            return
+        starts = np.arange(0, n, self.group_size)
+        self._rng.shuffle(starts)
+        self._perm = np.concatenate(
+            [np.arange(s, min(s + self.group_size, n)) for s in starts])
 
     def data(self, train: bool) -> Iterator:
         order = self._perm if train else np.arange(len(self.records))
@@ -94,14 +112,35 @@ class DistributedDataSet(AbstractDataSet):
     def __init__(self, records: Sequence, seed: int = 1,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None):
-        import jax
-        self.process_index = (jax.process_index() if process_index is None
-                              else process_index)
-        self.process_count = (jax.process_count() if process_count is None
-                              else process_count)
+        self._explicit_shard = (process_index, process_count)
         self._all = list(records)
         self._rng = np.random.default_rng(seed)
         self._perm = np.arange(len(self._all))
+
+    def _shard(self):
+        """Per-process (shard_index, shard_count), resolved LAZILY on every
+        data pass: derived from the CURRENT mesh topology ('model'-first
+        mesh -> every process keeps the full dataset, see
+        Engine.data_shard_info) rather than frozen at construction, so
+        dataset-before-Engine.init ordering cannot bake in a stale layout."""
+        import jax
+        pi, pc = self._explicit_shard
+        if pi is not None and pc is not None:
+            return pi, pc
+        from ..utils.engine import Engine
+        if Engine._mesh is not None:
+            si, sc = Engine.data_shard_info()
+        else:  # no mesh yet: blind per-process slice (the default-DP layout)
+            si, sc = jax.process_index(), jax.process_count()
+        return (si if pi is None else pi, sc if pc is None else pc)
+
+    @property
+    def process_index(self) -> int:
+        return self._shard()[0]
+
+    @property
+    def process_count(self) -> int:
+        return self._shard()[1]
 
     def size(self) -> int:
         return len(self._all)
@@ -156,6 +195,16 @@ class DataSet:
         if distributed:
             return DistributedDataSet(records, seed=seed)
         return LocalArrayDataSet(records, seed=seed)
+
+    @staticmethod
+    def sorted_array(records, key, group_size: int, seed: int = 1):
+        """Records sorted by `key` (e.g. sequence length) with group-wise
+        shuffling — the reference's `DataSet.sortRDD` + `groupSize` pattern
+        (dataset/DataSet.scala:372, :240) for variable-length text: batches
+        drawn from a group share similar lengths, so per-batch padding is
+        minimal and padded shapes repeat across epochs."""
+        return LocalArrayDataSet(sorted(records, key=key), seed=seed,
+                                 group_size=group_size)
 
     @staticmethod
     def rdd(records, seed: int = 1):

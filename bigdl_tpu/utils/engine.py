@@ -154,6 +154,35 @@ class Engine:
     # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
 
     @classmethod
+    def data_shard_info(cls, axis: str = None) -> tuple:
+        """(shard_index, shard_count) for PER-PROCESS input sharding,
+        derived from how the mesh's data axis maps onto processes (the
+        locality role of ZippedPartitionsWithLocalityRDD, SURVEY.md §5.8).
+
+        A process must feed exactly the batch rows its devices will hold:
+        when the data axis spans processes, each process feeds its slice
+        (shard_count > 1); when the data axis is intra-process (e.g. a
+        'model'-first mesh where TP spans hosts and the batch is replicated
+        across them), every process must feed the FULL batch
+        (shard_count == 1).  Feeding a blind per-process slice in the
+        latter layout silently trains each host on different data."""
+        axis = axis or cls.DATA_AXIS
+        if jax.process_count() == 1:
+            return 0, 1
+        mesh = cls.mesh()
+        if axis not in mesh.axis_names:
+            return jax.process_index(), jax.process_count()
+        devs = np.asarray(mesh.devices)
+        ax = mesh.axis_names.index(axis)
+        size = devs.shape[ax]
+        rows = np.moveaxis(devs, ax, 0).reshape(size, -1)
+        def coverage(pid):
+            return tuple(i for i in range(size)
+                         if any(d.process_index == pid for d in rows[i]))
+        unique = sorted({coverage(p) for p in range(jax.process_count())})
+        return unique.index(coverage(jax.process_index())), len(unique)
+
+    @classmethod
     def node_number(cls) -> int:
         """Number of host processes (BigDL: Engine.nodeNumber, utils/Engine.scala)."""
         return jax.process_count()

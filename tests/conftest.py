@@ -33,3 +33,39 @@ def _reset_engine():
     Engine.reset()
     yield
     Engine.reset()
+
+
+def spawn_multihost_workers(worker_src: str, tmp_path, n: int = 2,
+                            timeout: int = 420):
+    """Run `worker_src` as n real OS processes joined via the
+    BIGDL_TPU_COORDINATOR env contract; returns the last JSON line each
+    worker printed.  Shared by the multi-host integration tests."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = tmp_path / "mh_worker.py"
+    worker.write_text(worker_src)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env_base = {**os.environ,
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BIGDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "BIGDL_TPU_NUM_PROCESSES": str(n)}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker)],
+        env={**env_base, "BIGDL_TPU_PROCESS_ID": str(i)},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(n)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        outs.append(json.loads(line))
+    return outs

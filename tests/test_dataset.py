@@ -169,6 +169,22 @@ def test_movielens_provider(tmp_path):
     assert r2.tolist() == [[7, 2, 4], [8, 3, 3]]
 
 
+def test_sorted_array_group_shuffle():
+    """DataSet.sortRDD + groupSize role: records sorted by length, shuffle
+    permutes groups only — batches stay length-homogeneous."""
+    recs = [np.zeros(n) for n in [7, 3, 9, 1, 5, 8, 2, 6]]
+    ds = DataSet.sorted_array(recs, key=len, group_size=2, seed=3)
+    for _ in range(5):
+        ds.shuffle()
+        lens = [len(r) for r in ds.data(train=True)]
+        assert sorted(lens) == [1, 2, 3, 5, 6, 7, 8, 9]
+        # each adjacent pair must be one of the sorted-order groups
+        pairs = {(lens[i], lens[i + 1]) for i in range(0, 8, 2)}
+        assert pairs <= {(1, 2), (3, 5), (6, 7), (8, 9)}, lens
+    # eval order is the sorted order, untouched by shuffling
+    assert [len(r) for r in ds.data(train=False)] == [1, 2, 3, 5, 6, 7, 8, 9]
+
+
 def test_mt_sample_to_minibatch_matches_single_threaded():
     import numpy as np
     from bigdl_tpu.dataset import (MTSampleToMiniBatch, Sample,

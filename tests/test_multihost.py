@@ -11,14 +11,11 @@ DistributedDataSet per-process sharding, and Optimizer._put_batch's
 8-device mesh cannot reach.
 """
 
-import json
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import pytest
+
+from conftest import spawn_multihost_workers
 
 _WORKER = textwrap.dedent("""
     import json, os, sys
@@ -68,36 +65,8 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_training(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
-    port = _free_port()
-    env_base = {**os.environ,
-                "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
-                "BIGDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
-                "BIGDL_TPU_NUM_PROCESSES": "2"}
-    procs = [
-        subprocess.Popen([sys.executable, str(worker)],
-                         env={**env_base, "BIGDL_TPU_PROCESS_ID": str(i)},
-                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True)
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        outs.append(json.loads(line))
-
+    outs = spawn_multihost_workers(_WORKER, tmp_path)
     by_rank = {o["rank"]: o for o in outs}
     assert set(by_rank) == {0, 1}
     # training happened and converged on the separable data

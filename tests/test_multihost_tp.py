@@ -7,14 +7,11 @@ process boundaries, so every compiled step's collectives ride the Gloo
 inter-process backend — evidence the net-new parallelism (SURVEY.md §7)
 works beyond one host."""
 
-import json
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import pytest
+
+from conftest import spawn_multihost_workers
 
 _WORKER = textwrap.dedent("""
     import json
@@ -30,7 +27,11 @@ _WORKER = textwrap.dedent("""
     from bigdl_tpu.optim import Adam, Optimizer, Trigger
     from bigdl_tpu.parallel.sharding import TensorParallel
 
-    mesh = Engine.init(mesh_shape={"data": 2, "model": 2})
+    # 'model' FIRST: the global device list orders process 0's devices
+    # before process 1's (row-major reshape), so the leading axis is the
+    # one that spans processes — TP collectives must ride the inter-process
+    # backend, not stay intra-host
+    mesh = Engine.init(mesh_shape={"model": 2, "data": 2})
     assert jax.process_count() == 2
     rank = jax.process_index()
 
@@ -55,8 +56,8 @@ _WORKER = textwrap.dedent("""
                           nn.Linear(32, classes), nn.LogSoftMax())
     opt = (Optimizer(model, ds, nn.ClassNLLCriterion(),
                      strategy=TensorParallel(rule=tp_rule))
-           .set_optim_method(Adam(5e-3))
-           .set_end_when(Trigger.max_epoch(10)))
+           .set_optim_method(Adam(1e-2))
+           .set_end_when(Trigger.max_epoch(20)))
     trained = opt.optimize()
 
     # the TP-sharded weight spans both processes; gather it for the digest
@@ -70,36 +71,8 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_dp_tp_training(tmp_path):
-    worker = tmp_path / "worker_tp.py"
-    worker.write_text(_WORKER)
-    port = _free_port()
-    env_base = {**os.environ,
-                "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
-                "BIGDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
-                "BIGDL_TPU_NUM_PROCESSES": "2"}
-    procs = [
-        subprocess.Popen([sys.executable, str(worker)],
-                         env={**env_base, "BIGDL_TPU_PROCESS_ID": str(i)},
-                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True)
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        outs.append(json.loads(line))
-
+    outs = spawn_multihost_workers(_WORKER, tmp_path)
     by_rank = {o["rank"]: o for o in outs}
     assert set(by_rank) == {0, 1}
     for o in outs:
