@@ -322,6 +322,22 @@ def _cfg_textcnn():
             jnp.ones((b,), jnp.int32), 0.05)
 
 
+def _cfg_transformer_lm():
+    """Net-new long-context workload (SURVEY.md §7): decoder-only LM in
+    bf16 — flash-attention + matmul path on the MXU."""
+    import jax.numpy as jnp
+    from bigdl_tpu.common import DTypePolicy, set_policy
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    b, t = 16, 512
+    return (TransformerLM(vocab_size=32000, max_len=t, d_model=512,
+                          num_heads=8, num_layers=8),
+            TimeDistributedCriterion(ClassNLLCriterion(), size_average=True),
+            jnp.zeros((b, t), jnp.int32),
+            jnp.ones((b, t), jnp.int32), 0.01)
+
+
 def _cfg_lstm():
     import jax.numpy as jnp
     from bigdl_tpu.models.rnn import PTBModel
@@ -336,6 +352,7 @@ def _cfg_lstm():
 CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
            "lenet": _cfg_lenet, "inception_v1": _cfg_inception_v1,
            "textcnn": _cfg_textcnn, "lstm": _cfg_lstm,
+           "transformer_lm": _cfg_transformer_lm,
            # inference (Predictor/Evaluator path, fwd-only MFU); last so the
            # soft budget never skips a train config in its favor
            "resnet50_infer_bf16": _cfg_resnet50_bf16}

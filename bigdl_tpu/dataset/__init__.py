@@ -146,7 +146,7 @@ class DistributedDataSet(AbstractDataSet):
         return len(self._all)
 
     def local_size(self) -> int:
-        return len(self._all) // self.process_count
+        return len(self._all) // self._shard()[1]
 
     def shuffle(self) -> None:
         self._rng.shuffle(self._perm)
@@ -156,9 +156,10 @@ class DistributedDataSet(AbstractDataSet):
         # strided shard over the global permutation -> per-host local records,
         # truncated so every host yields the SAME count (unequal counts would
         # deadlock the per-step collectives when one host leaves the epoch
-        # loop early)
-        per_host = len(order) // self.process_count
-        for i in order[self.process_index::self.process_count][:per_host]:
+        # loop early); shard resolved ONCE per pass (it scans the mesh)
+        shard_index, shard_count = self._shard()
+        per_host = len(order) // shard_count
+        for i in order[shard_index::shard_count][:per_host]:
             yield self._all[i]
 
 

@@ -9,13 +9,16 @@ from .lenet import LeNet5
 from .resnet import ResNet, ShortcutType
 from .rnn import PTBModel, SimpleRNN
 from .textclassifier import TextClassifier
+from .transformer_lm import (PositionalEmbedding, TransformerBlock,
+                             TransformerLM)
 from .treelstm_sentiment import TreeLSTMSentiment, encode_tree
 from .vgg import Vgg_16, Vgg_19, VggForCifar10
 
 __all__ = [
     "AlexNet", "Autoencoder", "Inception_Layer_v1", "Inception_Layer_v2",
     "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_v2",
-    "Inception_v2_NoAuxClassifier", "LeNet5", "PTBModel", "ResNet",
-    "ShortcutType", "SimpleRNN", "TextClassifier", "TreeLSTMSentiment",
-    "encode_tree", "Vgg_16", "Vgg_19", "VggForCifar10",
+    "Inception_v2_NoAuxClassifier", "LeNet5", "PTBModel",
+    "PositionalEmbedding", "ResNet", "ShortcutType", "SimpleRNN",
+    "TextClassifier", "TransformerBlock", "TransformerLM",
+    "TreeLSTMSentiment", "encode_tree", "Vgg_16", "Vgg_19", "VggForCifar10",
 ]

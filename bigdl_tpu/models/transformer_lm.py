@@ -1,0 +1,93 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+Net-new vs the 2017 reference (its only sequence model is the SimpleRNN
+char-LM, models/rnn/SimpleRNN.scala:29-31); this is the workload that
+exercises the rebuild's §7 capabilities end to end: flash attention
+(ops/attention, Pallas on TPU), ring/Ulysses sequence parallelism
+(parallel/ring_attention via MultiHeadAttention(seq_parallel=True)), and
+the usual DP/TP mesh strategies — all under the same Optimizer facade.
+
+Built from the library's own Torch-style containers: residual branches are
+ConcatTable + CAddTable (the reference's residual idiom, e.g.
+models/resnet/ResNet.scala shortcuts), so the model doubles as a stress
+test of the container algebra.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common import get_policy
+from ..nn import (CAddTable, ConcatTable, Dropout, GELU, Identity, LayerNorm,
+                  Linear, LogSoftMax, LookupTable, MultiHeadAttention,
+                  Sequential)
+from ..nn.module import Module
+
+__all__ = ["TransformerLM", "TransformerBlock", "PositionalEmbedding"]
+
+
+class PositionalEmbedding(Module):
+    """Learned absolute positions added to [B, T, E] token embeddings."""
+
+    def __init__(self, max_len: int, embed_dim: int):
+        super().__init__()
+        self.max_len = max_len
+        self.embed_dim = embed_dim
+
+    def _init(self, rng):
+        dt = get_policy().param_dtype
+        return {"weight": 0.02 * jax.random.normal(
+            rng, (self.max_len, self.embed_dim), dt)}
+
+    def _apply(self, params, x):
+        t = x.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+        return x + params["weight"][:t].astype(x.dtype)
+
+
+def _residual(branch: Module) -> Sequential:
+    """y = x + branch(x), via the library's table algebra."""
+    return (Sequential()
+            .add(ConcatTable(branch, Identity()))
+            .add(CAddTable()))
+
+
+def TransformerBlock(d_model: int, num_heads: int, mlp_ratio: int = 4,
+                     dropout: float = 0.0, causal: bool = True,
+                     seq_parallel: bool = False) -> Sequential:
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    attn = (Sequential()
+            .add(LayerNorm(d_model))
+            .add(MultiHeadAttention(d_model, num_heads, causal=causal,
+                                    seq_parallel=seq_parallel)))
+    mlp = (Sequential()
+           .add(LayerNorm(d_model))
+           .add(Linear(d_model, mlp_ratio * d_model))
+           .add(GELU())
+           .add(Linear(mlp_ratio * d_model, d_model)))
+    if dropout > 0:
+        attn.add(Dropout(dropout))
+        mlp.add(Dropout(dropout))
+    return Sequential().add(_residual(attn)).add(_residual(mlp))
+
+
+def TransformerLM(vocab_size: int, max_len: int = 1024, d_model: int = 256,
+                  num_heads: int = 8, num_layers: int = 4,
+                  mlp_ratio: int = 4, dropout: float = 0.0,
+                  causal: bool = True,
+                  seq_parallel: bool = False) -> Sequential:
+    """tokens [B, T] int -> log-probs [B, T, vocab]; pairs with
+    TimeDistributedCriterion(ClassNLLCriterion) like the PTB LSTM."""
+    model = (Sequential()
+             .add(LookupTable(vocab_size, d_model))
+             .add(PositionalEmbedding(max_len, d_model)))
+    for _ in range(num_layers):
+        model.add(TransformerBlock(d_model, num_heads, mlp_ratio=mlp_ratio,
+                                   dropout=dropout, causal=causal,
+                                   seq_parallel=seq_parallel))
+    model.add(LayerNorm(d_model))
+    model.add(Linear(d_model, vocab_size))  # contracts the last axis of BTE
+    model.add(LogSoftMax())
+    return model

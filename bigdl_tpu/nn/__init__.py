@@ -9,10 +9,10 @@ from .initialization import (Zeros, Ones, ConstInitMethod, RandomUniform,
 from .containers import (Sequential, Concat, ConcatTable, ParallelTable,
                          MapTable, Identity, Echo, Bottle)
 from .graph import Graph, Input, ModuleNode
-from .activation import (ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, Tanh,
-                         TanhShrink, Sigmoid, SoftMax, SoftMin, SoftPlus,
-                         SoftSign, SoftShrink, HardShrink, HardTanh, Threshold,
-                         LogSoftMax, LogSigmoid)
+from .activation import (ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, GELU,
+                         Tanh, TanhShrink, Sigmoid, SoftMax, SoftMin,
+                         SoftPlus, SoftSign, SoftShrink, HardShrink, HardTanh,
+                         Threshold, LogSoftMax, LogSigmoid)
 from .linear import (Linear, Bilinear, CMul, CAdd, Mul, Add, MulConstant,
                      AddConstant)
 from .conv import (SpatialConvolution, SpatialDilatedConvolution,
@@ -24,7 +24,7 @@ from .pooling import (SpatialMaxPooling, SpatialAveragePooling,
 from .detection import Nms
 from .tree import TreeLSTM, BinaryTreeLSTM
 from .normalization import (BatchNormalization, SpatialBatchNormalization,
-                            Normalize, SpatialCrossMapLRN,
+                            LayerNorm, Normalize, SpatialCrossMapLRN,
                             SpatialWithinChannelLRN,
                             SpatialSubtractiveNormalization,
                             SpatialDivisiveNormalization,

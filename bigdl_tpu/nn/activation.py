@@ -187,3 +187,12 @@ class LogSoftMax(Module):
 class LogSigmoid(Module):
     def _apply(self, params, x):
         return jax.nn.log_sigmoid(x)
+
+
+class GELU(Module):
+    """Gaussian-error linear unit (net-new vs the 2017 reference; the
+    transformer MLP activation — companion to nn/attention and
+    nn.LayerNorm)."""
+
+    def _apply(self, params, x):
+        return jax.nn.gelu(x)

@@ -51,8 +51,10 @@ class MultiHeadAttention(Module):
         p = {"wq": w(ks[0], (e, e)), "wk": w(ks[1], (e, e)),
              "wv": w(ks[2], (e, e)), "wo": w(ks[3], (e, e))}
         if self.with_bias:
-            z = jnp.zeros((e,), dt)
-            p.update({"bq": z, "bk": z, "bv": z, "bo": z})
+            # distinct arrays per bias: aliased leaves crash buffer donation
+            # in the compiled train step ("donate the same buffer twice")
+            p.update({k: jnp.zeros((e,), dt)
+                      for k in ("bq", "bk", "bv", "bo")})
         return p
 
     def _proj(self, params, x, name):

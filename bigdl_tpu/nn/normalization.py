@@ -109,6 +109,37 @@ class SpatialBatchNormalization(BatchNormalization):
     is last either way."""
 
 
+class LayerNorm(Module):
+    """Layer normalization over the last axis (net-new vs the 2017
+    reference — required by the transformer/long-context capability,
+    SURVEY.md §7; companion to nn/attention.MultiHeadAttention).  Stats in
+    f32 regardless of the compute dtype, per-feature affine like BN."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.affine = affine
+
+    def _init(self, rng):
+        if not self.affine:
+            return {}
+        dt = get_policy().param_dtype
+        return {"weight": jnp.ones((self.n_output,), dt),
+                "bias": jnp.zeros((self.n_output,), dt)}
+
+    def _apply(self, params, x):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["weight"].astype(jnp.float32) + \
+                params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
 class Normalize(Module):
     """L_p-normalize along the feature axis (nn/Normalize.scala)."""
 

@@ -171,7 +171,9 @@ class Engine:
             return 0, 1
         mesh = cls.mesh()
         if axis not in mesh.axis_names:
-            return jax.process_index(), jax.process_count()
+            # no data axis -> batch_sharding replicates the batch: every
+            # process must feed the identical full dataset
+            return 0, 1
         devs = np.asarray(mesh.devices)
         ax = mesh.axis_names.index(axis)
         size = devs.shape[ax]
