@@ -65,6 +65,15 @@ def _build_model(name: str, class_num: int):
     if name == "autoencoder":
         from .autoencoder import Autoencoder
         return Autoencoder(32), (28, 28, 1), "mse"
+    if name == "transformer":
+        # token-sequence LM (long-context flagship); class_num = vocab size,
+        # input spec ("tokens", seq_len) drives the synthetic/record loaders
+        from .transformer_lm import TransformerLM
+        vocab = max(class_num, 64)
+        seq = 128
+        return (TransformerLM(vocab_size=vocab, max_len=seq, d_model=256,
+                              num_heads=8, num_layers=4),
+                ("tokens", seq, vocab), "lm")
     raise ValueError(f"unknown model {name!r}")
 
 
@@ -93,8 +102,19 @@ def _load_samples(path: str, input_hw):
 def _synthetic(input_hw, class_num: int, n: int = 512, seed: int = 0):
     """Separable synthetic data: class prototypes are FIXED (seed 0) so
     train (seed 0) and validation (seed 1) describe the same classes; only
-    the noise differs."""
+    the noise differs.  ("tokens", seq, vocab) spec -> deterministic cyclic
+    sequences for the LM (predict token t from t-1)."""
     from ..dataset import Sample
+    if input_hw and input_hw[0] == "tokens":
+        _, seq, vocab = input_hw
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            start = int(rng.integers(0, vocab))
+            toks = [(start + i) % vocab for i in range(seq + 1)]
+            out.append(Sample(np.asarray(toks[:-1], np.int32),
+                              np.asarray(toks[1:], np.int32)))
+        return out
     protos = np.random.default_rng(0).standard_normal(
         (class_num,) + input_hw)
     rng = np.random.default_rng(seed)
@@ -120,6 +140,9 @@ def train(args) -> None:
         criterion = nn.MSECriterion()
     elif crit == "nll":
         criterion = nn.ClassNLLCriterion()
+    elif crit == "lm":  # per-token NLL over [B, T, vocab] log-probs
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                size_average=True)
     else:
         criterion = nn.CrossEntropyCriterion()
     ds = DataSet.array(samples).transform(

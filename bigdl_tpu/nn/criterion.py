@@ -515,6 +515,20 @@ class TimeDistributedCriterion(Criterion):
 
     def loss(self, output, target):
         T = output.shape[1]
-        total = sum(self.critrn.loss(output[:, t], target[:, t])
-                    for t in range(T))
+        # lax.scan, not a Python loop: the body traces ONCE, so a T=512 LM
+        # criterion does not unroll 512 slice+gather+mean subgraphs (plus
+        # their VJPs) into the compiled train step.  A flattened single
+        # call would be cheaper still but changes semantics when padding
+        # varies per timestep (per-step means vs one global mean) — the
+        # reference applies the criterion per step (TimeDistributed
+        # Criterion.scala), so scan preserves that exactly.
+        o_t = jnp.moveaxis(output, 1, 0)
+        t_t = jnp.moveaxis(target, 1, 0)
+
+        def body(acc, ot):
+            o, t = ot
+            return acc + self.critrn.loss(o, t), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (o_t, t_t))
         return total / T if self.size_average else total

@@ -308,6 +308,7 @@ class Optimizer:
         opt_state_shape = jax.eval_shape(optim.init_state, self.model.params)
         opt_sh = self.strategy.opt_state_sharding(
             mesh, opt_state_shape, self.model.params, param_sh)
+        self._opt_sh = opt_sh  # single source of truth for placement too
         # in/out shardings pin the threaded state to a stable layout: without
         # them GSPMD may emit e.g. a column-parallel layer's bias 'model'-
         # sharded or re-replicate ZeRO optimizer slices, and while
@@ -435,11 +436,9 @@ class Optimizer:
         opt_state = (jax.tree.map(jnp.asarray, resume_os)
                      if resume_os is not None else optim.init_state(params))
         # place optimizer slots per the strategy (ShardedDataParallel = ZeRO
-        # slices; DataParallel = replicated); jit preserves input shardings
-        opt_state = jax.device_put(
-            opt_state,
-            self.strategy.opt_state_sharding(mesh, opt_state, params,
-                                             param_sh))
+        # slices; DataParallel = replicated) — the SAME shardings the step
+        # was compiled with (_build_step's in/out pins)
+        opt_state = jax.device_put(opt_state, self._opt_sh)
         self._resume_opt_state = None
 
         # driver state (reference: optimMethod.state Table). "neval" counts

@@ -282,3 +282,20 @@ def test_plateau_trigger_latches_after_firing():
     assert t({"val_loss": 1.0, "val_obs": 2})   # fires
     assert t({"val_loss": 1.0, "val_obs": 2})   # latched, same tick
     assert t({"val_loss": 0.1, "val_obs": 3})   # latched even on improvement
+
+
+def test_cli_transformer_synthetic_smoke():
+    """Train CLI drives the transformer LM workload (token-spec synthetic
+    data, TimeDistributedCriterion, per-token Top1 validation)."""
+    import sys
+    from bigdl_tpu.models import run as run_cli
+    argv_save = sys.argv
+    try:
+        sys.argv = ["run", "train", "--model", "transformer", "--synthetic",
+                    "--class-num", "64", "--batch-size", "32",
+                    "--max-epoch", "1", "--max-iteration", "3",
+                    "--learning-rate", "0.003", "--optim", "adam"]
+        opt = run_cli.main()
+        assert opt.optim_method.hyper["neval"] > 3
+    finally:
+        sys.argv = argv_save
