@@ -46,6 +46,12 @@ def test_tensorflow_interop_example():
     assert tensorflow_interop.main([]) < 1e-4
 
 
+def test_transformer_lm_long_context_example():
+    from examples import transformer_lm_long_context
+    acc, err = transformer_lm_long_context.main(["--epochs", "10"])
+    assert acc > 0.9 and err < 1e-3
+
+
 def test_text_classification_example():
     from examples import text_classification
     res = text_classification.main(["--n", "256"])

@@ -637,3 +637,55 @@ def test_every_exported_class_is_tested():
                 re.search(rf"\b{re.escape(name)}\b", here)):
             untested.append(name)
     assert not untested, f"exported nn classes with no test: {untested}"
+
+
+def test_layernorm_golden_and_grad():
+    m = nn.LayerNorm(6).build(rng())
+    x = _x((3, 5, 6), 11)
+    y = np.asarray(m.forward(x))
+    xn = np.asarray(x)
+    mean = xn.mean(-1, keepdims=True)
+    var = ((xn - mean) ** 2).mean(-1, keepdims=True)
+    expect = (xn - mean) / np.sqrt(var + 1e-5)
+    expect = expect * np.asarray(m.params["weight"]) + \
+        np.asarray(m.params["bias"])
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+    _fd_check_param(m, x, ("weight",), (2,))
+
+
+def test_gelu_golden():
+    x = _x((4, 7), 12, scale=2.0)
+    y = np.asarray(nn.GELU().build(rng()).forward(x))
+    xn = np.asarray(x, np.float64)
+    # tanh approximation (jax.nn.gelu default)
+    expect = 0.5 * xn * (1 + np.tanh(np.sqrt(2 / np.pi) *
+                                     (xn + 0.044715 * xn ** 3)))
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+    gx = jax.grad(lambda v: jnp.sum(jnp.square(jax.nn.gelu(v))))(x)
+    assert np.all(np.isfinite(np.asarray(gx)))
+
+
+def test_multi_head_attention_golden_and_grad():
+    """MHA forward == reference softmax attention composed from the same
+    projections; wq gets a finite-difference gradient check."""
+    m = nn.MultiHeadAttention(8, 2, causal=True).build(rng())
+    x = _x((2, 5, 8), 13)
+    y = np.asarray(m.forward(x))
+    p = {k: np.asarray(v) for k, v in m.params.items()}
+    q = np.asarray(x) @ p["wq"] + p["bq"]
+    k_ = np.asarray(x) @ p["wk"] + p["bk"]
+    v = np.asarray(x) @ p["wv"] + p["bv"]
+
+    def split(a):  # [B,T,E] -> [B,H,T,D]
+        return a.reshape(2, 5, 2, 4).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q), split(k_), split(v)
+    logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(4.0)
+    mask = np.tril(np.ones((5, 5), bool))
+    logits = np.where(mask, logits, -np.inf)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    ctx = (w @ vh).transpose(0, 2, 1, 3).reshape(2, 5, 8)
+    expect = ctx @ p["wo"] + p["bo"]
+    np.testing.assert_allclose(y, expect, rtol=2e-4, atol=2e-5)
+    _fd_check_param(m, x, ("wq",), (0, 1))
