@@ -77,6 +77,21 @@ def _build_model(name: str, class_num: int):
     raise ValueError(f"unknown model {name!r}")
 
 
+def build_criterion(crit: str):
+    """crit-name -> criterion: the SINGLE source of the model/criterion
+    pairing, shared by the Train CLI and tools/perf.py so the perf harness
+    always times the loss real training uses."""
+    from .. import nn
+    if crit == "mse":
+        return nn.MSECriterion()
+    if crit == "nll":
+        return nn.ClassNLLCriterion()
+    if crit == "lm":  # per-token NLL over [B, T, vocab] log-probs
+        return nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+    return nn.CrossEntropyCriterion()
+
+
 def _load_samples(path: str, input_hw):
     """BDRecord shards of {'data','label'} dicts or Samples -> [Sample]."""
     from ..dataset import Sample
@@ -137,14 +152,7 @@ def train(args) -> None:
     if crit == "mse":  # autoencoder: reconstruct the input
         from ..dataset import Sample
         samples = [Sample(s.feature, s.feature) for s in samples]
-        criterion = nn.MSECriterion()
-    elif crit == "nll":
-        criterion = nn.ClassNLLCriterion()
-    elif crit == "lm":  # per-token NLL over [B, T, vocab] log-probs
-        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                                size_average=True)
-    else:
-        criterion = nn.CrossEntropyCriterion()
+    criterion = build_criterion(crit)
     ds = DataSet.array(samples).transform(
         SampleToMiniBatch(args.batch_size, drop_last=True))
     method = (Adam(args.learning_rate) if args.optim == "adam"

@@ -29,14 +29,13 @@ _MODELS = {"inception_v1": ("inception", 1000),
            "inception_v2": ("inception_v2", 1000),
            "vgg16": ("vgg16", 1000),
            "vgg19": ("vgg19", 1000), "resnet50": ("resnet50", 1000),
-           "alexnet": ("alexnet", 1000), "lenet": ("lenet", 10)}
+           "alexnet": ("alexnet", 1000), "lenet": ("lenet", 10),
+           "transformer": ("transformer", 32000)}
 
 
 def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
         profile_dir: str = None):
-    from ..models.run import _build_model
-    from ..nn import (ClassNLLCriterion, CrossEntropyCriterion,
-                      MSECriterion)
+    from ..models.run import _build_model, build_criterion
     from ..optim import SGD, Optimizer, Trigger
     from ..utils.engine import Engine
 
@@ -45,8 +44,7 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
     mesh = Engine.mesh()
     zoo_name, classes = _MODELS[model_name]
     model, input_hw, crit = _build_model(zoo_name, classes)
-    criterion = {"nll": ClassNLLCriterion(), "mse": MSECriterion(),
-                 "xent": CrossEntropyCriterion()}[crit]
+    criterion = build_criterion(crit)
     model.build(jax.random.key(0))
     opt = Optimizer(model, dataset=None, criterion=criterion,
                     end_trigger=Trigger.max_iteration(1))
@@ -56,10 +54,16 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
     params = jax.device_put(model.params, param_sh)
     net_state = model.state
     opt_state = opt.optim_method.init_state(params)
-    inp = jnp.asarray(np.random.default_rng(0).standard_normal(
-        (batch_size,) + input_hw), jnp.float32)
-    tgt = jnp.asarray(np.random.default_rng(1).integers(
-        0, classes, batch_size), jnp.float32)
+    if input_hw and input_hw[0] == "tokens":  # LM: int token sequences
+        _, seq, vocab = input_hw
+        r = np.random.default_rng(0)
+        inp = jnp.asarray(r.integers(0, vocab, (batch_size, seq)), jnp.int32)
+        tgt = jnp.asarray(r.integers(0, vocab, (batch_size, seq)), jnp.int32)
+    else:
+        inp = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (batch_size,) + input_hw), jnp.float32)
+        tgt = jnp.asarray(np.random.default_rng(1).integers(
+            0, classes, batch_size), jnp.float32)
     rng = jax.random.key(1)
 
     def one():
