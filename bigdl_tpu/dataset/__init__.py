@@ -103,10 +103,11 @@ class DistributedDataSet(AbstractDataSet):
     """Per-host sharded records (reference: CachedDistriDataSet,
     dataset/DataSet.scala:240).
 
-    All processes construct it with the FULL record list (or a loader that can
-    produce any index); each keeps only its `process_index`-th shard resident.
-    `size()` reports the GLOBAL count; shuffles are seed-synchronized so every
-    host walks the same global permutation.
+    All processes construct it with the FULL record list and keep it
+    resident; each data pass YIELDS only this process's shard.  (Full-list
+    caching keeps seed-synchronized global shuffles trivial; for corpora
+    near host-memory size, assign shard FILES per process instead and pass
+    process_index=0, process_count=1.)  `size()` reports the GLOBAL count.
     """
 
     def __init__(self, records: Sequence, seed: int = 1,
@@ -233,8 +234,10 @@ class DataSet:
     def record_files(pattern, distributed: bool = False, seed: int = 1):
         """A glob (or list) of BDRecord shards -> one dataset — the sharded
         SeqFileFolder role (DataSet.scala:319): shard files concatenated in
-        sorted order, cached in memory like CachedDistriDataSet; under
-        `distributed=True` each process keeps its strided subset resident."""
+        sorted order and cached in memory on EVERY process; under
+        `distributed=True` each data pass yields only this process's record
+        shard.  For corpora near host-memory size, split the file list per
+        process yourself and build per-host local datasets instead."""
         import glob as _glob
         from ..utils.recordio import read_records
         paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)

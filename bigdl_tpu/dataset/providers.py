@@ -128,6 +128,9 @@ def load_labeled_text_dir(directory: str,
                             raise ValueError(
                                 f"unsafe tar member {m.name!r} in "
                                 f"{directory}")
+                        # strip setuid/setgid/sticky/world-write like
+                        # filter="data" does
+                        m.mode &= 0o755
                         if m.islnk() or m.issym():
                             tgt = m.linkname.replace("\\", "/")
                             base = (os.path.dirname(m.name)
@@ -161,13 +164,15 @@ def load_movielens(directory: str, filename: str = "ratings.dat"
     """MovieLens ratings (movielens.py read_data_sets role): parses the
     ml-1m `UserID::MovieID::Rating::Timestamp` format (also accepts
     comma-separated ml-latest CSV, skipping a header row if present) into
-    an int32 (N, 3) array of [user_id, movie_id, rating]."""
+    a float32 (N, 3) array of [user_id, movie_id, rating] — float so
+    ml-latest's half-star ratings survive (ids are exact in f32 up to
+    2^24, far beyond any MovieLens id)."""
     path = os.path.join(directory, filename)
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"{path} not found; place the MovieLens ratings file there "
             "(no downloads on a zero-egress host)")
-    rows: List[Tuple[int, int, int]] = []
+    rows: List[Tuple[float, float, float]] = []
     with open(path, "r", errors="replace") as f:
         for line in f:
             line = line.strip()
@@ -177,10 +182,10 @@ def load_movielens(directory: str, filename: str = "ratings.dat"
             if len(parts) < 3:
                 continue
             try:
-                rows.append((int(parts[0]), int(parts[1]),
-                             int(float(parts[2]))))
+                rows.append((float(int(parts[0])), float(int(parts[1])),
+                             float(parts[2])))
             except ValueError:
                 continue  # header row ("userId,movieId,...")
     if not rows:
         raise ValueError(f"no ratings parsed from {path}")
-    return np.asarray(rows, dtype=np.int32)
+    return np.asarray(rows, dtype=np.float32)

@@ -529,6 +529,13 @@ class TimeDistributedCriterion(Criterion):
             o, t = ot
             return acc + self.critrn.loss(o, t), None
 
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+        # carry dtype follows the inner loss (f64 under jax_enable_x64,
+        # custom criterions) — a pinned f32 carry would make scan reject
+        # the promoted acc + loss
+        loss_aval = jax.eval_shape(
+            self.critrn.loss,
+            jax.ShapeDtypeStruct(o_t.shape[1:], o_t.dtype),
+            jax.ShapeDtypeStruct(t_t.shape[1:], t_t.dtype))
+        total, _ = jax.lax.scan(body, jnp.zeros((), loss_aval.dtype),
                                 (o_t, t_t))
         return total / T if self.size_average else total

@@ -398,6 +398,12 @@ class LBFGS(OptimMethod):
             st["first"] = False
             t, f_new, g_new = _strong_wolfe(
                 lambda tt: fg(flat + tt * d), d, f, gtd, t0)
+            if f_new > f:
+                # line search failed to find ANY decrease (e.g. absurd lr on
+                # a narrow valley): taking the uphill probe would corrupt
+                # the curvature history — stop at the current point instead
+                losses.append(f)
+                break
             losses.append(f_new)
             s_new = t * d
             y_new = g_new - g

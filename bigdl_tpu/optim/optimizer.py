@@ -418,11 +418,18 @@ class Optimizer:
         model, optim = self.model, self.optim_method
         if model.params is None:
             model.build()
-        if getattr(self, "_initial_blob", None) is None:
+        if getattr(self, "_initial_blob", None) is None and \
+                self.checkpoint_path is not None and \
+                all(getattr(leaf, "is_fully_addressable", True)
+                    for leaf in jax.tree.leaves((model.params, model.state))):
             # host-side copy of the STARTING weights: a failure before the
             # first snapshot recovers to exactly these (the reference
             # retries from the initial model, not a re-roll of the RNG) —
-            # the crashed attempt's donated device buffers are unusable
+            # the crashed attempt's donated device buffers are unusable.
+            # Skipped when no checkpoint dir (the retry loop re-raises
+            # immediately, the copy could never be used) and for
+            # non-addressable multi-host shards (np.asarray would raise;
+            # recovery then falls back to a fresh init).
             self._initial_blob = (jax.tree.map(np.asarray, model.params),
                                   jax.tree.map(np.asarray, model.state))
 
@@ -547,6 +554,7 @@ class Optimizer:
         model.params = params
         model.state = net_state
         self._final_opt_state = opt_state
+        self._initial_blob = None  # release the host copy (run succeeded)
         return model
 
     # -- trigger hooks --------------------------------------------------

@@ -98,6 +98,12 @@ class TextClassifierUDF(UDFPredictor):
         super().__init__(model, preprocess=self._embed,
                          batch_size=batch_size)
 
+    def embed(self, text: str) -> np.ndarray:
+        """Public text -> embedded-feature preprocessing — the exact
+        transform the UDF applies at serving time, exposed so training
+        pipelines can share it (example/udfpredictor's Utils role)."""
+        return self._embed(text)
+
     def _embed(self, text: str) -> np.ndarray:
         toks = self.tokenizer(str(text))[:self.seq_len]
         idx = np.full((self.seq_len,), self.pad_index, np.int64)

@@ -70,7 +70,7 @@ def main(argv=None):
                             tokenizer=lambda s: s.split())
 
     def embed_text(t):
-        return udf._embed(t)  # same preprocessing for training and serving
+        return udf.embed(t)  # same preprocessing for training and serving
 
     samples = [Sample(embed_text(t), np.int32(l))
                for t, l in zip(texts, labels)]

@@ -62,9 +62,15 @@ def spawn_multihost_workers(worker_src: str, tmp_path, n: int = 2,
         env={**env_base, "BIGDL_TPU_PROCESS_ID": str(i)},
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in range(n)]
+    # drain pipes CONCURRENTLY: workers run distributed barriers, so a
+    # sequential communicate() deadlocks if a later worker fills its 64KB
+    # pipe while an earlier one waits in a collective
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        results = list(pool.map(
+            lambda p: (p, *p.communicate(timeout=timeout)), procs))
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
+    for p, out, err in results:
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         line = [l for l in out.splitlines() if l.startswith("{")][-1]
         outs.append(json.loads(line))

@@ -160,13 +160,13 @@ def test_movielens_provider(tmp_path):
     (tmp_path / "ratings.dat").write_text(
         "1::1193::5::978300760\n1::661::3::978302109\n2::1357::5::978298709\n")
     r = load_movielens(str(tmp_path))
-    assert r.shape == (3, 3) and r.dtype.name == "int32"
-    assert r[0].tolist() == [1, 1193, 5]
-    # ml-latest CSV with header
+    assert r.shape == (3, 3) and r.dtype.name == "float32"
+    assert r[0].tolist() == [1.0, 1193.0, 5.0]
+    # ml-latest CSV with header; half-star ratings must survive
     (tmp_path / "ratings.csv").write_text(
         "userId,movieId,rating,timestamp\n7,2,4.0,123\n8,3,3.5,456\n")
     r2 = load_movielens(str(tmp_path), "ratings.csv")
-    assert r2.tolist() == [[7, 2, 4], [8, 3, 3]]
+    assert r2.tolist() == [[7.0, 2.0, 4.0], [8.0, 3.0, 3.5]]
 
 
 def test_sorted_array_group_shuffle():

@@ -63,12 +63,23 @@ def test_retry_before_first_checkpoint_restores_initial_weights(tmp_path):
            .set_end_when(Trigger.max_epoch(1))
            # checkpoint trigger that never fires before the fault
            .set_checkpoint(str(tmp_path), Trigger.several_iteration(1000)))
+    # spy on recovery: the blob is released after a successful run, so
+    # capture what the recovery path actually restored from
+    captured = {}
+    orig_recover = opt._recover_from_checkpoint
+
+    def spy():
+        captured["blob"] = opt._initial_blob
+        orig_recover()
+
+    opt._recover_from_checkpoint = spy
     trained = opt.optimize()
     # completion proves recovery restored usable weights (device_put of the
     # donated originals would have raised); the captured blob must be the
     # USER's starting weights, not a re-rolled init
     assert trained.params is not None
-    for a, b in zip(jax.tree.leaves(opt._initial_blob[0]),
+    assert "blob" in captured and captured["blob"] is not None
+    for a, b in zip(jax.tree.leaves(captured["blob"][0]),
                     jax.tree.leaves(pretrained)):
         np.testing.assert_array_equal(a, b)
 
