@@ -128,9 +128,12 @@ def load_labeled_text_dir(directory: str,
                             raise ValueError(
                                 f"unsafe tar member {m.name!r} in "
                                 f"{directory}")
-                        # strip setuid/setgid/sticky/world-write like
-                        # filter="data" does
-                        m.mode &= 0o755
+                        # mode parity with filter="data": strip
+                        # setuid/setgid/sticky/world-write AND guarantee
+                        # owner access (files rw, dirs rwx) so extracted
+                        # trees stay readable
+                        m.mode = (m.mode & 0o755) | \
+                            (0o700 if m.isdir() else 0o600)
                         if m.islnk() or m.issym():
                             tgt = m.linkname.replace("\\", "/")
                             base = (os.path.dirname(m.name)

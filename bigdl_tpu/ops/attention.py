@@ -162,6 +162,40 @@ def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
     return out.reshape(B, H, Tqp, D)[:, :, :Tq, :]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Differentiable wrapper over the Pallas forward: pallas_call has no
+    autodiff rule, so training through the kernel needs an explicit VJP.
+    The backward recomputes attention via `mha_reference` and differentiates
+    THAT (the two forwards are parity-tested equal, so the cotangents are
+    consistent) — XLA generates the bwd instead of a hand-written kernel.
+
+    Memory note: this bwd materializes the dense [Tq, Tk] scores, so
+    TRAINING memory is quadratic in sequence length even though the
+    forward is blockwise.  For long-sequence training use ring_attention
+    (scan-based blockwise gradient); a blockwise bwd kernel is the future
+    upgrade path here."""
+    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+
+
+def _flash_diff_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_diff(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
+                                         sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
@@ -177,6 +211,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         use_pallas = jax.default_backend() == "tpu"
     if not use_pallas:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret)
+    return _flash_diff(q, k, v, causal, sm_scale, block_q, block_k,
+                       interpret)

@@ -398,10 +398,11 @@ class LBFGS(OptimMethod):
             st["first"] = False
             t, f_new, g_new = _strong_wolfe(
                 lambda tt: fg(flat + tt * d), d, f, gtd, t0)
-            if f_new > f:
-                # line search failed to find ANY decrease (e.g. absurd lr on
-                # a narrow valley): taking the uphill probe would corrupt
-                # the curvature history — stop at the current point instead
+            if not (f_new <= f):  # NaN-safe: catches uphill AND overflow
+                # line search failed to find ANY decrease (absurd lr on a
+                # narrow valley, or a divergent probe producing NaN):
+                # taking the probe would corrupt the curvature history —
+                # stop at the current point instead
                 losses.append(f)
                 break
             losses.append(f_new)
