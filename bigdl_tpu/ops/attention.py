@@ -166,15 +166,9 @@ def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
 def _flash_diff(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     """Differentiable wrapper over the Pallas forward: pallas_call has no
     autodiff rule, so training through the kernel needs an explicit VJP.
-    The backward recomputes attention via `mha_reference` and differentiates
-    THAT (the two forwards are parity-tested equal, so the cotangents are
-    consistent) — XLA generates the bwd instead of a hand-written kernel.
-
-    Memory note: this bwd materializes the dense [Tq, Tk] scores, so
-    TRAINING memory is quadratic in sequence length even though the
-    forward is blockwise.  For long-sequence training use ring_attention
-    (scan-based blockwise gradient); a blockwise bwd kernel is the future
-    upgrade path here."""
+    The backward is a blockwise recompute (`_flash_bwd_chunked`): a scan
+    over query blocks rebuilds each block's probabilities and accumulates
+    dQ/dK/dV, so BOTH directions stay linear-memory in sequence length."""
     return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
                          block_q=block_q, block_k=block_k,
                          interpret=interpret)
@@ -185,12 +179,68 @@ def _flash_diff_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out, (q, k, v)
 
 
+def _flash_bwd_chunked(q, k, v, g, *, causal: bool, sm_scale: float,
+                       block_q: int):
+    """Standard flash-attention backward, scanned over query blocks.
+
+    For each block (rows r0..r0+c) the dense-math identities
+        P  = softmax(S),  S = scale * Qc K^T  (+ causal mask)
+        dV += P^T dO;  dP = dO V^T;  dS = P * (dP - rowsum(dP .* P))
+        dQc = scale * dS K;  dK += scale * dS^T Qc
+    are evaluated with only a [c, Tk] score block live, carrying (dK, dV)
+    through the scan — memory O(block_q * Tk), not O(Tq * Tk)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    c = min(block_q, Tq)
+    pq = (-Tq) % c
+    if pq:  # pad query rows; their dO is zero so they contribute nothing
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    n_blocks = (Tq + pq) // c
+    qb = q.reshape(B, H, n_blocks, c, D)
+    gb = g.reshape(B, H, n_blocks, c, D)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    col = jnp.arange(Tk)
+
+    hi = jax.lax.Precision.HIGHEST  # match the forward: MXU default
+    # precision would silently degrade f32 gradients to ~bf16 accuracy
+
+    def body(carry, idx_qc_gc):
+        dk, dv = carry
+        blk, qc, gc = idx_qc_gc
+        qcf = qc.astype(jnp.float32)
+        gcf = gc.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qcf, kf, precision=hi) * sm_scale
+        if causal:
+            row = blk * c + jnp.arange(c)
+            s = jnp.where(row[:, None] >= col[None, :], s, -jnp.inf)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)  # fully-masked rows
+        p = jnp.exp(s - m)
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        p = p / jnp.where(denom == 0.0, 1.0, denom)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, gcf, precision=hi)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gcf, vf, precision=hi)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dqc = jnp.einsum("bhqk,bhkd->bhqd", ds, kf, precision=hi) * sm_scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qcf,
+                             precision=hi) * sm_scale
+        return (dk, dv), dqc
+
+    zeros = jnp.zeros((B, H, Tk, D), jnp.float32)
+    (dk, dv), dq_blocks = jax.lax.scan(
+        body, (zeros, zeros),
+        (jnp.arange(n_blocks),
+         jnp.moveaxis(qb, 2, 0), jnp.moveaxis(gb, 2, 0)))
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(B, H, Tq + pq, D)[:, :, :Tq]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _flash_diff_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
-                                         sm_scale=sm_scale), q, k, v)
-    return vjp(g)
+    return _flash_bwd_chunked(q, k, v, g, causal=causal, sm_scale=sm_scale,
+                              block_q=block_q)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

@@ -197,11 +197,9 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
                                   tiled=True)
 
     qh, kh, vh = fwd(q), fwd(k), fwd(v)
-    # flash attention keeps FORWARD memory linear in the gathered sequence
-    # length (Pallas kernel on TPU, blockwise jnp elsewhere); its backward
-    # currently recomputes densely (see ops/attention._flash_diff), so for
-    # very long TRAINING sequences prefer ring_attention, whose scan-based
-    # gradient stays blockwise
+    # flash attention keeps memory linear in the gathered sequence length
+    # in BOTH directions (blockwise pallas forward + scanned blockwise
+    # backward, ops/attention._flash_bwd_chunked)
     from ..ops.attention import flash_attention
     oh = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
     return rev(oh)

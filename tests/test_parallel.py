@@ -58,6 +58,31 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5, rtol=2e-5)
 
+    def test_grad_pallas_ragged_blocks_and_noncausal(self):
+        """Blockwise bwd edge cases: Tq not a multiple of block_q, and the
+        non-causal mask — both must match dense-reference gradients."""
+        r = np.random.default_rng(9)
+        q = jnp.asarray(r.normal(size=(2, 2, 21, 8)), jnp.float32)
+        k = jnp.asarray(r.normal(size=(2, 2, 21, 8)), jnp.float32)
+        v = jnp.asarray(r.normal(size=(2, 2, 21, 8)), jnp.float32)
+        for causal in (False, True):
+            def f_pallas(q, k, v):
+                out = flash_attention(q, k, v, causal=causal,
+                                      use_pallas=True, interpret=True,
+                                      block_q=8, block_k=8)
+                return jnp.sum(jnp.sin(out))
+
+            def f_ref(q, k, v):
+                return jnp.sum(jnp.sin(mha_reference(q, k, v,
+                                                     causal=causal)))
+
+            gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gp, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=3e-5, rtol=3e-5,
+                                           err_msg=f"causal={causal}")
+
     def test_fallback_path(self):
         q, k, v = _qkv(seed=2)
         out = flash_attention(q, k, v)  # auto: jnp path on CPU
