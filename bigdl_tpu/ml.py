@@ -164,16 +164,14 @@ class DLModel:
 
     def _forward_batch(self, xb: np.ndarray) -> np.ndarray:
         if self._fwd is None:
-            m = self.model
-
-            @jax.jit
-            def fwd(params, state, x):
-                out, _ = m.apply(params, state, x, training=False)
-                return out
-
-            self._fwd = fwd
-        return np.asarray(self._fwd(self.model.params, self.model.state,
-                                    np.asarray(xb, np.float32)))
+            # mesh-sharded SPMD inference, the same engine Evaluator and
+            # Predictor use — a bare jax.jit would run on ONE device while
+            # training used the whole mesh (the round-2 Evaluator gap)
+            from .optim.optimizer import _ShardedForward
+            self._fwd = _ShardedForward(self.model)
+        from .optim.optimizer import _trim
+        out, n = self._fwd(np.asarray(xb, np.float32))
+        return _trim(out, n)  # n = pre-pad row count; handles table outputs
 
     def _raw_outputs(self, X) -> np.ndarray:
         X = np.asarray(X, np.float32)

@@ -24,7 +24,12 @@ from ..nn import (CAddTable, ConcatTable, Dropout, GELU, Identity, LayerNorm,
                   Sequential)
 from ..nn.module import Module
 
-__all__ = ["TransformerLM", "TransformerBlock", "PositionalEmbedding"]
+__all__ = ["TransformerLM", "TransformerBlock", "PositionalEmbedding",
+           "greedy_generate"]
+
+import weakref
+
+_GENERATE_FWD_CACHE = weakref.WeakKeyDictionary()
 
 
 class PositionalEmbedding(Module):
@@ -91,3 +96,47 @@ def TransformerLM(vocab_size: int, max_len: int = 1024, d_model: int = 256,
     model.add(Linear(d_model, vocab_size))  # contracts the last axis of BTE
     model.add(LogSoftMax())
     return model
+
+
+def greedy_generate(model, prompt, num_tokens: int, max_len: int,
+                    pad_token: int = 0):
+    """Greedy decoding: extend `prompt` (list/array of ints, or [B, T0]
+    batch) by `num_tokens` via repeated argmax next-token prediction.
+
+    Serving-style utility (the udfpredictor analog for the LM): the jitted
+    forward runs once per generated token at the STATIC [B, max_len] shape
+    (right-padded), so there is exactly one compile; causal masking makes
+    the padding inert for positions < current length."""
+    import numpy as np
+
+    toks = np.asarray(prompt, np.int32)
+    if toks.ndim == 1:
+        toks = toks[None, :]
+    batch, t0 = toks.shape
+    if t0 == 0:
+        raise ValueError("empty prompt: need at least one token to condition"
+                         " the first prediction on")
+    if t0 + num_tokens > max_len:
+        raise ValueError(f"prompt ({t0}) + num_tokens ({num_tokens}) "
+                         f"exceeds max_len ({max_len})")
+    buf = np.full((batch, max_len), pad_token, np.int32)
+    buf[:, :t0] = toks
+
+    # jit cached PER MODEL so a serving loop compiles once, not per call;
+    # kept OUTSIDE the module (weak map) so Module.save stays picklable
+    fwd = _GENERATE_FWD_CACHE.get(model)
+    if fwd is None:
+        @jax.jit
+        def fwd(params, state, tokens):
+            out, _ = model.apply(params, state, tokens, training=False,
+                                 rng=None)
+            return out
+
+        _GENERATE_FWD_CACHE[model] = fwd
+
+    for i in range(t0, t0 + num_tokens):
+        logits = fwd(model.params, model.state, jnp.asarray(buf))
+        # slice on DEVICE: only the [B, vocab] row crosses to host
+        buf[:, i] = np.argmax(np.asarray(logits[:, i - 1]), axis=-1)
+    out = buf[:, : t0 + num_tokens]
+    return out[0] if np.asarray(prompt).ndim == 1 else out

@@ -86,6 +86,45 @@ def test_transformer_lm_trains_copy_task():
     assert (pred == tgt).mean() > 0.95
 
 
+def test_greedy_generate_reproduces_learned_cycle():
+    """Train on the +1-mod-vocab cycle, then greedy_generate must emit it;
+    also checks batch input and the one-compile static-shape contract."""
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.transformer_lm import greedy_generate
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    vocab, t = 10, 12
+    seqs = [[(s + i) % vocab for i in range(t + 1)] for s in range(vocab)] * 8
+    samples = [Sample(np.asarray(s[:-1], np.int32),
+                      np.asarray(s[1:], np.int32)) for s in seqs]
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(16, drop_last=True))
+    model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                          num_heads=4, num_layers=2)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    Optimizer(model, ds, crit).set_optim_method(Adam(3e-3)) \
+        .set_end_when(Trigger.max_epoch(12)).optimize()
+
+    out = greedy_generate(model, [4, 5, 6], num_tokens=6, max_len=t)
+    assert out.tolist() == [4, 5, 6, 7, 8, 9, 0, 1, 2]
+    outs = greedy_generate(model, [[1, 2], [7, 8]], num_tokens=4,
+                           max_len=t)
+    assert outs.tolist() == [[1, 2, 3, 4, 5, 6], [7, 8, 9, 0, 1, 2]]
+    with pytest.raises(ValueError):
+        greedy_generate(model, [0] * 10, num_tokens=5, max_len=t)
+    with pytest.raises(ValueError):
+        greedy_generate(model, [], num_tokens=2, max_len=t)
+    # the per-model jit cache must not break native save (pickling)
+    import os
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(), "lm.bin")
+    model.save(path)
+    assert nn.Module.load(path).params is not None
+
+
 def test_transformer_lm_seq_parallel_matches_dense():
     """Ring attention under shard_map over a 'seq' axis must reproduce the
     dense forward bit-for-tolerance."""
