@@ -76,8 +76,13 @@ def main(argv=None):
     err = float(np.abs(np.asarray(dense) - np.asarray(ring)).max())
     acc = float((np.argmax(np.asarray(dense), -1) ==
                  np.asarray([s[1:] for s in seqs[:4]])).mean())
+
+    # serving-style decoding with the public utility
+    from bigdl_tpu.models.transformer_lm import greedy_generate
+    seed = seqs[0][:3]
+    gen = greedy_generate(trained, seed, num_tokens=5, max_len=t)
     print(f"next-token acc={acc:.3f}; ring-vs-dense max|diff|={err:.2e} "
-          f"over {n_ring} devices")
+          f"over {n_ring} devices; generate({seed}) -> {gen.tolist()}")
     return acc, err
 
 
