@@ -99,9 +99,12 @@ def TransformerLM(vocab_size: int, max_len: int = 1024, d_model: int = 256,
 
 
 def greedy_generate(model, prompt, num_tokens: int, max_len: int,
-                    pad_token: int = 0):
-    """Greedy decoding: extend `prompt` (list/array of ints, or [B, T0]
-    batch) by `num_tokens` via repeated argmax next-token prediction.
+                    pad_token: int = 0, temperature: float = 0.0,
+                    top_k: int = 0, rng=None):
+    """Decode: extend `prompt` (list/array of ints, or [B, T0] batch) by
+    `num_tokens`.  temperature == 0 -> greedy argmax; temperature > 0 ->
+    sample from softmax(logits / temperature), optionally truncated to the
+    `top_k` most likely tokens (requires `rng`, a jax PRNG key).
 
     Serving-style utility (the udfpredictor analog for the LM): the jitted
     forward runs once per generated token at the STATIC [B, max_len] shape
@@ -134,9 +137,23 @@ def greedy_generate(model, prompt, num_tokens: int, max_len: int,
 
         _GENERATE_FWD_CACHE[model] = fwd
 
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs a jax PRNG key "
+                         "via rng=")
+
     for i in range(t0, t0 + num_tokens):
         logits = fwd(model.params, model.state, jnp.asarray(buf))
         # slice on DEVICE: only the [B, vocab] row crosses to host
-        buf[:, i] = np.argmax(np.asarray(logits[:, i - 1]), axis=-1)
+        row = np.asarray(logits[:, i - 1])
+        if temperature <= 0:
+            buf[:, i] = np.argmax(row, axis=-1)
+        else:
+            scaled = row / temperature
+            if top_k > 0 and top_k < scaled.shape[-1]:
+                kth = np.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = np.where(scaled >= kth, scaled, -np.inf)
+            rng, sub = jax.random.split(rng)
+            buf[:, i] = np.asarray(jax.random.categorical(
+                sub, jnp.asarray(scaled), axis=-1))
     out = buf[:, : t0 + num_tokens]
     return out[0] if np.asarray(prompt).ndim == 1 else out

@@ -117,6 +117,17 @@ def test_greedy_generate_reproduces_learned_cycle():
         greedy_generate(model, [0] * 10, num_tokens=5, max_len=t)
     with pytest.raises(ValueError):
         greedy_generate(model, [], num_tokens=2, max_len=t)
+    with pytest.raises(ValueError):
+        greedy_generate(model, [1], num_tokens=2, max_len=t,
+                        temperature=0.5)  # sampling without rng
+    # sampling with near-zero temperature on a confident model follows the
+    # learned cycle; top_k=1 is exactly greedy
+    s = greedy_generate(model, [4, 5], num_tokens=4, max_len=t,
+                        temperature=0.05, rng=jax.random.key(0))
+    assert s.tolist() == [4, 5, 6, 7, 8, 9]
+    s1 = greedy_generate(model, [4, 5], num_tokens=4, max_len=t,
+                         temperature=2.0, top_k=1, rng=jax.random.key(1))
+    assert s1.tolist() == [4, 5, 6, 7, 8, 9]
     # the per-model jit cache must not break native save (pickling)
     import os
     import tempfile
