@@ -150,8 +150,14 @@ def greedy_generate(model, prompt, num_tokens: int, max_len: int,
         else:
             scaled = row / temperature
             if top_k > 0 and top_k < scaled.shape[-1]:
-                kth = np.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = np.where(scaled >= kth, scaled, -np.inf)
+                # EXACTLY k survivors (rank-based, O(V) argpartition) —
+                # a >=threshold mask would keep every kth-value tie, so
+                # top_k=1 would not reduce to greedy under ties
+                keep = np.argpartition(scaled, -top_k, axis=-1)[:, -top_k:]
+                masked = np.full_like(scaled, -np.inf)
+                np.put_along_axis(masked, keep,
+                                  np.take_along_axis(scaled, keep, -1), -1)
+                scaled = masked
             rng, sub = jax.random.split(rng)
             buf[:, i] = np.asarray(jax.random.categorical(
                 sub, jnp.asarray(scaled), axis=-1))

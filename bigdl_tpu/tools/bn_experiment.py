@@ -1,0 +1,110 @@
+"""BatchNorm stat-computation experiment for the ResNet-50 MFU push.
+
+Measured round 3 (real v5e chip, batch 256 bf16): fwd-eval hits 0.61 MFU
+and eval-mode grad 0.45, but training-mode BN batch-stats machinery costs
+~27ms of the 108ms step, capping train MFU at ~0.34 vs the 0.45 target
+(BASELINE.md).  This tool times stat-computation variants through the whole
+resnet50 grad so the winner can be promoted into nn/normalization.py with
+evidence.  Run ON A REAL TPU (the tunnel was down for the second half of
+round 3, so the variants were never measured):
+
+    python -m bigdl_tpu.tools.bn_experiment [baseline dtype_arg]
+
+Variants:
+  baseline  — astype(f32) then two fused reductions (current nn code)
+  dtype_arg — jnp.mean(..., dtype=f32) accumulation without the explicit
+              upcast (tests whether XLA materializes the f32 copy)
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PEAK = 197e12  # v5e table peak; see utils/timing.measure_roofline
+BATCH = 256
+
+
+def _variant_apply(kind):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            if kind == "baseline":
+                xf = x.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+            else:  # dtype_arg
+                mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+                var = (jnp.mean(jnp.square(x.astype(jnp.float32)),
+                                axis=axes) - jnp.square(mean))
+            m = self.momentum
+            n = 1
+            for ax in axes:
+                n *= x.shape[ax]
+            unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = params["weight"] * inv
+            shift = params["bias"] - mean * scale
+        else:
+            scale, shift = inv, -mean * inv
+        y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+        return y, new_state
+
+    return apply
+
+
+def bench_variant(kind: str) -> None:
+    from ..common import DTypePolicy, set_policy
+    from ..nn import CrossEntropyCriterion
+    from ..nn.normalization import BatchNormalization
+    from ..utils.flops import jaxpr_flops
+    from ..utils.timing import measure_step_seconds
+
+    BatchNormalization.apply = _variant_apply(kind)
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    from ..models.resnet import ResNet
+    model = ResNet(50, class_num=1000,
+                   dataset="imagenet").build(jax.random.key(0))
+    crit = CrossEntropyCriterion()
+    x = jnp.zeros((BATCH, 224, 224, 3), jnp.float32)
+    y = jnp.ones((BATCH,), jnp.int32)
+
+    def loss(p):
+        out, _ = model.apply(p, model.state, x, training=True,
+                             rng=jax.random.key(2))
+        return crit.forward(out, y)
+
+    def g(p):
+        gr = jax.grad(loss)(p)
+        return sum(jnp.sum(l.astype(jnp.float32))
+                   for l in jax.tree.leaves(gr))
+
+    flops = jaxpr_flops(jax.make_jaxpr(g)(model.params))
+    compiled = jax.jit(g).lower(model.params).compile()
+    compiled(model.params)
+    dt, _ = measure_step_seconds(lambda: compiled(model.params))
+    print(f"bn[{kind:9s}] dt={dt * 1e3:8.2f}ms "
+          f"mfu={flops / dt / PEAK:.4f}", flush=True)
+
+
+def main(argv=None):
+    for kind in (argv or sys.argv[1:]) or ["baseline", "dtype_arg"]:
+        try:
+            bench_variant(kind)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            print(f"bn[{kind}] FAILED {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
