@@ -20,6 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..common import conv_accum_dtype, get_policy
 from .initialization import default_bias_init, default_weight_init
@@ -91,7 +92,10 @@ class SpatialConvolution(Module):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.n_group,
             preferred_element_type=conv_accum_dtype())
-        return y.astype(c)
+        # named so selective rematerialization (Optimizer.set_remat("conv_out"))
+        # can save exactly the MXU outputs and recompute the cheap elementwise
+        # tail (BN/ReLU/add) in the backward pass; a no-op otherwise
+        return checkpoint_name(y.astype(c), "conv_out")
 
     def _apply(self, params, x):
         y = self._conv(x, params["weight"])

@@ -129,6 +129,7 @@ class Optimizer:
         self.validation_summary = None
         self.grad_clip_norm = None
         self.grad_clip_const = None
+        self.remat_policy = None
         self.log_interval = 1
         self.metrics = Metrics()
         self._compiled = None
@@ -193,6 +194,20 @@ class Optimizer:
 
     def set_strategy(self, strategy: ShardingStrategy):
         self.strategy = strategy
+        return self
+
+    def set_remat(self, policy):
+        """Rematerialization for the compiled step (net-new vs the reference,
+        which has no activation-memory pressure on JVM heaps; on TPU this is
+        the HBM lever, SURVEY §7 hard-part (f)).
+
+        policy: None (save everything), "full" (jax.checkpoint with no
+        policy — recompute everything in backward), "conv_out" (save only
+        MXU conv outputs, recompute the elementwise tail — see
+        nn/conv.SpatialConvolution._conv), or any jax.checkpoint_policies
+        callable.
+        """
+        self.remat_policy = policy
         return self
 
     def set_drop_module_property(self, drop_percentage: float,
@@ -275,10 +290,22 @@ class Optimizer:
         clip_norm, clip_const = self.grad_clip_norm, self.grad_clip_const
         from .regularizer import apply_regularizer_grads
 
+        remat = self.remat_policy
+
         def step(params, net_state, opt_state, inp, tgt, lr, rng):
             def loss_fn(p):
                 out, ns = model.apply(p, net_state, inp, training=True, rng=rng)
                 return criterion.loss(out, tgt), ns
+
+            if remat == "full":
+                loss_fn = jax.checkpoint(loss_fn)
+            elif remat == "conv_out":
+                loss_fn = jax.checkpoint(
+                    loss_fn,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "conv_out"))
+            elif callable(remat):
+                loss_fn = jax.checkpoint(loss_fn, policy=remat)
 
             (loss, new_net_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)

@@ -11,9 +11,16 @@ round 3, so the variants were never measured):
     python -m bigdl_tpu.tools.bn_experiment [baseline dtype_arg]
 
 Variants:
-  baseline  — astype(f32) then two fused reductions (current nn code)
-  dtype_arg — jnp.mean(..., dtype=f32) accumulation without the explicit
-              upcast (tests whether XLA materializes the f32 copy)
+  baseline   — astype(f32) then two fused reductions (current nn code)
+  dtype_arg  — jnp.mean(..., dtype=f32) accumulation without the explicit
+               upcast (tests whether XLA materializes the f32 copy)
+  custom_vjp — hand-written fused BN backward (2 read passes + 1 write:
+               the canonical dx = scale*(dy - mean(dy) - xhat*mean(dy*xhat))
+               formula) instead of autodiff through the stat graph
+  remat_conv — baseline BN + selective rematerialization: save only conv
+               outputs + BN stats across fwd/bwd, recompute all elementwise
+               (BN normalize, ReLU, adds) in the backward pass — trades
+               cheap recompute FLOPs for HBM writes of BN/ReLU activations
 """
 
 from __future__ import annotations
@@ -28,7 +35,19 @@ PEAK = 197e12  # v5e table peak; see utils/timing.measure_roofline
 BATCH = 256
 
 
+_PRISTINE_APPLY = None  # BatchNormalization.apply before any variant patch
+
+
 def _variant_apply(kind):
+    import os
+
+    if kind == "custom_vjp":
+        # the library implementation behind BIGDL_TPU_BN_FUSED_VJP
+        # (nn/normalization._fused_bn_train) — benchmark THAT, not a copy
+        os.environ["BIGDL_TPU_BN_FUSED_VJP"] = "1"
+        return _PRISTINE_APPLY
+    os.environ.pop("BIGDL_TPU_BN_FUSED_VJP", None)
+
     def apply(self, params, state, x, *, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if training:
@@ -65,13 +84,20 @@ def _variant_apply(kind):
 
 
 def bench_variant(kind: str) -> None:
+    global _PRISTINE_APPLY
     from ..common import DTypePolicy, set_policy
     from ..nn import CrossEntropyCriterion
     from ..nn.normalization import BatchNormalization
     from ..utils.flops import jaxpr_flops
     from ..utils.timing import measure_step_seconds
 
-    BatchNormalization.apply = _variant_apply(kind)
+    if _PRISTINE_APPLY is None:
+        _PRISTINE_APPLY = BatchNormalization.apply
+    # conv outputs are checkpoint_name-tagged by nn/conv itself, so the
+    # remat variant only needs the jax.checkpoint policy below
+    remat = kind == "remat_conv"
+    BatchNormalization.apply = _variant_apply(
+        "baseline" if remat else kind)
     set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
     from ..models.resnet import ResNet
     model = ResNet(50, class_num=1000,
@@ -84,6 +110,11 @@ def bench_variant(kind: str) -> None:
         out, _ = model.apply(p, model.state, x, training=True,
                              rng=jax.random.key(2))
         return crit.forward(out, y)
+
+    if remat:
+        loss = jax.checkpoint(
+            loss, policy=jax.checkpoint_policies.save_only_these_names(
+                "conv_out"))
 
     def g(p):
         gr = jax.grad(loss)(p)
@@ -99,7 +130,8 @@ def bench_variant(kind: str) -> None:
 
 
 def main(argv=None):
-    for kind in (argv or sys.argv[1:]) or ["baseline", "dtype_arg"]:
+    for kind in (argv or sys.argv[1:]) or ["baseline", "dtype_arg",
+                                           "custom_vjp", "remat_conv"]:
         try:
             bench_variant(kind)
         except Exception as e:  # noqa: BLE001 — report and continue

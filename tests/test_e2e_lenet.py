@@ -71,6 +71,17 @@ def test_lenet_sharded_data_parallel():
     assert np.isfinite(opt.optim_method.hyper["loss"])
 
 
+def test_lenet_remat_conv_out():
+    """set_remat('conv_out') saves only MXU conv outputs across fwd/bwd
+    (nn/conv tags them with checkpoint_name); training must still converge
+    identically in expectation — the policy changes the schedule, not math."""
+    Engine.init()
+    model, opt = make_optimizer()
+    opt.set_remat("conv_out")
+    opt.optimize()
+    assert opt.optim_method.hyper["loss"] < 1.0
+
+
 def test_checkpoint_and_resume(tmp_path):
     Engine.init()
     model, opt = make_optimizer(samples=synthetic_mnist(128))

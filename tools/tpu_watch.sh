@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-3 TPU-tunnel watcher: the axon tunnel to the single v5e chip is flaky
+# (outages 05:20-15:02 UTC and again from ~15:07).  Poll with a cheap matmul
+# probe; when the tunnel answers, run whatever command was passed, then exit.
+#   tools/tpu_watch.sh <logfile> <cmd...>
+LOG="$1"; shift
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+print(float((x @ x).sum()))" >/dev/null 2>&1; then
+    echo "[tpu_watch] tunnel up at $(date -u +%H:%M:%S) — running: $*" >> "$LOG"
+    "$@" >> "$LOG" 2>&1
+    echo "[tpu_watch] done rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  echo "[tpu_watch] tunnel down at $(date -u +%H:%M:%S)" >> "$LOG"
+  sleep 240
+done
