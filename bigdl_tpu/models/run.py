@@ -27,7 +27,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
-def _build_model(name: str, class_num: int):
+def _build_model(name: str, class_num: int, num_experts: int = 0):
     """-> (model, input_hw, criterion_name).  Models ending in LogSoftMax
     (like the reference zoo) pair with ClassNLL; logits models with
     CrossEntropy (see models/resnet Train.scala pairing note)."""
@@ -72,7 +72,8 @@ def _build_model(name: str, class_num: int):
         vocab = max(class_num, 64)
         seq = 128
         return (TransformerLM(vocab_size=vocab, max_len=seq, d_model=256,
-                              num_heads=8, num_layers=4),
+                              num_heads=8, num_layers=4,
+                              num_experts=num_experts),
                 ("tokens", seq, vocab), "lm")
     raise ValueError(f"unknown model {name!r}")
 
@@ -146,7 +147,8 @@ def train(args) -> None:
     from ..visualization import TrainSummary, ValidationSummary
 
     Engine.init()
-    model, input_hw, crit = _build_model(args.model, args.class_num)
+    model, input_hw, crit = _build_model(args.model, args.class_num,
+                                         getattr(args, "num_experts", 0))
     samples = (_synthetic(input_hw, args.class_num) if args.synthetic
                else _load_samples(args.data, input_hw))
     if crit == "mse":  # autoencoder: reconstruct the input
@@ -222,6 +224,12 @@ def main(argv=None):
         if cmd == "train":
             # scopt-option parity with the reference Train CLIs
             # (models/lenet/Utils.scala, models/inception/Options.scala)
+            p.add_argument("--num-experts", type=int, default=0,
+                           help="transformer only: Switch-style MoE FFN "
+                                "with this many experts "
+                                "(parallel/expert.MoEFFN); test mode "
+                                "rebuilds the model from the snapshot, so "
+                                "the flag lives on train only")
             p.add_argument("--max-epoch", type=int, default=5)
             p.add_argument("--max-iteration", type=int, default=0,
                            help="also stop after N iterations (-i)")

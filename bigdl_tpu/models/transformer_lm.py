@@ -61,17 +61,29 @@ def _residual(branch: Module) -> Sequential:
 
 def TransformerBlock(d_model: int, num_heads: int, mlp_ratio: int = 4,
                      dropout: float = 0.0, causal: bool = True,
-                     seq_parallel: bool = False) -> Sequential:
-    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+                     seq_parallel: bool = False, num_experts: int = 0,
+                     expert_k: int = 1, expert_axis=None) -> Sequential:
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+    num_experts > 0 swaps the dense MLP for a capacity-routed MoE FFN
+    (parallel/expert.MoEFFN, Switch-Transformer style); expert_axis names
+    the mesh axis for expert parallelism under jit/GSPMD."""
     attn = (Sequential()
             .add(LayerNorm(d_model))
             .add(MultiHeadAttention(d_model, num_heads, causal=causal,
                                     seq_parallel=seq_parallel)))
-    mlp = (Sequential()
-           .add(LayerNorm(d_model))
-           .add(Linear(d_model, mlp_ratio * d_model))
-           .add(GELU())
-           .add(Linear(mlp_ratio * d_model, d_model)))
+    if num_experts:
+        from ..parallel.expert import MoEFFN
+        mlp = (Sequential()
+               .add(LayerNorm(d_model))
+               .add(MoEFFN(d_model, mlp_ratio * d_model, num_experts,
+                           k=expert_k, expert_axis=expert_axis)))
+    else:
+        mlp = (Sequential()
+               .add(LayerNorm(d_model))
+               .add(Linear(d_model, mlp_ratio * d_model))
+               .add(GELU())
+               .add(Linear(mlp_ratio * d_model, d_model)))
     if dropout > 0:
         attn.add(Dropout(dropout))
         mlp.add(Dropout(dropout))
@@ -82,16 +94,21 @@ def TransformerLM(vocab_size: int, max_len: int = 1024, d_model: int = 256,
                   num_heads: int = 8, num_layers: int = 4,
                   mlp_ratio: int = 4, dropout: float = 0.0,
                   causal: bool = True,
-                  seq_parallel: bool = False) -> Sequential:
+                  seq_parallel: bool = False, num_experts: int = 0,
+                  expert_k: int = 1, expert_axis=None) -> Sequential:
     """tokens [B, T] int -> log-probs [B, T, vocab]; pairs with
-    TimeDistributedCriterion(ClassNLLCriterion) like the PTB LSTM."""
+    TimeDistributedCriterion(ClassNLLCriterion) like the PTB LSTM.
+    num_experts > 0 builds the Switch-style MoE variant (EP workload)."""
     model = (Sequential()
              .add(LookupTable(vocab_size, d_model))
              .add(PositionalEmbedding(max_len, d_model)))
     for _ in range(num_layers):
         model.add(TransformerBlock(d_model, num_heads, mlp_ratio=mlp_ratio,
                                    dropout=dropout, causal=causal,
-                                   seq_parallel=seq_parallel))
+                                   seq_parallel=seq_parallel,
+                                   num_experts=num_experts,
+                                   expert_k=expert_k,
+                                   expert_axis=expert_axis))
     model.add(LayerNorm(d_model))
     model.add(Linear(d_model, vocab_size))  # contracts the last axis of BTE
     model.add(LogSoftMax())

@@ -292,10 +292,25 @@ class Optimizer:
 
         remat = self.remat_policy
 
+        def collect_aux_losses(ns):
+            """Sum `aux_loss` entries threaded through the state pytree
+            (e.g. the MoE load-balancing loss, parallel/expert.MoEFFN)."""
+            total = 0.0
+            if isinstance(ns, dict):
+                for k, v in ns.items():
+                    if k == "aux_loss":
+                        total = total + v
+                    else:
+                        total = total + collect_aux_losses(v)
+            elif isinstance(ns, (list, tuple)):
+                for v in ns:
+                    total = total + collect_aux_losses(v)
+            return total
+
         def step(params, net_state, opt_state, inp, tgt, lr, rng):
             def loss_fn(p):
                 out, ns = model.apply(p, net_state, inp, training=True, rng=rng)
-                return criterion.loss(out, tgt), ns
+                return criterion.loss(out, tgt) + collect_aux_losses(ns), ns
 
             if remat == "full":
                 loss_fn = jax.checkpoint(loss_fn)

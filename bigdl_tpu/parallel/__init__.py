@@ -6,13 +6,17 @@ manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7):
 - ring_attention: sequence/context parallelism (shard_map + ppermute ring)
 - ulysses_attention: all-to-all sequence parallelism
 - pipeline: GPipe-style microbatched stage parallelism
+- expert: capacity-routed MoE over the `expert` axis (GSPMD + shard_map)
 """
 
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
                        TensorParallel)
 from .ring_attention import ring_attention, ulysses_attention
 from .pipeline import pipeline_apply, stack_stage_params
+from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
+                     load_balancing_loss)
 
 __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
            "TensorParallel", "ring_attention", "ulysses_attention",
-           "pipeline_apply", "stack_stage_params"]
+           "pipeline_apply", "stack_stage_params", "MoEFFN",
+           "expert_parallel_ffn", "top_k_routing", "load_balancing_loss"]

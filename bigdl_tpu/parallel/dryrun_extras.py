@@ -92,3 +92,15 @@ def run(mesh: Mesh) -> None:
         _, _, _, lm_loss = step(params, lm.state, opt_state, tok, tgt,
                                 jnp.float32(0.01), jax.random.key(4))
         assert np.isfinite(float(lm_loss)), f"LM dryrun loss: {lm_loss}"
+
+    # --- expert parallelism over an 'expert' axis -------------------------
+    from .expert import MoEFFN, expert_parallel_ffn
+
+    ep_mesh = Mesh(devices.reshape(n), ("expert",))
+    moe = MoEFFN(d_model=16, d_hidden=32, num_experts=2 * n,
+                 capacity_factor=8.0).build(jax.random.key(5)).evaluate()
+    xt = jax.random.normal(jax.random.key(6), (8 * n, 16))
+    y_dense = moe.forward(xt)
+    y_ep = expert_parallel_ffn(ep_mesh, moe.params, xt, capacity_factor=8.0)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               atol=2e-5, rtol=2e-4)

@@ -1,0 +1,265 @@
+"""Expert parallelism (EP): capacity-routed mixture-of-experts over the
+`expert` mesh axis.
+
+Net-new vs the reference (SURVEY.md §2.5: "TP / PP / SP / EP / CP ...
+ABSENT"); the reference's only MoE-shaped construct is the dense
+MixtureTable blend (nn/MixtureTable.scala — ours in nn/table_ops.py).
+This module adds the real thing, TPU-first, in the GShard/Switch style:
+
+- top-k softmax gating with a fixed per-expert token capacity (static
+  shapes — XLA requirement; overflow tokens are dropped by the dispatch
+  mask exactly as in Switch/GShard),
+- dispatch/combine as einsums against a one-hot [tokens, experts,
+  capacity] mask (differentiable w.r.t. the gate through the combine
+  weights; the routing itself is piecewise-constant),
+- two integration styles:
+  * `MoEFFN` — a Module whose math is dense einsum over all experts with
+    `with_sharding_constraint` hints on the expert-major buffers, so under
+    jit/GSPMD on a mesh with an `expert` axis XLA shards the expert
+    matmuls and inserts the all-to-alls itself (composes with the
+    Optimizer's compiled step like any other layer);
+  * `expert_parallel_ffn` — an explicit shard_map implementation with
+    `lax.all_to_all` dispatch→compute→combine, for when the collective
+    schedule must be pinned (and as the parity oracle for the GSPMD path).
+
+The Switch load-balancing auxiliary loss (num_experts * sum(fraction_e *
+mean_prob_e)) is exposed via `load_balancing_loss`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..common import get_policy
+from ..nn.module import Module
+
+__all__ = ["MoEFFN", "expert_parallel_ffn", "top_k_routing",
+           "load_balancing_loss"]
+
+
+def top_k_routing(gate_logits, capacity: int, k: int = 1):
+    """Top-k capacity routing (GShard/Switch).
+
+    gate_logits: [T, E].  Returns (combine, dispatch, probs, assign):
+      combine  [T, E, C] float — gate prob at the token's buffer slot,
+      dispatch [T, E, C] bool-as-float one-hot routing mask,
+      probs    [T, E] full softmax (for the aux loss),
+      assign   [T, E] PRE-capacity router choices (one-hot sum over the k
+               rounds) — the Switch paper's f_e uses these, NOT the
+               post-drop dispatch: during heavy overflow the dispatched
+               fraction saturates at C/T, which would weaken the
+               anti-collapse gradient exactly when collapse is worst.
+    Tokens beyond an expert's capacity C are dropped (mask row = 0) in
+    priority order of their position in the batch, as in the references.
+    """
+    T, E = gate_logits.shape
+    if k > E:
+        raise ValueError(f"top-k routing with k={k} > num_experts={E}")
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    assign = jnp.zeros((T, E), jnp.float32)
+    # claimed[e] tracks how many tokens already routed to expert e by
+    # higher-priority choices (earlier k, earlier token)
+    claimed = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                       # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)      # [T, E]
+        # position of each token within its chosen expert's buffer
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot)        # [T, E]
+        pos = jnp.sum(pos_in_e * onehot, axis=-1).astype(jnp.int32) + \
+            jnp.take(claimed, idx)                              # [T]
+        keep = pos < capacity
+        slot = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
+                              dtype=jnp.float32)                # [T, C]
+        route = onehot[:, :, None] * slot[:, None, :]           # [T, E, C]
+        gate_p = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [T, 1]
+        dispatch = dispatch + route
+        combine = combine + route * gate_p[:, :, None]
+        assign = assign + onehot
+        claimed = claimed + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)  # exclude already-chosen experts
+    return combine, dispatch, probs, assign
+
+
+def load_balancing_loss(probs, assign):
+    """Switch aux loss: E * sum_e(fraction_routed_e * mean_prob_e), with
+    the fraction taken from the PRE-capacity router choices (`assign`,
+    [T, E]) per the paper's f_e definition."""
+    E = probs.shape[-1]
+    frac = jnp.mean(assign, axis=0)                       # [E]
+    mean_p = jnp.mean(probs, axis=0)                      # [E]
+    return E * jnp.sum(frac * mean_p)
+
+
+def _expert_ffn(x, w1, b1, w2, b2):
+    """Per-expert two-layer FFN on expert-major buffers [E, C, D]."""
+    h = jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :]
+    h = jax.nn.relu(h)
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+class MoEFFN(Module):
+    """Mixture-of-experts FFN block: gate → top-k capacity routing →
+    per-expert 2-layer ReLU FFN → combine.
+
+    GSPMD integration: on a mesh with an `expert` axis, pass
+    `expert_axis="expert"` — the expert-major dispatch buffers and the
+    stacked expert weights get `with_sharding_constraint(P(axis))` hints
+    and XLA lowers the expert matmuls sharded with all-to-all routing.
+    Off-mesh (tests, single chip) the same math runs dense.
+
+    capacity_factor: C = ceil(k * T / E * capacity_factor).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 k: int = 1, capacity_factor: float = 1.25,
+                 expert_axis: Optional[str] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.expert_axis = expert_axis
+        self.aux_loss_weight = 0.01
+        self.router_jitter = 0.01  # Switch-Transformer jitter epsilon
+
+    def _init(self, rng):
+        dt = get_policy().param_dtype
+        kg, k1, k2 = jax.random.split(rng, 3)
+        E, D, H = self.num_experts, self.d_model, self.d_hidden
+        s1 = (2.0 / D) ** 0.5
+        s2 = (2.0 / H) ** 0.5
+        return {
+            # near-uniform initial routing (Switch-Transformer practice):
+            # a confident random router at init collapses tokens onto wrong
+            # experts and training becomes strongly init-dependent
+            "gate": jax.random.normal(kg, (D, E), dt) * 0.02,
+            "w1": jax.random.normal(k1, (E, D, H), dt) * s1,
+            "b1": jnp.zeros((E, H), dt),
+            "w2": jax.random.normal(k2, (E, H, D), dt) * s2,
+            "b2": jnp.zeros((E, D), dt),
+        }
+
+    def _init_state(self):
+        # aux_loss rides the functional state pytree so the Optimizer can
+        # add it to the criterion inside the same jit trace (see
+        # Optimizer._build_step's collect_aux_losses)
+        return {"aux_loss": jnp.float32(0.0)}
+
+    def _capacity(self, T):
+        import math
+        return max(1, math.ceil(self.k * T / self.num_experts
+                                * self.capacity_factor))
+
+    def _constrain(self, v):
+        if self.expert_axis is None:
+            return v
+        try:
+            spec = P(self.expert_axis)
+            return lax.with_sharding_constraint(v, spec)
+        except (ValueError, RuntimeError) as e:
+            # acceptable only when there is genuinely no mesh in scope
+            # (single-chip/test runs); a present-but-mismatched mesh must
+            # not silently degrade to replicated experts
+            if not type(self)._warned_no_mesh:
+                type(self)._warned_no_mesh = True
+                import logging
+                logging.getLogger("bigdl_tpu").warning(
+                    "MoEFFN(expert_axis=%r): sharding constraint not "
+                    "applied (%s); running with replicated experts — if a "
+                    "mesh is active, check the axis name", self.expert_axis,
+                    e)
+            return v
+
+    _warned_no_mesh = False
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        c = get_policy().compute_dtype
+        shape = x.shape
+        D = shape[-1]
+        xt = x.reshape((-1, D)).astype(c)                       # [T, D]
+        T = xt.shape[0]
+        gate_in = xt.astype(jnp.float32)
+        if training and rng is not None and self.router_jitter > 0:
+            # Switch-style input jitter: multiplicative uniform noise on the
+            # router input only — exploration + tie-breaking near the
+            # uniform init, inert at eval
+            e = self.router_jitter
+            gate_in = gate_in * jax.random.uniform(
+                rng, gate_in.shape, jnp.float32, 1.0 - e, 1.0 + e)
+        logits = gate_in @ params["gate"].astype(jnp.float32)
+        combine, dispatch, probs, assign = top_k_routing(
+            logits, self._capacity(T), self.k)
+        # expert-major buffers: sharding over the expert axis makes GSPMD
+        # place each expert's tokens+weights on its own devices
+        buf = jnp.einsum("tec,td->ecd", dispatch.astype(c), xt)
+        buf = self._constrain(buf)
+        out = _expert_ffn(buf,
+                          self._constrain(params["w1"]).astype(c),
+                          self._constrain(params["b1"]).astype(c),
+                          self._constrain(params["w2"]).astype(c),
+                          self._constrain(params["b2"]).astype(c))
+        y = jnp.einsum("tec,ecd->td", combine.astype(c), out)
+        aux = (self.aux_loss_weight
+               * load_balancing_loss(probs, assign)) if training \
+            else state["aux_loss"]
+        return y.reshape(shape), {"aux_loss": aux}
+
+
+def expert_parallel_ffn(mesh, params, x, *, k: int = 1,
+                        capacity_factor: float = 1.25,
+                        axis: str = "expert"):
+    """Explicit-collective EP: tokens sharded over `axis`, experts sharded
+    over `axis`; dispatch and combine cross the mesh via lax.all_to_all.
+
+    params: MoEFFN-style dict (gate [D,E], w1 [E,D,H], b1, w2, b2).
+    x: [T, D] global tokens, T divisible by the axis size.
+    Returns [T, D], numerically matching the dense MoEFFN math whenever no
+    token overflows capacity (the parity tests assert this).
+    """
+    import math
+
+    n = mesh.shape[axis]
+    E = params["w1"].shape[0]
+    assert E % n == 0, f"num_experts {E} not divisible by mesh axis {n}"
+    T = x.shape[0]
+    # LOCAL capacity per expert per source shard, so all_to_all blocks are
+    # uniform; global per-expert capacity = cap * n
+    cap = max(1, math.ceil(k * (T // n) / E * capacity_factor))
+
+    def local(px, pw):  # px: [T_l, D]; pw: expert-sharded params
+        gate, w1, b1, w2, b2 = pw
+        from .ring_attention import _pvary
+        gate = _pvary(gate, (axis,))  # replicated → device-varying
+        logits = px.astype(jnp.float32) @ gate.astype(jnp.float32)
+        combine, dispatch, _, _ = top_k_routing(logits, cap, k)
+        buf = jnp.einsum("tec,td->ecd", dispatch.astype(px.dtype), px)
+        # [E, cap, D] → exchange so each device holds its E/n experts'
+        # tokens from every source shard: [E/n, n*cap, D]
+        buf = lax.all_to_all(buf, axis, split_axis=0, concat_axis=1,
+                             tiled=True)
+        out = _expert_ffn(buf, w1, b1, w2, b2)
+        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                             tiled=True)                     # [E, cap, D]
+        return jnp.einsum("tec,ecd->td", combine.astype(px.dtype), out)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), (P(), P(axis), P(axis), P(axis), P(axis))),
+        out_specs=P(axis))
+    pw = (params["gate"], params["w1"], params["b1"], params["w2"],
+          params["b2"])
+    return fn(x, pw)

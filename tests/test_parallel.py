@@ -340,3 +340,92 @@ class TestOptStateSharding:
         sh2 = ShardingStrategy().opt_state_sharding(mesh, state2, params, p_sh)
         assert sh2["m"]["a"].spec == P("data", None)
         assert sh2["m"]["b"].spec == P(None, "data")
+
+
+class TestExpertParallel:
+    """EP: capacity-routed MoE (parallel/expert.py) — dense GSPMD module vs
+    explicit shard_map all-to-all implementation."""
+
+    def _model(self, E=8, D=16, H=32, k=1, cf=4.0, axis=None):
+        from bigdl_tpu.parallel import MoEFFN
+        return MoEFFN(D, H, E, k=k, capacity_factor=cf,
+                      expert_axis=axis).build(jax.random.key(0))
+
+    def test_dense_routing_matches_manual(self):
+        """With ample capacity and k=1, MoE output == gate-prob-weighted
+        output of each token's argmax expert."""
+        m = self._model().evaluate()  # eval: no router jitter
+        x = jax.random.normal(jax.random.key(1), (32, 16))
+        y = m.forward(x)
+        p = m.params
+        logits = x @ p["gate"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(logits, axis=-1)
+        h = jnp.maximum(jnp.einsum("td,edh->teh", x, p["w1"])
+                        + p["b1"][None], 0.0)
+        out_e = jnp.einsum("teh,ehd->ted", h, p["w2"]) + p["b2"][None]
+        expect = (jnp.take_along_axis(
+            out_e, idx[:, None, None].repeat(16, -1), 1)[:, 0]
+            * jnp.take_along_axis(probs, idx[:, None], 1))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_top2_and_capacity_drop(self):
+        """k=2 routes each token to two experts; capacity 1 forces drops —
+        dispatch mask never exceeds capacity."""
+        from bigdl_tpu.parallel import top_k_routing
+        logits = jax.random.normal(jax.random.key(2), (16, 4))
+        combine, dispatch, probs, assign = top_k_routing(logits,
+                                                         capacity=2, k=2)
+        # pre-capacity assignment counts every router choice, dropped or not
+        assert float(jnp.sum(assign)) == 32.0  # 16 tokens x k=2
+        # per-token: at most 2 slots
+        assert float(jnp.max(jnp.sum(dispatch, axis=(1, 2)))) <= 2.0
+        # per-expert: never more tokens than capacity
+        assert float(jnp.max(jnp.sum(dispatch, axis=(0, 2)))) <= 2.0
+        # slot uniqueness: one token per (expert, slot)
+        assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0
+
+    def test_gate_gradient_flows(self):
+        m = self._model()
+        x = jax.random.normal(jax.random.key(3), (32, 16))
+
+        def loss(params):
+            y = m.apply(params, m.state, x, training=True)[0]
+            return jnp.sum(jnp.square(y))
+
+        g = jax.grad(loss)(m.params)
+        assert float(jnp.sum(jnp.abs(g["gate"]))) > 0.0
+        assert float(jnp.sum(jnp.abs(g["w1"]))) > 0.0
+
+    def test_shard_map_matches_dense(self):
+        """expert_parallel_ffn (explicit all_to_all over the expert axis)
+        must match the dense MoEFFN math when nothing overflows."""
+        from bigdl_tpu.parallel import expert_parallel_ffn
+        mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+        m = self._model(E=8, cf=8.0).evaluate()  # eval: no router jitter
+        x = jax.random.normal(jax.random.key(4), (64, 16))
+        y_dense = m.forward(x)
+        y_ep = expert_parallel_ffn(mesh, m.params, x, k=1,
+                                   capacity_factor=8.0)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_aux_loss_balanced_vs_collapsed(self):
+        from bigdl_tpu.parallel import top_k_routing, load_balancing_loss
+        T, E = 64, 4
+        balanced = jnp.tile(jnp.eye(E) * 10.0, (T // E, 1))
+        collapsed = jnp.zeros((T, E)).at[:, 0].set(10.0)
+        _, _, p1, a1 = top_k_routing(balanced, capacity=T, k=1)
+        _, _, p2, a2 = top_k_routing(collapsed, capacity=T, k=1)
+        assert float(load_balancing_loss(p1, a1)) < \
+            float(load_balancing_loss(p2, a2))
+        # aux pressure must NOT saturate under capacity overflow: with a
+        # tiny capacity the collapsed router keeps the same (pre-drop) loss
+        _, _, p3, a3 = top_k_routing(collapsed, capacity=2, k=1)
+        np.testing.assert_allclose(float(load_balancing_loss(p3, a3)),
+                                   float(load_balancing_loss(p2, a2)),
+                                   rtol=1e-6)
+        # k > num_experts is a hard error, not silent expert-0 double-dispatch
+        with pytest.raises(ValueError):
+            top_k_routing(balanced, capacity=4, k=5)
