@@ -1,0 +1,183 @@
+"""KV-cache incremental decoding for the TransformerLM family.
+
+`greedy_generate` (transformer_lm.py) re-runs the full [B, max_len] forward
+for every emitted token — O(T·L²) attention work per sequence.  This module
+adds the serving-grade path: a per-layer key/value cache updated in place
+(buffer-donated under jit), so each new token costs one [B, 1, E] forward
+and an O(L) masked attention read — the standard TPU decode shape (static
+cache length, position mask instead of dynamic slicing, exactly one
+compile).
+
+No reference counterpart (the 2017 reference serves batch predictors only,
+`example/udfpredictor/`); this is part of the net-new long-context /
+serving capability (SURVEY.md §7).
+
+Works structurally: the decoder walks the same Module tree the training
+forward uses (Sequential / residual ConcatTable+CAddTable / LayerNorm /
+MoEFFN / MultiHeadAttention...), so a model trained through the Optimizer
+decodes with its own modules — no weight surgery.  Unrecognized module
+types raise rather than silently mis-decode.
+"""
+
+from __future__ import annotations
+
+import weakref
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import MultiHeadAttention
+from ..nn.containers import ConcatTable, Sequential
+from ..nn.module import Container, Module
+from .transformer_lm import PositionalEmbedding, sample_next
+
+__all__ = ["init_kv_cache", "cached_generate"]
+
+# jitted decode step per model (weak: dropping the model drops the cache);
+# inner dict keyed by (batch, max_len, cache dtype) — the shapes that
+# change the compiled program
+_DECODE_STEP_CACHE = weakref.WeakKeyDictionary()
+
+
+def _modules_of_type(module, cls):
+    """Leaves of type `cls` in traversal order (== cache slot order)."""
+    if isinstance(module, cls):
+        return [module]
+    if isinstance(module, Container):
+        out = []
+        for m in module.modules:
+            out.extend(_modules_of_type(m, cls))
+        return out
+    return []
+
+
+def _mha_modules(module):
+    return _modules_of_type(module, MultiHeadAttention)
+
+
+def init_kv_cache(model, batch: int, max_len: int, dtype=jnp.float32):
+    """One {k, v} buffer of shape [B, H, max_len, D] per attention layer."""
+    caches = []
+    for mha in _mha_modules(model):
+        shape = (batch, mha.num_heads, max_len, mha.head_dim)
+        caches.append({"k": jnp.zeros(shape, dtype),
+                       "v": jnp.zeros(shape, dtype)})
+    return caches
+
+
+def _cached_attention(mha, params, x, cache, pos):
+    """x: [B, 1, E] at position `pos`; returns ([B, 1, E], new_cache)."""
+    B, _, E = x.shape
+    H, D = mha.num_heads, mha.head_dim
+    split = lambda y: y.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
+    q, k, v = (split(mha._proj(params, x, n)) for n in "qkv")
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))
+    L = ck.shape[2]
+    scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / (D ** 0.5)
+    mask = jnp.arange(L)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhql,bhld->bhqd", w, cv.astype(jnp.float32))
+    o = o.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, 1, E)
+    return mha._proj(params, o, "o"), {"k": ck, "v": cv}
+
+
+def _step(module, params, state, x, caches, slot, pos):
+    """Incremental apply of one module; returns (y, next_slot).
+
+    `caches` is mutated in place (list of per-MHA dicts) — the caller
+    rebuilds the functional output tuple.
+    """
+    if isinstance(module, MultiHeadAttention):
+        y, caches[slot] = _cached_attention(module, params, x, caches[slot],
+                                            pos)
+        return y, slot + 1
+    if isinstance(module, PositionalEmbedding):
+        return x + jax.lax.dynamic_slice_in_dim(
+            params["weight"], pos, 1, axis=0).astype(x.dtype)[None], slot
+    if isinstance(module, Sequential):
+        for m, p, s in zip(module.modules, params, state):
+            x, slot = _step(m, p, s, x, caches, slot, pos)
+        return x, slot
+    if isinstance(module, ConcatTable):
+        outs = []
+        for m, p, s in zip(module.modules, params, state):
+            o, slot = _step(m, p, s, x, caches, slot, pos)
+            outs.append(o)
+        return outs, slot
+    if not isinstance(module, Container):
+        # leaf modules (LayerNorm, Linear, GELU, CAddTable, MoEFFN, ...)
+        # are position-independent: reuse their own eval apply
+        y, _ = module.apply(params, state, x, training=False, rng=None)
+        return y, slot
+    raise NotImplementedError(
+        f"cached decoding: unsupported container {type(module).__name__}")
+
+
+def cached_generate(model, prompt, num_tokens: int, max_len: int,
+                    pad_token: int = 0, temperature: float = 0.0,
+                    top_k: int = 0, rng=None, cache_dtype=None):
+    """KV-cache decode: same contract as transformer_lm.greedy_generate
+    (greedy when temperature == 0, else temperature/top-k sampling) but
+    each generated token runs a [B, 1, E] incremental forward against the
+    cache instead of a full [B, max_len] re-forward.
+
+    Greedy outputs are bit-identical to greedy_generate (parity-tested).
+    MoE caveat: MoEFFN capacity is computed from the live token count, so
+    with a large batch an expert can overflow in one mode but not the other
+    (both drop per the capacity contract); raise capacity_factor on the
+    model if exact MoE parity at scale matters.
+    """
+    prompt_arr = np.asarray(prompt, np.int32)
+    toks = prompt_arr[None, :] if prompt_arr.ndim == 1 else prompt_arr
+    B, t0 = toks.shape
+    if t0 == 0:
+        raise ValueError("empty prompt")
+    if t0 + num_tokens > max_len:
+        raise ValueError(f"prompt ({t0}) + num_tokens ({num_tokens}) "
+                         f"exceeds max_len ({max_len})")
+    for pe in _modules_of_type(model, PositionalEmbedding):
+        if max_len > pe.max_len:
+            # fail loudly like the full forward would — dynamic_slice on a
+            # traced position would otherwise CLAMP and silently mis-decode
+            raise ValueError(f"max_len {max_len} > model positional "
+                             f"embedding max_len {pe.max_len}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng=")
+    if model.params is None:
+        model.build()
+
+    from ..common import get_policy
+    dtype = cache_dtype or get_policy().compute_dtype
+
+    shape_key = (B, max_len, jnp.dtype(dtype).name)
+    per_model = _DECODE_STEP_CACHE.setdefault(model, {})
+    step = per_model.get(shape_key)
+    if step is None:
+        @partial(jax.jit, donate_argnums=(2,))  # cache updated in place
+        def step(params, state, caches, tok, pos):
+            x = tok[:, None]  # [B, 1] token ids; LookupTable embeds them
+            caches = list(caches)
+            y, _ = _step(model, params, state, x, caches, 0, pos)
+            return y[:, -1], tuple(caches)
+
+        per_model[shape_key] = step
+
+    caches = tuple(init_kv_cache(model, B, max_len, dtype))
+    buf = np.full((B, max_len), pad_token, np.int32)
+    buf[:, :t0] = toks
+    for pos in range(t0 + num_tokens - 1):
+        logits, caches = step(model.params, model.state, caches,
+                              jnp.asarray(buf[:, pos]), pos)
+        if pos + 1 < t0:
+            continue  # prompt prefill: only the cache matters
+        buf[:, pos + 1], rng = sample_next(np.asarray(logits), temperature,
+                                           top_k, rng)
+    out = buf[:, : t0 + num_tokens]
+    return out[0] if prompt_arr.ndim == 1 else out

@@ -25,7 +25,7 @@ from ..nn import (CAddTable, ConcatTable, Dropout, GELU, Identity, LayerNorm,
 from ..nn.module import Module
 
 __all__ = ["TransformerLM", "TransformerBlock", "PositionalEmbedding",
-           "greedy_generate"]
+           "greedy_generate", "sample_next"]
 
 import weakref
 
@@ -115,6 +115,31 @@ def TransformerLM(vocab_size: int, max_len: int = 1024, d_model: int = 256,
     return model
 
 
+def sample_next(row, temperature: float, top_k: int, rng):
+    """Pick next tokens from a [B, vocab] logit row; returns (tokens, rng).
+
+    temperature <= 0 -> argmax; else softmax(row / temperature) sampling,
+    optionally truncated to EXACTLY the top_k most likely tokens
+    (rank-based argpartition, O(V) — a >=threshold mask would keep every
+    kth-value tie, so top_k=1 would not reduce to greedy under ties).
+    Shared by greedy_generate and decode.cached_generate so the two
+    decoders cannot drift."""
+    import numpy as np
+
+    if temperature <= 0:
+        return np.argmax(row, axis=-1), rng
+    scaled = row / temperature
+    if 0 < top_k < scaled.shape[-1]:
+        keep = np.argpartition(scaled, -top_k, axis=-1)[:, -top_k:]
+        masked = np.full_like(scaled, -np.inf)
+        np.put_along_axis(masked, keep,
+                          np.take_along_axis(scaled, keep, -1), -1)
+        scaled = masked
+    rng, sub = jax.random.split(rng)
+    return np.asarray(jax.random.categorical(
+        sub, jnp.asarray(scaled), axis=-1)), rng
+
+
 def greedy_generate(model, prompt, num_tokens: int, max_len: int,
                     pad_token: int = 0, temperature: float = 0.0,
                     top_k: int = 0, rng=None):
@@ -162,21 +187,6 @@ def greedy_generate(model, prompt, num_tokens: int, max_len: int,
         logits = fwd(model.params, model.state, jnp.asarray(buf))
         # slice on DEVICE: only the [B, vocab] row crosses to host
         row = np.asarray(logits[:, i - 1])
-        if temperature <= 0:
-            buf[:, i] = np.argmax(row, axis=-1)
-        else:
-            scaled = row / temperature
-            if top_k > 0 and top_k < scaled.shape[-1]:
-                # EXACTLY k survivors (rank-based, O(V) argpartition) —
-                # a >=threshold mask would keep every kth-value tie, so
-                # top_k=1 would not reduce to greedy under ties
-                keep = np.argpartition(scaled, -top_k, axis=-1)[:, -top_k:]
-                masked = np.full_like(scaled, -np.inf)
-                np.put_along_axis(masked, keep,
-                                  np.take_along_axis(scaled, keep, -1), -1)
-                scaled = masked
-            rng, sub = jax.random.split(rng)
-            buf[:, i] = np.asarray(jax.random.categorical(
-                sub, jnp.asarray(scaled), axis=-1))
+        buf[:, i], rng = sample_next(row, temperature, top_k, rng)
     out = buf[:, : t0 + num_tokens]
     return out[0] if np.asarray(prompt).ndim == 1 else out

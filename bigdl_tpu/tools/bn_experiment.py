@@ -21,6 +21,7 @@ Variants:
                outputs + BN stats across fwd/bwd, recompute all elementwise
                (BN normalize, ReLU, adds) in the backward pass — trades
                cheap recompute FLOPs for HBM writes of BN/ReLU activations
+  vjp_remat  — custom_vjp and remat_conv combined
 """
 
 from __future__ import annotations
@@ -94,10 +95,10 @@ def bench_variant(kind: str) -> None:
     if _PRISTINE_APPLY is None:
         _PRISTINE_APPLY = BatchNormalization.apply
     # conv outputs are checkpoint_name-tagged by nn/conv itself, so the
-    # remat variant only needs the jax.checkpoint policy below
-    remat = kind == "remat_conv"
+    # remat variants only need the jax.checkpoint policy below
+    remat = kind in ("remat_conv", "vjp_remat")
     BatchNormalization.apply = _variant_apply(
-        "baseline" if remat else kind)
+        {"remat_conv": "baseline", "vjp_remat": "custom_vjp"}.get(kind, kind))
     set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
     from ..models.resnet import ResNet
     model = ResNet(50, class_num=1000,
@@ -131,7 +132,8 @@ def bench_variant(kind: str) -> None:
 
 def main(argv=None):
     for kind in (argv or sys.argv[1:]) or ["baseline", "dtype_arg",
-                                           "custom_vjp", "remat_conv"]:
+                                           "custom_vjp", "remat_conv",
+                                           "vjp_remat"]:
         try:
             bench_variant(kind)
         except Exception as e:  # noqa: BLE001 — report and continue

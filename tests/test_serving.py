@@ -64,3 +64,86 @@ def test_text_classifier_udf_end_to_end():
     assert udf(["good great nice"])[0] == preds[0]
     # same-word texts map to identical features, so identical predictions
     assert udf(["bad awful poor"])[0] == preds[1]
+
+
+class TestCachedGenerate:
+    """KV-cache decode (models/decode.py) vs the full-forward generate."""
+
+    def _trained_lm(self, num_experts=0):
+        import numpy as np
+        from bigdl_tpu.common import set_seed
+        from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+        import bigdl_tpu.nn as nn
+
+        set_seed(2)
+        vocab, t = 12, 8
+        seqs = [[(s + i) % vocab for i in range(t + 1)] for s in range(vocab)] * 8
+        samples = [Sample(np.asarray(s[:-1], np.int32),
+                          np.asarray(s[1:], np.int32)) for s in seqs]
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(24, drop_last=True))
+        model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                              num_heads=4, num_layers=2,
+                              num_experts=num_experts)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        (Optimizer(model, ds, crit).set_optim_method(Adam(3e-3))
+         .set_end_when(Trigger.max_epoch(3)).optimize())
+        return model, vocab, t
+
+    def test_greedy_parity_with_full_forward(self):
+        import numpy as np
+        from bigdl_tpu.models.decode import cached_generate
+        from bigdl_tpu.models.transformer_lm import greedy_generate
+
+        model, vocab, t = self._trained_lm()
+        prompt = [[3, 4], [7, 8]]
+        full = greedy_generate(model, prompt, num_tokens=5, max_len=t)
+        cached = cached_generate(model, prompt, num_tokens=5, max_len=t)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+    def test_greedy_parity_moe_lm(self):
+        """The structural walker must decode the MoE variant too."""
+        import numpy as np
+        from bigdl_tpu.models.decode import cached_generate
+        from bigdl_tpu.models.transformer_lm import greedy_generate
+
+        model, vocab, t = self._trained_lm(num_experts=4)
+        prompt = [[1, 2, 3]]
+        full = greedy_generate(model, prompt, num_tokens=4, max_len=t)
+        cached = cached_generate(model, prompt, num_tokens=4, max_len=t)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+    def test_1d_prompt_returns_1d_like_greedy(self):
+        import numpy as np
+        import pytest
+        from bigdl_tpu.models.decode import cached_generate
+        from bigdl_tpu.models.transformer_lm import greedy_generate
+
+        model, vocab, t = self._trained_lm()
+        full = greedy_generate(model, [3, 4], num_tokens=3, max_len=t)
+        cached = cached_generate(model, [3, 4], num_tokens=3, max_len=t)
+        assert cached.ndim == 1 and full.ndim == 1
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+        # positions beyond the model's positional table fail loudly (the
+        # full forward raises too; dynamic_slice would silently clamp)
+        with pytest.raises(ValueError):
+            cached_generate(model, [1], num_tokens=2, max_len=t + 4)
+
+    def test_sampling_contract(self):
+        import jax
+        import numpy as np
+        from bigdl_tpu.models.decode import cached_generate
+        import pytest
+
+        model, vocab, t = self._trained_lm()
+        with pytest.raises(ValueError):
+            cached_generate(model, [[1]], num_tokens=2, max_len=t,
+                            temperature=0.5)  # rng required
+        out = cached_generate(model, [[1]], num_tokens=3, max_len=t,
+                              temperature=0.7, top_k=3,
+                              rng=jax.random.key(0))
+        assert out.shape == (1, 4)
+        assert ((0 <= out) & (out < vocab)).all()
