@@ -24,3 +24,5 @@ from . import optim
 from . import dataset
 from . import models
 from . import parallel
+from . import quantize as quantization
+from .quantize import quantize

@@ -35,7 +35,9 @@ from .transformer_lm import PositionalEmbedding, sample_next
 
 __all__ = ["init_kv_cache", "cached_generate"]
 
-# jitted decode step per model (weak: dropping the model drops the cache);
+# jitted decode step per model (weak: dropping the model drops the cache —
+# the step closure holds only a weakref to the model, else the value would
+# strongly reference its own key and defeat the WeakKeyDictionary);
 # inner dict keyed by (batch, max_len, cache dtype) — the shapes that
 # change the compiled program
 _DECODE_STEP_CACHE = weakref.WeakKeyDictionary()
@@ -69,6 +71,12 @@ def init_kv_cache(model, batch: int, max_len: int, dtype=jnp.float32):
 
 def _cached_attention(mha, params, x, cache, pos):
     """x: [B, 1, E] at position `pos`; returns ([B, 1, E], new_cache)."""
+    if not mha.causal:
+        # a KV cache presumes causal attention; fail loudly instead of
+        # silently masking a bidirectional model into different outputs
+        raise NotImplementedError(
+            "cached decoding requires causal attention "
+            "(MultiHeadAttention(causal=False) found)")
     B, _, E = x.shape
     H, D = mha.num_heads, mha.head_dim
     split = lambda y: y.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
@@ -160,11 +168,13 @@ def cached_generate(model, prompt, num_tokens: int, max_len: int,
     per_model = _DECODE_STEP_CACHE.setdefault(model, {})
     step = per_model.get(shape_key)
     if step is None:
+        model_ref = weakref.ref(model)  # break the value->key cycle
+
         @partial(jax.jit, donate_argnums=(2,))  # cache updated in place
         def step(params, state, caches, tok, pos):
             x = tok[:, None]  # [B, 1] token ids; LookupTable embeds them
             caches = list(caches)
-            y, _ = _step(model, params, state, x, caches, 0, pos)
+            y, _ = _step(model_ref(), params, state, x, caches, 0, pos)
             return y[:, -1], tuple(caches)
 
         per_model[shape_key] = step

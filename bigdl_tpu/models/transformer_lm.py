@@ -168,13 +168,19 @@ def greedy_generate(model, prompt, num_tokens: int, max_len: int,
     buf[:, :t0] = toks
 
     # jit cached PER MODEL so a serving loop compiles once, not per call;
-    # kept OUTSIDE the module (weak map) so Module.save stays picklable
+    # kept OUTSIDE the module (weak map) so Module.save stays picklable.
+    # The closure holds a weakref — a strong capture would make the cached
+    # value reference its own key and the WeakKeyDictionary never collect.
     fwd = _GENERATE_FWD_CACHE.get(model)
     if fwd is None:
+        import weakref
+
+        model_ref = weakref.ref(model)
+
         @jax.jit
         def fwd(params, state, tokens):
-            out, _ = model.apply(params, state, tokens, training=False,
-                                 rng=None)
+            out, _ = model_ref().apply(params, state, tokens,
+                                       training=False, rng=None)
             return out
 
         _GENERATE_FWD_CACHE[model] = fwd

@@ -1,0 +1,247 @@
+"""Post-training weight quantization (int8, per-output-channel symmetric).
+
+Net-new vs the 2017 reference (no quantization anywhere in BigDL v0.3;
+SURVEY.md §2 inventory) — on TPU this is a serving lever: weights stay int8
+in HBM (half of bf16, quarter of f32) and XLA fuses the int8→compute-dtype
+convert into the matmul/conv read, so memory-bound inference (small batch,
+big weights — the LLM decode regime served by models/decode.py) gains
+roughly the storage ratio in weight bandwidth.
+
+Design: `quantize(model)` rebuilds the module tree, swapping the
+matmul-bearing leaves for quantized twins that store `{q: int8, scale:
+f32[per-out-channel]}` and apply `matmul(x, q.astype(compute)) * scale`
+— scales commute with the contraction because both are linear per output
+channel.  Everything else (BN folded stats, LayerNorm, activations,
+containers) is structurally copied.  The result is a normal Module:
+`forward`, `Module.save/load`, `Predictor`, and `cached_generate` all work
+unchanged.
+
+Accuracy contract: symmetric per-channel int8 on weights only (activations
+stay bf16/f32), the configuration that is near-lossless for the zoo models
+(tests assert trained-model parity).
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+
+from .common import get_policy
+from .nn.attention import MultiHeadAttention
+from .nn.conv import SpatialConvolution
+from .nn.dropout import LookupTable
+from .nn.linear import Linear
+from .nn.module import Container, Module
+
+__all__ = ["quantize", "quantize_array", "QuantLinear",
+           "QuantSpatialConvolution", "QuantMultiHeadAttention",
+           "QuantLookupTable"]
+
+
+class _NoReinit:
+    """Mixin: quantized params come only from from_float; a re-build would
+    silently replace int8 weights with float keys and crash later."""
+
+    def _init(self, rng):
+        raise RuntimeError(
+            f"{type(self).__name__} cannot be (re)initialized — quantized "
+            "modules get their params from quantize()/from_float only")
+
+
+def quantize_array(w, channel_axis: int):
+    """Symmetric per-channel int8: returns (q int8, scale f32 [channels])."""
+    w = jnp.asarray(w, jnp.float32)
+    axes = tuple(a for a in range(w.ndim) if a != channel_axis)
+    amax = jnp.max(jnp.abs(w), axis=axes)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    shape = [1] * w.ndim
+    shape[channel_axis] = -1
+    q = jnp.clip(jnp.round(w / scale.reshape(shape)), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+class QuantLinear(_NoReinit, Module):
+    """int8 twin of nn.Linear (weight (out, in), per-out-row scale)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    @classmethod
+    def from_float(cls, mod: Linear, params):
+        m = cls(mod.input_size, mod.output_size, mod.with_bias)
+        q, scale = quantize_array(params["weight"], channel_axis=0)
+        p = {"q": q, "scale": scale}
+        if mod.with_bias:
+            p["bias"] = jnp.asarray(params["bias"])
+        return m, p
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        y = jax.lax.dot_general(
+            x.astype(c), params["q"].astype(c),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        y = (y * params["scale"]).astype(c)
+        if self.with_bias:
+            y = y + params["bias"].astype(c)
+        return y
+
+
+class QuantSpatialConvolution(_NoReinit, Module):
+    """int8 twin of nn.SpatialConvolution (HWIO weight, per-O scale).
+
+    Keeps the float layer's geometry by delegating to a carried
+    SpatialConvolution instance's `_conv` (stride/pad/group handling) with
+    the int8 weight cast to compute dtype; the per-channel scale is applied
+    to the conv OUTPUT, which is exact because convolution is linear per
+    output channel."""
+
+    def __init__(self, conv: SpatialConvolution):
+        super().__init__()
+        self.conv = conv
+        self.with_bias = conv.with_bias
+
+    @classmethod
+    def from_float(cls, mod: SpatialConvolution, params):
+        geom = copy.copy(mod)
+        geom.params = geom.state = None  # geometry only — no float weights
+        m = cls(geom)
+        q, scale = quantize_array(params["weight"], channel_axis=3)  # HWIO
+        p = {"q": q, "scale": scale}
+        if mod.with_bias:
+            p["bias"] = jnp.asarray(params["bias"])
+        return m, p
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        y = self.conv._conv(x, params["q"])
+        y = (y.astype(jnp.float32) * params["scale"]).astype(c)
+        if self.with_bias:
+            y = y + params["bias"].astype(c)
+        return y
+
+
+class QuantMultiHeadAttention(_NoReinit, MultiHeadAttention):
+    """MHA with int8 q/k/v/o projection weights (per-out-column scale).
+
+    Inherits the attention math (flash/ring path selection) and overrides
+    only the projections, so cached decoding (models/decode.py) quantizes
+    for free — _cached_attention calls _proj."""
+
+    @classmethod
+    def from_float(cls, mod: MultiHeadAttention, params):
+        m = cls(mod.embed_dim, mod.num_heads, causal=mod.causal,
+                seq_parallel=mod.seq_parallel, seq_axis=mod.seq_axis,
+                with_bias=mod.with_bias)
+        p = {}
+        for n in "qkvo":
+            q, scale = quantize_array(params["w" + n], channel_axis=1)
+            p["w" + n + "_q"] = q
+            p["s" + n] = scale
+            if mod.with_bias:
+                p["b" + n] = jnp.asarray(params["b" + n])
+        return m, p
+
+    def _proj(self, params, x, name):
+        c = get_policy().compute_dtype
+        y = jax.lax.dot_general(
+            x.astype(c), params["w" + name + "_q"].astype(c),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        y = (y * params["s" + name]).astype(c)
+        if self.with_bias:
+            y = y + params["b" + name].astype(c)
+        return y
+
+
+class QuantLookupTable(_NoReinit, Module):
+    """int8 embedding table with per-ROW scale (rows are the channels)."""
+
+    def __init__(self, lut: LookupTable):
+        super().__init__()
+        self.lut = lut
+
+    @classmethod
+    def from_float(cls, mod: LookupTable, params):
+        table = copy.copy(mod)
+        table.params = table.state = None  # config only — no float weights
+        q, scale = quantize_array(params["weight"], channel_axis=0)
+        return cls(table), {"q": q, "scale": scale}
+
+    def _apply(self, params, x):
+        c = get_policy().compute_dtype
+        idx = jnp.asarray(x, jnp.int32)
+        if self.lut.one_based:
+            idx = idx - 1
+        rows = jnp.take(params["q"], idx, axis=0).astype(jnp.float32)
+        scale = jnp.take(params["scale"], idx, axis=0)
+        return (rows * scale[..., None]).astype(c)
+
+
+_LEAF_RULES = [
+    (MultiHeadAttention, QuantMultiHeadAttention),  # before generic checks
+    (Linear, QuantLinear),
+    (SpatialConvolution, QuantSpatialConvolution),
+    (LookupTable, QuantLookupTable),
+]
+
+
+def _quantize_node(module, params, state):
+    """Returns (new_module, new_params, new_state).
+
+    Child modules deliberately carry NO params/state — the module system's
+    contract is that the top-level module owns the authoritative pytrees
+    (nn/module.py Container note); attaching copies to every node would
+    make Module.save embed each weight twice."""
+    if isinstance(module, Container):
+        clone = copy.copy(module)
+        clone.modules = []
+        clone.params = clone.state = None
+        new_p, new_s = [], []
+        for m, p, s in zip(module.modules, params, state):
+            qm, qp, qs = _quantize_node(m, p, s)
+            clone.modules.append(qm)
+            new_p.append(qp)
+            new_s.append(qs)
+        return clone, new_p, new_s
+    for float_cls, quant_cls in _LEAF_RULES:
+        # exact-class dispatch would miss aliases (SpatialShareConvolution);
+        # subclass dispatch must not re-quantize an already-quantized twin
+        if isinstance(module, float_cls) and \
+                not isinstance(module, (QuantLinear, QuantSpatialConvolution,
+                                        QuantMultiHeadAttention,
+                                        QuantLookupTable)):
+            if isinstance(module, SpatialConvolution) and \
+                    type(module).__name__ not in ("SpatialConvolution",
+                                                  "SpatialShareConvolution"):
+                break  # dilated/full/map conv geometries stay float
+            if isinstance(module, LookupTable) and \
+                    module.max_norm is not None:
+                break  # lookup-time renorm is not representable in int8 rows
+            qm, qp = quant_cls.from_float(module, params)
+            return qm, qp, state
+    clone = copy.copy(module)
+    clone.params = clone.state = None
+    return clone, params, state
+
+
+def quantize(model: Module) -> Module:
+    """Weight-only int8 post-training quantization.
+
+    Returns a NEW module tree (the float model is untouched) whose
+    matmul-bearing leaves store int8 weights + per-channel scales; use it
+    exactly like the float model for inference (training a quantized model
+    is not supported — gradients through rounded weights are meaningless
+    here)."""
+    if model.params is None:
+        raise ValueError("quantize: build/train the model first "
+                         "(params is None)")
+    qm, qp, qs = _quantize_node(model, model.params, model.state)
+    qm.params, qm.state = qp, qs
+    return qm

@@ -1,0 +1,105 @@
+"""Weight-only int8 post-training quantization (bigdl_tpu/quantize.py).
+
+Net-new vs the reference (no quantization in BigDL v0.3); the contract is
+near-lossless serving: per-output-channel symmetric int8 on matmul-bearing
+weights, activations untouched.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.quantize import (QuantLinear, QuantMultiHeadAttention,
+                                quantize, quantize_array)
+
+
+def test_quantize_array_roundtrip():
+    w = np.random.default_rng(0).normal(size=(16, 32)).astype(np.float32)
+    q, scale = quantize_array(w, channel_axis=0)
+    assert q.dtype == jnp.int8
+    deq = np.asarray(q, np.float32) * np.asarray(scale)[:, None]
+    # per-channel symmetric int8: max error is scale/2 per channel
+    err = np.abs(deq - w)
+    assert (err <= np.asarray(scale)[:, None] * 0.5 + 1e-7).all()
+
+
+def test_linear_parity():
+    m = nn.Linear(64, 32).build(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 64)),
+                    jnp.float32)
+    y_f = m.forward(x)
+    qm = quantize(m)
+    assert isinstance(qm, QuantLinear)
+    assert qm.params["q"].dtype == jnp.int8
+    y_q = qm.forward(x)
+    rel = float(jnp.linalg.norm(y_q - y_f) / jnp.linalg.norm(y_f))
+    assert rel < 0.01, rel
+
+
+def test_float_model_untouched():
+    m = nn.Linear(8, 4).build(jax.random.key(0))
+    w_before = np.asarray(m.params["weight"]).copy()
+    quantize(m)
+    np.testing.assert_array_equal(np.asarray(m.params["weight"]), w_before)
+
+
+def test_unbuilt_model_rejected():
+    with pytest.raises(ValueError):
+        quantize(nn.Linear(4, 4))
+
+
+def test_trained_lenet_accuracy_preserved():
+    """Train LeNet on the separable synthetic task, quantize, and the
+    held-out accuracy must survive int8 weights."""
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init()
+    model, opt = make_optimizer()
+    opt.optimize()
+    val = DataSet.array(synthetic_mnist(256, seed=9))
+    acc_f = Evaluator(model).test(val, [Top1Accuracy()],
+                                  batch_size=64)[0][1].result()[0]
+    qmodel = quantize(model)
+    acc_q = Evaluator(qmodel).test(val, [Top1Accuracy()],
+                                   batch_size=64)[0][1].result()[0]
+    assert acc_q >= acc_f - 0.02, (acc_f, acc_q)
+    # conv + linear weights really are int8 now
+    leaves = jax.tree.leaves(qmodel.params)
+    assert any(l.dtype == jnp.int8 for l in leaves)
+
+
+def test_transformer_quantized_cached_decode():
+    """Quantized MHA inherits the cache path: cached_generate on the int8
+    model must agree with the int8 full forward (and stay close to f32)."""
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.decode import cached_generate
+    from bigdl_tpu.models.transformer_lm import greedy_generate
+    from bigdl_tpu.common import set_seed
+
+    set_seed(4)
+    vocab, t = 12, 8
+    model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                          num_heads=4, num_layers=2).build(jax.random.key(1))
+    qmodel = quantize(model)
+    mhas = [m for m in
+            __import__("bigdl_tpu.models.decode",
+                       fromlist=["_mha_modules"])._mha_modules(qmodel)]
+    assert mhas and all(isinstance(m, QuantMultiHeadAttention)
+                        for m in mhas)
+    prompt = [[3, 4, 5]]
+    full_q = greedy_generate(qmodel, prompt, num_tokens=4, max_len=t)
+    cached_q = cached_generate(qmodel, prompt, num_tokens=4, max_len=t)
+    np.testing.assert_array_equal(np.asarray(full_q), np.asarray(cached_q))
+    # logits of the quantized model track the float model closely
+    tok = jnp.asarray(prompt, jnp.int32)
+    lf, _ = model.apply(model.params, model.state, tok, training=False,
+                        rng=None)
+    lq, _ = qmodel.apply(qmodel.params, qmodel.state, tok, training=False,
+                         rng=None)
+    assert float(jnp.max(jnp.abs(lf - lq))) < 0.15
