@@ -186,6 +186,12 @@ def _bench_config(name, build, peak_flops):
     opt = Optimizer(model, dataset=None, criterion=criterion,
                     end_trigger=Trigger.max_iteration(1))
     opt.set_optim_method(SGD(learning_rate=lr, momentum=0.9))
+    # perf knobs measured by bigdl_tpu.tools.bn_experiment: remat policy for
+    # the timed step (BIGDL_TPU_BENCH_REMAT=conv_out|full) composes with the
+    # BIGDL_TPU_BN_FUSED_VJP config-tier flag read inside BatchNormalization
+    bench_remat = os.environ.get("BIGDL_TPU_BENCH_REMAT")
+    if bench_remat:
+        opt.set_remat(bench_remat)
     step, param_sh, data_sh = opt._build_step(mesh)
 
     params = jax.device_put(model.params, param_sh)

@@ -9,13 +9,14 @@ loops + running-stat EMA), `nn/SpatialBatchNormalization.scala`,
 TPU-native notes: batch-norm is a fused reduce+scale XLA graph; running statistics
 live in the module's `state` pytree (the functional analog of the reference's
 mutable runningMean/runningVar tensors), updated only when training=True.  Under
-data parallelism the Optimizer computes batch stats per shard (matching the
-reference, where each model replica normalizes over its local sub-batch,
-DistriOptimizer.scala:165-183).  Cross-replica sync-BN (`sync_axis=`) uses
-`lax.pmean`, which requires the step to run under `shard_map` with that axis
-bound (see bigdl_tpu.parallel) — it is NOT usable under the default
-jit/GSPMD data-parallel path, where per-shard stats are the (reference-matching)
-behavior.
+the default jit/GSPMD data-parallel path the reductions run over the GLOBAL
+logical batch — XLA inserts a (cheap, per-channel-vector) cross-device
+all-reduce — i.e. sync-BN semantics out of the box.  This differs from the
+reference, where each model replica normalizes over only its local sub-batch
+(DistriOptimizer.scala:165-183); global stats are the statistically stronger
+behavior and the natural GSPMD lowering, so it is the default here.  The
+explicit `sync_axis=` + `lax.pmean` path exists for `shard_map` contexts
+(bigdl_tpu.parallel), where reductions really are per-shard unless synced.
 """
 
 from __future__ import annotations

@@ -207,6 +207,12 @@ class Optimizer:
         nn/conv.SpatialConvolution._conv), or any jax.checkpoint_policies
         callable.
         """
+        if policy is not None and not callable(policy) and \
+                policy not in ("full", "conv_out"):
+            # a typo'd string would otherwise silently run the no-remat path
+            raise ValueError(f"set_remat: unknown policy {policy!r} — "
+                             "expected None, 'full', 'conv_out', or a "
+                             "jax.checkpoint_policies callable")
         self.remat_policy = policy
         return self
 
