@@ -371,7 +371,21 @@ class Optimizer:
             out_shardings=(param_sh, rep, opt_sh, None),
             donate_argnums=(0, 1, 2),
         )
-        return jitted, param_sh, data_sh
+
+        def step_in_mesh(*args):
+            # trace/compile under the mesh context so PartitionSpec-based
+            # with_sharding_constraint inside modules binds to the training
+            # mesh (e.g. MoEFFN's expert-axis hints); entering a mesh
+            # context on an already-compiled call is nanoseconds
+            with mesh:
+                return jitted(*args)
+
+        def lower_in_mesh(*args, **kw):
+            with mesh:
+                return jitted.lower(*args, **kw)
+
+        step_in_mesh.lower = lower_in_mesh  # bench/dryrun introspection
+        return step_in_mesh, param_sh, data_sh
 
     def _build_forward(self, mesh):
         model = self.model
@@ -381,7 +395,15 @@ class Optimizer:
                                  rng=None)
             return out
 
-        return jax.jit(fwd)
+        jitted = jax.jit(fwd)
+
+        def fwd_in_mesh(*args):
+            # same mesh-context rule as the train step: PartitionSpec
+            # constraints inside modules must bind during validation too
+            with mesh:
+                return jitted(*args)
+
+        return fwd_in_mesh
 
     # ------------------------------------------------------------------
     # the driver loop (reference: DistriOptimizer.scala:141-381)
@@ -752,7 +774,8 @@ class _ShardedForward:
 
         n = (inp[0] if isinstance(inp, (list, tuple)) else inp).shape[0]
         placed = _put_batch(jax.tree.map(pad, inp), data_sh)
-        return self._fwd(params, net_state, placed), n
+        with mesh:  # PartitionSpec constraints inside modules must bind
+            return self._fwd(params, net_state, placed), n
 
 
 class Evaluator:

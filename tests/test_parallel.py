@@ -429,3 +429,46 @@ class TestExpertParallel:
         # k > num_experts is a hard error, not silent expert-0 double-dispatch
         with pytest.raises(ValueError):
             top_k_routing(balanced, capacity=4, k=5)
+
+    def test_moe_lm_trains_on_data_x_expert_mesh(self):
+        """GSPMD EP end-to-end: the MoE TransformerLM trains through the
+        Optimizer's compiled step on a {"data": 2, "expert": 4} mesh with
+        expert_axis sharding constraints active (MoEFFN._constrain) —
+        proving EP composes with data-parallel training, not just the
+        shard_map parity path."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.common import set_seed
+        from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.reset()
+        Engine.init(mesh_shape={"data": 2, "expert": 4})
+        set_seed(3)
+        vocab, t = 12, 8
+        seqs = [[(s + i) % vocab for i in range(t + 1)]
+                for s in range(vocab)] * 8
+        samples = [Sample(np.asarray(s[:-1], np.int32),
+                          np.asarray(s[1:], np.int32)) for s in seqs]
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(32, drop_last=True))
+        model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                              num_heads=4, num_layers=2, num_experts=4,
+                              expert_axis="expert")
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        from bigdl_tpu.optim import Loss, Trigger as Trg
+        opt = (Optimizer(model, ds, crit)
+               .set_optim_method(Adam(3e-3))
+               .set_end_when(Trigger.max_epoch(5))
+               .set_validation(Trg.every_epoch(), ds, [Loss(crit)]))
+        from bigdl_tpu.parallel import MoEFFN
+        MoEFFN._warned_no_mesh = False
+        opt.optimize()
+        # the expert-axis constraint must have BOUND (the step is traced
+        # under the mesh context) — a silent replicated-experts fallback
+        # would set the warning latch
+        assert MoEFFN._warned_no_mesh is False
+        loss = opt.optim_method.hyper["loss"]
+        assert np.isfinite(loss) and loss < 2.4  # descending from ln(12)
