@@ -231,18 +231,41 @@ class DataSet:
         return DataSet.array(list(read_records(path)), distributed=distributed)
 
     @staticmethod
-    def record_files(pattern, distributed: bool = False, seed: int = 1):
+    def record_files(pattern, distributed: bool = False, seed: int = 1,
+                     num_threads: int = 0):
         """A glob (or list) of BDRecord shards -> one dataset — the sharded
         SeqFileFolder role (DataSet.scala:319): shard files concatenated in
         sorted order and cached in memory on EVERY process; under
         `distributed=True` each data pass yields only this process's record
         shard.  For corpora near host-memory size, split the file list per
-        process yourself and build per-host local datasets instead."""
+        process yourself and build per-host local datasets instead.
+
+        num_threads > 0 loads shards through the native multithreaded
+        prefetcher (csrc/prefetch.cc — the concurrent-read role of one
+        Spark task per SeqFile partition); record order then interleaves
+        across shards nondeterministically, which is fine locally (training
+        shuffles per epoch; eval metrics are order-invariant sums) but NOT
+        under distributed=True, where every process must hold the identical
+        list for the seeded permutation + strided slice to partition
+        correctly — so distributed mode always uses the deterministic
+        sequential read.  Falls back to the sequential reader when the
+        native library is absent."""
         import glob as _glob
         from ..utils.recordio import read_records
         paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
                  else list(pattern))
         if not paths:
             raise FileNotFoundError(f"no record shards match {pattern!r}")
-        records = [rec for p in paths for rec in read_records(p)]
+        records = None
+        if num_threads > 0 and not distributed:
+            from ..utils import native
+            if native.is_native_loaded() and native.has_prefetch():
+                import pickle
+                with native.NativePrefetchReader(
+                        paths, num_threads=num_threads) as reader:
+                    # payloads are pickled by write_records; decode like
+                    # read_records does
+                    records = [pickle.loads(b) for b in reader]
+        if records is None:
+            records = [rec for p in paths for rec in read_records(p)]
         return DataSet.array(records, distributed=distributed, seed=seed)

@@ -22,7 +22,8 @@ import numpy as np
 
 __all__ = ["lib", "crc32c", "is_native_loaded", "build", "set_num_threads",
            "get_num_threads", "f32_to_bf16", "bf16_to_f32",
-           "NativeRecordWriter", "NativeRecordReader"]
+           "NativeRecordWriter", "NativeRecordReader",
+           "NativePrefetchReader", "has_prefetch"]
 
 _pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _csrc_dir = os.path.join(os.path.dirname(_pkg_dir), "csrc")
@@ -56,6 +57,19 @@ def _bind(cdll: ctypes.CDLL) -> None:
     cdll.bigdl_record_reader_data.argtypes = [ctypes.c_void_p]
     cdll.bigdl_record_reader_close.restype = None
     cdll.bigdl_record_reader_close.argtypes = [ctypes.c_void_p]
+    if hasattr(cdll, "bigdl_prefetch_open"):
+        # optional (newer than the first shipped .so): an older binary
+        # without these symbols must still provide crc32c/record IO/hostops
+        cdll.bigdl_prefetch_open.restype = ctypes.c_void_p
+        cdll.bigdl_prefetch_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64]
+        cdll.bigdl_prefetch_next.restype = ctypes.c_int64
+        cdll.bigdl_prefetch_next.argtypes = [ctypes.c_void_p]
+        cdll.bigdl_prefetch_data.restype = ctypes.c_void_p
+        cdll.bigdl_prefetch_data.argtypes = [ctypes.c_void_p]
+        cdll.bigdl_prefetch_close.restype = None
+        cdll.bigdl_prefetch_close.argtypes = [ctypes.c_void_p]
     cdll.bigdl_set_num_threads.restype = None
     cdll.bigdl_set_num_threads.argtypes = [ctypes.c_int]
     cdll.bigdl_get_num_threads.restype = ctypes.c_int
@@ -117,6 +131,12 @@ def build(quiet: bool = True) -> bool:
 def is_native_loaded() -> bool:
     """(reference: MKL.isMKLLoaded)."""
     return lib is not None
+
+
+def has_prefetch() -> bool:
+    """True when the loaded .so exports the bigdl_prefetch_* symbols
+    (optional: older binaries predate csrc/prefetch.cc)."""
+    return lib is not None and hasattr(lib, "bigdl_prefetch_open")
 
 
 def set_num_threads(n: int) -> None:
@@ -231,6 +251,8 @@ class NativeRecordReader:
         return self
 
     def __next__(self) -> bytes:
+        if not self._h:  # use-after-close would hand C a NULL handle
+            raise StopIteration
         n = lib.bigdl_record_reader_next(self._h)
         if n == -1:
             raise StopIteration
@@ -248,3 +270,55 @@ class NativeRecordReader:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class NativePrefetchReader:
+    """Multithreaded shard prefetcher (csrc/prefetch.cc): N C++ reader
+    threads stream BDRecord shards into a bounded ring buffer; iterating
+    yields payload bytes.  Record order interleaves across shards (the
+    Spark-partition semantics of the reference's SeqFileFolder datasets);
+    single consumer only."""
+
+    def __init__(self, paths, num_threads: int = 4, capacity: int = 256):
+        if not has_prefetch():
+            raise RuntimeError("native library not loaded or too old "
+                               "(no bigdl_prefetch_* symbols)")
+        paths = [str(p) for p in paths]
+        if not paths:
+            raise ValueError("no shard paths")
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._h = lib.bigdl_prefetch_open(arr, len(paths), num_threads,
+                                          capacity)
+        if not self._h:
+            raise IOError(f"cannot open prefetcher over {len(paths)} shards")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if not self._h:  # use-after-close would hand C a NULL handle
+            raise StopIteration
+        n = lib.bigdl_prefetch_next(self._h)
+        if n == -1:
+            raise StopIteration
+        if n < 0:
+            raise IOError("prefetch: IO error or corrupt record")
+        return ctypes.string_at(lib.bigdl_prefetch_data(self._h), n)
+
+    def close(self) -> None:
+        if self._h:
+            lib.bigdl_prefetch_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

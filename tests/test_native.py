@@ -179,3 +179,77 @@ def test_make_build_is_idempotent():
     rc = subprocess.run(["make", "-C", _CSRC, "-q"],
                         capture_output=True).returncode
     assert rc in (0, 1)  # 0 = up to date; 1 = would rebuild (still fine)
+
+
+class TestNativePrefetch:
+    """csrc/prefetch.cc: multithreaded shard reader behind
+    utils.native.NativePrefetchReader and DataSet.record_files(num_threads)."""
+
+    def _write_shards(self, tmp_path, n_shards=6, per_shard=40):
+        import pickle
+        from bigdl_tpu.utils.recordio import write_records
+        paths, expect = [], []
+        for s in range(n_shards):
+            p = str(tmp_path / f"shard-{s:03d}.bd")
+            recs = [f"shard{s}-rec{i}" * (i % 7 + 1)
+                    for i in range(per_shard)]
+            write_records(p, recs)
+            expect.extend(pickle.dumps(r, pickle.HIGHEST_PROTOCOL)
+                          for r in recs)
+            paths.append(p)
+        return paths, expect
+
+    def test_reads_exact_multiset(self, tmp_path):
+        from bigdl_tpu.utils import native
+        if not native.is_native_loaded():
+            pytest.skip("native library not built")
+        paths, expect = self._write_shards(tmp_path)
+        with native.NativePrefetchReader(paths, num_threads=4,
+                                         capacity=16) as r:
+            got = list(r)
+        assert sorted(got) == sorted(expect)
+        # per-shard order is preserved even though shards interleave
+        for s, p in enumerate(paths):
+            prefix = f"shard{s}-".encode()
+            mine = [g for g in got if g.startswith(prefix)]
+            assert mine == [e for e in expect if e.startswith(prefix)]
+
+    def test_more_threads_than_shards(self, tmp_path):
+        from bigdl_tpu.utils import native
+        if not native.is_native_loaded():
+            pytest.skip("native library not built")
+        paths, expect = self._write_shards(tmp_path, n_shards=2, per_shard=5)
+        with native.NativePrefetchReader(paths, num_threads=16) as r:
+            assert sorted(list(r)) == sorted(expect)
+
+    def test_missing_shard_raises(self, tmp_path):
+        from bigdl_tpu.utils import native
+        if not native.is_native_loaded():
+            pytest.skip("native library not built")
+        paths, _ = self._write_shards(tmp_path, n_shards=2, per_shard=3)
+        paths.append(str(tmp_path / "missing.bd"))
+        with native.NativePrefetchReader(paths, num_threads=2) as r:
+            # the error latch guarantees IOError, never a silent clean end —
+            # a regression that skips unreadable shards must fail here
+            with pytest.raises(IOError):
+                while True:
+                    next(r)
+
+    def test_early_close_does_not_hang(self, tmp_path):
+        from bigdl_tpu.utils import native
+        if not native.is_native_loaded():
+            pytest.skip("native library not built")
+        paths, _ = self._write_shards(tmp_path, n_shards=4, per_shard=200)
+        r = native.NativePrefetchReader(paths, num_threads=4, capacity=4)
+        next(r)  # consume one record, leave producers blocked on the ring
+        r.close()  # must join all workers without deadlock
+
+    def test_record_files_num_threads(self, tmp_path):
+        import pickle
+        from bigdl_tpu.dataset import DataSet
+        paths, expect = self._write_shards(tmp_path, n_shards=3,
+                                           per_shard=10)
+        ds = DataSet.record_files(paths, num_threads=4)
+        seq = DataSet.record_files(paths)
+        objs = sorted(pickle.loads(b) for b in expect)
+        assert sorted(ds.records) == sorted(seq.records) == objs
