@@ -156,6 +156,15 @@ def _make_record(name, batch, dt, timing, compile_s, flops_step,
            "compile_seconds": round(compile_s, 2),
            "model_flops_per_step": flops_step,
            "mfu": mfu, "timing": timing, **flops_detail, **extra}
+    if name.startswith("resnet50") and extra.get("mode") != "inference" \
+            and peak_flops:  # peak is only set on real accelerator runs
+        # measured decomposition, docs/benchmarking.md "BN bandwidth
+        # ceiling": exact batch-stat BN adds ~4 activation-sized HBM
+        # passes (~22ms at batch 256), capping train MFU near 0.35 on one
+        # v5e chip; eval-mode grad = 0.452, inference fwd = 0.61
+        rec["mfu_note"] = ("train-mode BN batch statistics are "
+                           "HBM-bound; see docs/benchmarking.md for the "
+                           "measured ceiling decomposition")
     if mfu_error:
         rec["mfu_raw"] = round(mfu_raw, 4)
         rec["mfu_error"] = mfu_error
