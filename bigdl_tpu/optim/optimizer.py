@@ -81,6 +81,48 @@ def _any_deleted(tree) -> bool:
                for leaf in jax.tree.leaves(tree))
 
 
+def _accumulated_grads(model, criterion, collect_aux_losses, apply_remat,
+                       accum, params, net_state, inp, tgt, rng):
+    """Gradient accumulation inside the compiled step (net-new vs the
+    reference): split the global batch into `accum` microbatches, lax.scan
+    the fwd+bwd over them threading net_state (each microbatch normalizes
+    by its own BN stats, like consecutive small steps would), and average
+    loss/grads.  Peak activation memory drops by ~accum; composes with the
+    remat policy, which applies per-microbatch."""
+    def split(x):
+        if x.shape[0] % accum:
+            # deterministic setup error: the retry loop must re-raise, not
+            # burn retries recovering from checkpoints (ConfigurationError)
+            raise ConfigurationError(
+                f"gradient accumulation: batch {x.shape[0]} not divisible "
+                f"by accumulation steps {accum}")
+        return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+    micro_inp = jax.tree.map(split, inp)
+    micro_tgt = jax.tree.map(split, tgt)
+    rngs = jax.random.split(rng, accum)
+
+    def loss_fn(p, ns, x, t, r):
+        out, ns2 = model.apply(p, ns, x, training=True, rng=r)
+        return criterion.loss(out, t) + collect_aux_losses(ns2), ns2
+
+    vg = jax.value_and_grad(apply_remat(loss_fn), has_aux=True)
+
+    def body(carry, xs):
+        ns, gacc, lacc = carry
+        x, t, r = xs
+        (loss, ns2), g = vg(params, ns, x, t, r)
+        gacc = jax.tree.map(jnp.add, gacc, g)
+        return (ns2, gacc, lacc + loss), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (new_ns, gsum, lsum), _ = jax.lax.scan(
+        body, (net_state, zeros, jnp.float32(0.0)),
+        (micro_inp, micro_tgt, rngs))
+    grads = jax.tree.map(lambda g: g / accum, gsum)
+    return lsum / accum, new_ns, grads
+
+
 def _put_batch(batch, sharding):
     """Host batch -> sharded global device arrays.
 
@@ -130,6 +172,7 @@ class Optimizer:
         self.grad_clip_norm = None
         self.grad_clip_const = None
         self.remat_policy = None
+        self.grad_accum_steps = 1
         self.log_interval = 1
         self.metrics = Metrics()
         self._compiled = None
@@ -214,6 +257,17 @@ class Optimizer:
                              "expected None, 'full', 'conv_out', or a "
                              "jax.checkpoint_policies callable")
         self.remat_policy = policy
+        return self
+
+    def set_gradient_accumulation(self, steps: int):
+        """Split each global batch into `steps` microbatches inside the
+        compiled step (lax.scan), averaging the gradients before the single
+        optimizer update — activation memory drops ~steps-fold for the same
+        effective batch (net-new vs the reference; composes with
+        set_remat).  Batch size must be divisible by `steps`."""
+        if steps < 1:
+            raise ValueError(f"set_gradient_accumulation: steps={steps}")
+        self.grad_accum_steps = int(steps)
         return self
 
     def set_drop_module_property(self, drop_percentage: float,
@@ -313,23 +367,33 @@ class Optimizer:
                     total = total + collect_aux_losses(v)
             return total
 
-        def step(params, net_state, opt_state, inp, tgt, lr, rng):
-            def loss_fn(p):
-                out, ns = model.apply(p, net_state, inp, training=True, rng=rng)
-                return criterion.loss(out, tgt) + collect_aux_losses(ns), ns
+        accum = self.grad_accum_steps
 
+        def apply_remat(fn):
             if remat == "full":
-                loss_fn = jax.checkpoint(loss_fn)
-            elif remat == "conv_out":
-                loss_fn = jax.checkpoint(
-                    loss_fn,
-                    policy=jax.checkpoint_policies.save_only_these_names(
+                return jax.checkpoint(fn)
+            if remat == "conv_out":
+                return jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies.save_only_these_names(
                         "conv_out"))
-            elif callable(remat):
-                loss_fn = jax.checkpoint(loss_fn, policy=remat)
+            if callable(remat):
+                return jax.checkpoint(fn, policy=remat)
+            return fn
 
-            (loss, new_net_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+        def step(params, net_state, opt_state, inp, tgt, lr, rng):
+            if accum > 1:
+                loss, new_net_state, grads = _accumulated_grads(
+                    model, criterion, collect_aux_losses, apply_remat,
+                    accum, params, net_state, inp, tgt, rng)
+            else:
+                def loss_fn(p):
+                    out, ns = model.apply(p, net_state, inp, training=True,
+                                          rng=rng)
+                    return (criterion.loss(out, tgt)
+                            + collect_aux_losses(ns), ns)
+
+                (loss, new_net_state), grads = jax.value_and_grad(
+                    apply_remat(loss_fn), has_aux=True)(params)
             # bf16 wire: cross-chip gradient reduction happens on these values —
             # casting here makes the GSPMD all-reduce ride ICI at bf16, the
             # reference's FP16CompressedTensor format

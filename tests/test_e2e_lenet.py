@@ -137,3 +137,61 @@ def test_lr_schedule_advances_during_training():
     # after 5 iterations (evalCounter=5) lr must have decayed 0.1 * 0.5^2
     lr = sgd.get_learning_rate(sgd.hyper)
     assert abs(lr - 0.1 * 0.25) < 1e-9, lr
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """set_gradient_accumulation(4): microbatched grads averaged inside the
+    step must reproduce the full-batch trajectory on an rng-free model
+    (differences are float reassociation only)."""
+    from bigdl_tpu.common import set_seed
+
+    Engine.init()
+    samples = synthetic_mnist(256)
+
+    def train(accum):
+        set_seed(5)
+        model, opt = make_optimizer(batch_size=64, samples=samples)
+        opt.set_optim_method(SGD(learning_rate=0.05))
+        opt.set_end_when(Trigger.max_epoch(1))
+        if accum > 1:
+            opt.set_gradient_accumulation(accum)
+        opt.optimize()
+        return jax.tree.leaves(jax.tree.map(np.asarray, model.params))
+
+    base, acc = train(1), train(4)
+    for a, b in zip(base, acc):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_gradient_accumulation_indivisible_batch_rejected():
+    Engine.init()
+    model, opt = make_optimizer(batch_size=64)
+    opt.set_gradient_accumulation(7)  # 64 % 7 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        opt.optimize()
+
+
+def test_gradient_accumulation_with_remat_and_bn():
+    """accumulation composes with remat and BN state threading (each
+    microbatch normalizes with its own stats; running stats advance)."""
+    Engine.init()
+    model = nn.Sequential() \
+        .add(nn.Reshape((28, 28, 1))) \
+        .add(nn.SpatialConvolution(1, 4, 3, 3, 1, 1, -1, -1)) \
+        .add(nn.SpatialBatchNormalization(4)) \
+        .add(nn.ReLU()) \
+        .add(nn.Reshape((28 * 28 * 4,))) \
+        .add(nn.Linear(28 * 28 * 4, 10)) \
+        .add(nn.LogSoftMax())
+    ds = DataSet.array(synthetic_mnist(256)).transform(
+        SampleToMiniBatch(64, drop_last=True))
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(learning_rate=1e-3))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_gradient_accumulation(4)
+           .set_remat("conv_out"))
+    opt.optimize()
+    assert opt.optim_method.hyper["loss"] < 1.0
+    # BN running stats advanced through the scan
+    rm = np.asarray(jax.tree.leaves(model.state)[0])
+    assert np.abs(rm).sum() > 0
