@@ -5,7 +5,7 @@ from .autoencoder import Autoencoder
 from .inception import (Inception_Layer_v1, Inception_Layer_v2,
                         Inception_v1, Inception_v1_NoAuxClassifier,
                         Inception_v2, Inception_v2_NoAuxClassifier)
-from .decode import cached_generate, init_kv_cache
+from .decode import beam_generate, cached_generate, init_kv_cache
 from .lenet import LeNet5
 from .resnet import ResNet, ShortcutType
 from .rnn import PTBModel, SimpleRNN
@@ -21,6 +21,7 @@ __all__ = [
     "Inception_v2_NoAuxClassifier", "LeNet5", "PTBModel",
     "PositionalEmbedding", "ResNet", "ShortcutType", "SimpleRNN",
     "TextClassifier", "TransformerBlock", "TransformerLM",
-    "TreeLSTMSentiment", "cached_generate", "encode_tree", "init_kv_cache",
+    "TreeLSTMSentiment", "beam_generate", "cached_generate",
+    "encode_tree", "init_kv_cache",
     "Vgg_16", "Vgg_19", "VggForCifar10",
 ]

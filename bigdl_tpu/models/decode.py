@@ -33,7 +33,7 @@ from ..nn.containers import ConcatTable, Sequential
 from ..nn.module import Container, Module
 from .transformer_lm import PositionalEmbedding, sample_next
 
-__all__ = ["init_kv_cache", "cached_generate"]
+__all__ = ["init_kv_cache", "cached_generate", "beam_generate"]
 
 # jitted decode step per model (weak: dropping the model drops the cache —
 # the step closure holds only a weakref to the model, else the value would
@@ -128,6 +128,101 @@ def _step(module, params, state, x, caches, slot, pos):
         f"cached decoding: unsupported container {type(module).__name__}")
 
 
+def _get_step(model, rows: int, max_len: int, dtype):
+    """The jitted one-position decode step, cached per
+    (model, rows, max_len, dtype)."""
+    shape_key = (rows, max_len, jnp.dtype(dtype).name)
+    per_model = _DECODE_STEP_CACHE.setdefault(model, {})
+    step = per_model.get(shape_key)
+    if step is None:
+        model_ref = weakref.ref(model)  # break the value->key cycle
+
+        @partial(jax.jit, donate_argnums=(2,))  # cache updated in place
+        def step(params, state, caches, tok, pos):
+            x = tok[:, None]  # [rows, 1] token ids; LookupTable embeds them
+            caches = list(caches)
+            y, _ = _step(model_ref(), params, state, x, caches, 0, pos)
+            return y[:, -1], tuple(caches)
+
+        per_model[shape_key] = step
+    return step
+
+
+def _validate_generate(model, toks, num_tokens, max_len):
+    if toks.shape[1] == 0:
+        raise ValueError("empty prompt")
+    if toks.shape[1] + num_tokens > max_len:
+        raise ValueError(f"prompt ({toks.shape[1]}) + num_tokens "
+                         f"({num_tokens}) exceeds max_len ({max_len})")
+    for pe in _modules_of_type(model, PositionalEmbedding):
+        if max_len > pe.max_len:
+            # fail loudly like the full forward would — dynamic_slice on a
+            # traced position would otherwise CLAMP and silently mis-decode
+            raise ValueError(f"max_len {max_len} > model positional "
+                             f"embedding max_len {pe.max_len}")
+    if model.params is None:
+        model.build()
+
+
+def beam_generate(model, prompt, num_tokens: int, max_len: int,
+                  beam_size: int = 4, pad_token: int = 0, cache_dtype=None):
+    """Beam-search decoding over the KV cache: keeps the `beam_size`
+    highest-total-log-prob hypotheses per batch row; returns the best
+    sequence(s), [t0+num_tokens] for a 1-D prompt else [B, t0+num_tokens].
+
+    Assumes the model emits log-probabilities (the zoo TransformerLM ends
+    in LogSoftMax) so per-step scores sum to a sequence log-prob.
+    beam_size=1 reduces exactly to greedy.  Per step, the KV caches are
+    reordered along the row axis to follow the surviving hypotheses
+    (device-side jnp.take)."""
+    prompt_arr = np.asarray(prompt, np.int32)
+    toks = prompt_arr[None, :] if prompt_arr.ndim == 1 else prompt_arr
+    B, t0 = toks.shape
+    _validate_generate(model, toks, num_tokens, max_len)
+    if beam_size < 1:
+        raise ValueError(f"beam_size {beam_size}")
+
+    from ..common import get_policy
+    dtype = cache_dtype or get_policy().compute_dtype
+    rows = B * beam_size
+    step = _get_step(model, rows, max_len, dtype)
+    caches = tuple(init_kv_cache(model, rows, max_len, dtype))
+    buf = np.full((rows, max_len), pad_token, np.int32)
+    buf[:, :t0] = np.repeat(toks, beam_size, axis=0)
+    # all beams start as copies of the prompt; only beam 0 may expand on
+    # the first scored step, else the top-k would pick duplicates
+    scores = np.full((B, beam_size), -np.inf, np.float64)
+    scores[:, 0] = 0.0
+    for pos in range(t0 + num_tokens - 1):
+        logits, caches = step(model.params, model.state, caches,
+                              jnp.asarray(buf[:, pos]), pos)
+        if pos + 1 < t0:
+            continue  # prompt prefill
+        lp = np.asarray(logits, np.float64).reshape(B, beam_size, -1)
+        V = lp.shape[-1]
+        flat = (scores[:, :, None] + lp).reshape(B, beam_size * V)
+        k = min(beam_size, flat.shape[1])
+        top = np.argpartition(flat, -k, axis=-1)[:, -k:]
+        order = np.argsort(-np.take_along_axis(flat, top, -1), axis=-1)
+        top = np.take_along_axis(top, order, -1)
+        scores = np.take_along_axis(flat, top, -1)        # [B, k] desc
+        src = top // V                                    # surviving beam
+        tok = (top % V).astype(np.int32)
+        gather = (np.arange(B)[:, None] * beam_size + src).reshape(-1)
+        if not np.array_equal(gather, np.arange(rows)):
+            buf = buf[gather].copy()
+            # cache reorder is a full [rows, H, max_len, D] copy per layer —
+            # skip when the permutation is the identity (always true for
+            # beam_size=1) and on the final step, whose caches are unused
+            if pos + 2 < t0 + num_tokens:
+                gidx = jnp.asarray(gather)
+                caches = tuple({k2: jnp.take(c[k2], gidx, axis=0)
+                                for k2 in c} for c in caches)
+        buf[:, pos + 1] = tok.reshape(-1)
+    out = buf.reshape(B, beam_size, max_len)[:, 0, : t0 + num_tokens]
+    return out[0] if prompt_arr.ndim == 1 else out
+
+
 def cached_generate(model, prompt, num_tokens: int, max_len: int,
                     pad_token: int = 0, temperature: float = 0.0,
                     top_k: int = 0, rng=None, cache_dtype=None):
@@ -145,40 +240,13 @@ def cached_generate(model, prompt, num_tokens: int, max_len: int,
     prompt_arr = np.asarray(prompt, np.int32)
     toks = prompt_arr[None, :] if prompt_arr.ndim == 1 else prompt_arr
     B, t0 = toks.shape
-    if t0 == 0:
-        raise ValueError("empty prompt")
-    if t0 + num_tokens > max_len:
-        raise ValueError(f"prompt ({t0}) + num_tokens ({num_tokens}) "
-                         f"exceeds max_len ({max_len})")
-    for pe in _modules_of_type(model, PositionalEmbedding):
-        if max_len > pe.max_len:
-            # fail loudly like the full forward would — dynamic_slice on a
-            # traced position would otherwise CLAMP and silently mis-decode
-            raise ValueError(f"max_len {max_len} > model positional "
-                             f"embedding max_len {pe.max_len}")
+    _validate_generate(model, toks, num_tokens, max_len)
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng=")
-    if model.params is None:
-        model.build()
 
     from ..common import get_policy
     dtype = cache_dtype or get_policy().compute_dtype
-
-    shape_key = (B, max_len, jnp.dtype(dtype).name)
-    per_model = _DECODE_STEP_CACHE.setdefault(model, {})
-    step = per_model.get(shape_key)
-    if step is None:
-        model_ref = weakref.ref(model)  # break the value->key cycle
-
-        @partial(jax.jit, donate_argnums=(2,))  # cache updated in place
-        def step(params, state, caches, tok, pos):
-            x = tok[:, None]  # [B, 1] token ids; LookupTable embeds them
-            caches = list(caches)
-            y, _ = _step(model_ref(), params, state, x, caches, 0, pos)
-            return y[:, -1], tuple(caches)
-
-        per_model[shape_key] = step
-
+    step = _get_step(model, B, max_len, dtype)
     caches = tuple(init_kv_cache(model, B, max_len, dtype))
     buf = np.full((B, max_len), pad_token, np.int32)
     buf[:, :t0] = toks

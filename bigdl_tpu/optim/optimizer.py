@@ -546,7 +546,41 @@ class Optimizer:
         model_path, optim_path, neval = latest
         self.resume_from(model_path, optim_path)
 
+    def _check_accum_batching(self):
+        """Fail at optimize() start (not mid-epoch on the final partial
+        batch) when gradient accumulation cannot divide every batch: the
+        batcher must drop or pad the remainder and the batch size must be
+        divisible by the accumulation steps."""
+        accum = self.grad_accum_steps
+        if accum <= 1:
+            return
+        batchers = []
+
+        def walk(obj):
+            if obj is None:
+                return
+            if isinstance(obj, SampleToMiniBatch):
+                batchers.append(obj)
+            walk(getattr(obj, "first", None))
+            walk(getattr(obj, "second", None))
+            walk(getattr(obj, "transformer", None))
+            walk(getattr(obj, "base", None))
+
+        walk(self.dataset)
+        for b in batchers:
+            if b.batch_size % accum:
+                raise ConfigurationError(
+                    f"gradient accumulation: batch_size {b.batch_size} not "
+                    f"divisible by accumulation steps {accum}")
+            if not b.drop_last and not b.pad_last:
+                raise ConfigurationError(
+                    "gradient accumulation needs every batch divisible by "
+                    f"{accum}: set drop_last=True or pad_last=True on "
+                    "SampleToMiniBatch so the final partial batch cannot "
+                    "break the microbatch split mid-epoch")
+
     def _optimize_impl(self) -> Module:
+        self._check_accum_batching()
         mesh = Engine.mesh()
         self._mesh = mesh
         model, optim = self.model, self.optim_method
