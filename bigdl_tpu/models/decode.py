@@ -186,18 +186,31 @@ def beam_generate(model, prompt, num_tokens: int, max_len: int,
     dtype = cache_dtype or get_policy().compute_dtype
     rows = B * beam_size
     step = _get_step(model, rows, max_len, dtype)
-    caches = tuple(init_kv_cache(model, rows, max_len, dtype))
     buf = np.full((rows, max_len), pad_token, np.int32)
     buf[:, :t0] = np.repeat(toks, beam_size, axis=0)
+    # prefill with B rows only (all beams are byte-identical until the
+    # first scored step), then expand the caches beam_size-fold — saves
+    # beam_size x the prompt FLOPs/cache traffic for long prompts
+    if t0 > 1 and beam_size > 1:
+        pre = _get_step(model, B, max_len, dtype)
+        caches = tuple(init_kv_cache(model, B, max_len, dtype))
+        for pos in range(t0 - 1):
+            _, caches = pre(model.params, model.state, caches,
+                            jnp.asarray(toks[:, pos]), pos)
+        caches = tuple({k2: jnp.repeat(c[k2], beam_size, axis=0)
+                        for k2 in c} for c in caches)
+    else:
+        caches = tuple(init_kv_cache(model, rows, max_len, dtype))
+        for pos in range(t0 - 1):
+            _, caches = step(model.params, model.state, caches,
+                             jnp.asarray(buf[:, pos]), pos)
     # all beams start as copies of the prompt; only beam 0 may expand on
     # the first scored step, else the top-k would pick duplicates
     scores = np.full((B, beam_size), -np.inf, np.float64)
     scores[:, 0] = 0.0
-    for pos in range(t0 + num_tokens - 1):
+    for pos in range(t0 - 1, t0 + num_tokens - 1):
         logits, caches = step(model.params, model.state, caches,
                               jnp.asarray(buf[:, pos]), pos)
-        if pos + 1 < t0:
-            continue  # prompt prefill
         lp = np.asarray(logits, np.float64).reshape(B, beam_size, -1)
         V = lp.shape[-1]
         flat = (scores[:, :, None] + lp).reshape(B, beam_size * V)

@@ -567,12 +567,19 @@ class Optimizer:
             walk(getattr(obj, "base", None))
 
         walk(self.dataset)
+        try:
+            n_samples = self.dataset.size()
+        except Exception:  # noqa: BLE001 — size is advisory here
+            n_samples = None
         for b in batchers:
             if b.batch_size % accum:
                 raise ConfigurationError(
                     f"gradient accumulation: batch_size {b.batch_size} not "
                     f"divisible by accumulation steps {accum}")
-            if not b.drop_last and not b.pad_last:
+            if not b.drop_last and not b.pad_last and \
+                    (n_samples is None or n_samples % b.batch_size):
+                # a dataset that divides evenly never produces a partial
+                # final batch, so it needs no drop/pad setting
                 raise ConfigurationError(
                     "gradient accumulation needs every batch divisible by "
                     f"{accum}: set drop_last=True or pad_last=True on "

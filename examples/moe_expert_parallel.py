@@ -1,0 +1,84 @@
+"""Mixture-of-experts training with expert parallelism.
+
+Net-new vs the reference (SURVEY.md §2.5 lists EP as absent): a Switch-
+Transformer-style LM (`TransformerLM(num_experts=E, expert_axis="expert")`)
+trained through the standard Optimizer on a {"data", "expert"} mesh — GSPMD
+shards the expert FFN matmuls along the expert axis from the module's
+sharding hints (parallel/expert.MoEFFN), and the explicit
+`expert_parallel_ffn` shard_map path cross-checks the routed math.
+Run: python examples/moe_expert_parallel.py [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+if __package__ in (None, ""):  # run as a script from any cwd
+    import _bootstrap  # noqa: F401
+else:
+    from . import _bootstrap  # noqa: F401
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--num-experts", type=int, default=4)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import Engine
+    from bigdl_tpu.common import set_seed
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.parallel import MoEFFN, expert_parallel_ffn
+
+    n = len(jax.devices())
+    # expert axis = largest divisor of the device count (mesh must cover
+    # every device: data * expert == n)
+    ep = max(d for d in range(1, min(args.num_experts, n) + 1)
+             if n % d == 0)
+    Engine.init(mesh_shape={"data": n // ep, "expert": ep})
+    set_seed(3)
+
+    vocab, t = 12, 8
+    seqs = [[(s + i) % vocab for i in range(t + 1)]
+            for s in range(vocab)] * 8
+    samples = [Sample(np.asarray(s[:-1], np.int32),
+                      np.asarray(s[1:], np.int32)) for s in seqs]
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(32, drop_last=True))
+    model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                          num_heads=4, num_layers=2,
+                          num_experts=args.num_experts,
+                          expert_axis="expert")
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    opt = (Optimizer(model, ds, crit)
+           .set_optim_method(Adam(3e-3))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    trained = opt.optimize()
+    loss = opt.optim_method.hyper["loss"]
+
+    # cross-check: the shard_map all_to_all EP path computes the same MoE
+    # math as the dense/GSPMD module (on an expert-only mesh)
+    from jax.sharding import Mesh
+    moe = MoEFFN(16, 32, 2 * ep, capacity_factor=8.0) \
+        .build(jax.random.key(0)).evaluate()
+    x = jax.random.normal(jax.random.key(1), (8 * ep, 16))
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("expert",))
+    err = float(jnp.max(jnp.abs(
+        expert_parallel_ffn(mesh, moe.params, x, capacity_factor=8.0)
+        - moe.forward(x))))
+    print(f"MoE LM loss after {args.epochs} epochs: {loss:.4f}; "
+          f"shard_map-vs-dense max|diff| = {err:.2e} over {ep} devices")
+    return loss, err
+
+
+if __name__ == "__main__":
+    main()

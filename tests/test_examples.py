@@ -57,3 +57,15 @@ def test_text_classification_example():
     res = text_classification.main(["--n", "256"])
     (_, acc), = [(m, r.result()[0]) for m, r in res]
     assert acc > 0.9
+
+
+def test_moe_expert_parallel_example():
+    from examples import moe_expert_parallel
+    loss, err = moe_expert_parallel.main(["--epochs", "5"])
+    assert loss < 2.4 and err < 1e-3
+
+
+def test_quantized_serving_example():
+    from examples import quantized_serving
+    full, beam = quantized_serving.main(["--epochs", "5"])
+    assert len(full) == 7 and len(beam) == 7
