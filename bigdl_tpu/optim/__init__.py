@@ -2,11 +2,11 @@
 the Optimizer facade (reference: BigDL optim/, SURVEY.md §2.5)."""
 
 from .method import (OptimMethod, SGD, Adam, Adagrad, Adadelta, Adamax,
-                     RMSprop, LBFGS)
+                     RMSprop, LBFGS, EMA)
 from .schedules import (LearningRateSchedule, Default, Poly, Step, MultiStep,
                         EpochDecay, EpochStep, NaturalExp, Exponential,
                         EpochSchedule, Regime, Plateau, SequentialSchedule,
-                        Warmup)
+                        Warmup, CosineDecay)
 from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
                           L1L2Regularizer)
 from .trigger import Trigger

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from .schedules import Default
 
 __all__ = ["OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax",
-           "RMSprop", "LBFGS"]
+           "RMSprop", "LBFGS", "EMA"]
 
 
 class OptimMethod:
@@ -497,3 +497,62 @@ def _strong_wolfe(phi, d, f0, df0, t0, c1=1e-4, c2=0.9, max_ls=25):
     if lo_g is not None and lo_t > 0:
         return lo_t, lo_f, lo_g
     return t, f, g
+
+
+class EMA(OptimMethod):
+    """Wrapper maintaining an exponential moving average of the weights
+    alongside any inner method: shadow = decay*shadow + (1-decay)*params
+    after every update, inside the same compiled step (net-new vs the
+    reference — standard practice for serving-quality weights).
+
+    `ema_params(opt_state)` extracts the averaged weights; after
+    Optimizer.optimize() the trained model keeps the LIVE weights, and
+    `apply_to(model, opt)` swaps in the shadow set for evaluation/export.
+    """
+
+    def __init__(self, inner: OptimMethod, decay: float = 0.999):
+        super().__init__(learning_rate=inner.learning_rate)
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"EMA decay {decay}")
+        self.inner = inner
+        self.decay = decay
+        # start from the inner's driver-state mirror; do NOT rely on the
+        # alias staying shared (the Optimizer rebinds wrapper.hyper), so
+        # LR queries below always pass OUR hyper down explicitly
+        self.hyper = inner.hyper
+
+    # -- pure, jitted ---------------------------------------------------
+    def init_state(self, params):
+        # REAL copies: jnp.asarray would alias the param buffers, and the
+        # compiled step donates params and opt_state separately — aliased
+        # leaves crash with "donate the same buffer twice"
+        return {"inner": self.inner.init_state(params),
+                "shadow": jax.tree.map(jnp.copy, params)}
+
+    def update(self, grads, params, state, lr):
+        new_p, new_inner = self.inner.update(grads, params,
+                                             state["inner"], lr)
+        d = self.decay
+        shadow = jax.tree.map(lambda s, p: d * s + (1 - d) * p,
+                              state["shadow"], new_p)
+        return new_p, {"inner": new_inner, "shadow": shadow}
+
+    # -- host-side ------------------------------------------------------
+    def get_learning_rate(self, driver_state=None) -> float:
+        return self.inner.get_learning_rate(
+            self.hyper if driver_state is None else driver_state)
+
+    def ema_params(self, opt_state):
+        return opt_state["shadow"]
+
+    @staticmethod
+    def apply_to(model, optimizer):
+        """Copy the shadow weights AND the trained non-parameter state
+        (BN running statistics etc. — not averaged, there is only one
+        trained copy) from a finished Optimizer run onto `model`
+        (host-side; returns model)."""
+        shadow = optimizer.optim_method.ema_params(
+            optimizer._final_opt_state)
+        model.params = jax.tree.map(jnp.asarray, shadow)
+        model.state = jax.tree.map(jnp.asarray, optimizer.model.state)
+        return model

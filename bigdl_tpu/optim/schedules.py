@@ -18,7 +18,8 @@ from __future__ import annotations
 
 __all__ = ["LearningRateSchedule", "Default", "Poly", "Step", "MultiStep",
            "EpochDecay", "EpochStep", "NaturalExp", "Exponential",
-           "EpochSchedule", "Regime", "Plateau", "SequentialSchedule", "Warmup"]
+           "EpochSchedule", "Regime", "Plateau", "SequentialSchedule",
+           "Warmup", "CosineDecay"]
 
 import math
 
@@ -189,9 +190,43 @@ class Plateau(LearningRateSchedule):
         return self.current_lr
 
 
+class CosineDecay(LearningRateSchedule):
+    """lr * (min_factor + (1-min_factor) * 0.5*(1+cos(pi * t/T))) over T
+    iterations, then held at lr*min_factor (not in the 2017 reference —
+    the standard modern schedule for TPU training runs; pairs with Warmup
+    via `Warmup(delta, n, after=CosineDecay(T))`)."""
+
+    def __init__(self, max_iteration: int, min_factor: float = 0.0):
+        if max_iteration <= 0:
+            raise ValueError(f"max_iteration {max_iteration}")
+        self.max_iteration = max_iteration
+        self.min_factor = min_factor
+
+    def get_lr(self, optim, state):
+        t = min(state.get("evalCounter", 0), self.max_iteration)
+        cos = 0.5 * (1.0 + math.cos(math.pi * t / self.max_iteration))
+        return optim.learning_rate * (self.min_factor
+                                      + (1.0 - self.min_factor) * cos)
+
+
+class _PeakLR:
+    """Proxy presenting the warmup PEAK as `learning_rate` to the
+    after-schedule while passing every other attribute through."""
+
+    def __init__(self, optim, peak):
+        object.__setattr__(self, "_optim", optim)
+        object.__setattr__(self, "learning_rate", peak)
+
+    def __getattr__(self, name):
+        return getattr(self._optim, name)
+
+
 class Warmup(LearningRateSchedule):
-    """Linear warmup from lr to lr + delta*warmupIteration, then `after`
-    (not in the 2017 reference — standard add-on for large-batch TPU training)."""
+    """Linear warmup from lr to peak = lr + delta*warmupIteration, then
+    `after` continues FROM THE PEAK with a re-zeroed iteration counter
+    (not in the 2017 reference — standard add-on for large-batch TPU
+    training).  `Warmup(delta, n, after=CosineDecay(T))` is therefore the
+    standard continuous ramp-to-peak-then-cosine over n + T iterations."""
 
     def __init__(self, delta: float, warmup_iteration: int,
                  after: LearningRateSchedule = None):
@@ -203,7 +238,10 @@ class Warmup(LearningRateSchedule):
         neval = state.get("evalCounter", 0)
         if neval < self.warmup_iteration:
             return optim.learning_rate + self.delta * neval
-        return self.after.get_lr(optim, state)
+        sub = dict(state)
+        sub["evalCounter"] = neval - self.warmup_iteration
+        peak = optim.learning_rate + self.delta * self.warmup_iteration
+        return self.after.get_lr(_PeakLR(optim, peak), sub)
 
 
 class SequentialSchedule(LearningRateSchedule):

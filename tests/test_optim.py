@@ -236,3 +236,124 @@ def test_tree_nn_accuracy_per_node_targets():
     target = np.array([[1.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
     acc, n = TreeNNAccuracy()(out, target).result()
     assert n == 2 and acc == 1.0
+
+
+def test_cosine_decay_schedule():
+    from bigdl_tpu.optim import SGD, CosineDecay
+
+    sgd = SGD(learning_rate=1.0, learning_rate_schedule=CosineDecay(100))
+    assert abs(sgd.get_learning_rate({"evalCounter": 0}) - 1.0) < 1e-9
+    assert abs(sgd.get_learning_rate({"evalCounter": 50}) - 0.5) < 1e-9
+    assert abs(sgd.get_learning_rate({"evalCounter": 100})) < 1e-9
+    assert abs(sgd.get_learning_rate({"evalCounter": 999})) < 1e-9
+    s2 = SGD(learning_rate=1.0,
+             learning_rate_schedule=CosineDecay(100, min_factor=0.1))
+    assert abs(s2.get_learning_rate({"evalCounter": 100}) - 0.1) < 1e-9
+
+
+def test_warmup_cosine_continuity():
+    """Warmup hands the after-schedule the PEAK lr and a re-zeroed
+    counter: ramp-to-peak then cosine is continuous and T-phased."""
+    from bigdl_tpu.optim import SGD, CosineDecay, Warmup
+
+    sgd = SGD(learning_rate=0.1,
+              learning_rate_schedule=Warmup(0.009, 100,
+                                            after=CosineDecay(1000)))
+    end_warm = sgd.get_learning_rate({"evalCounter": 99})
+    start_cos = sgd.get_learning_rate({"evalCounter": 100})
+    peak = 0.1 + 0.009 * 100
+    assert abs(start_cos - peak) < 0.01 * peak  # continuous at handoff
+    assert abs(end_warm - (peak - 0.009)) < 1e-9
+    # cosine floor is reached T iters AFTER warmup, not at global T
+    assert sgd.get_learning_rate({"evalCounter": 1100}) < 1e-9
+    assert sgd.get_learning_rate({"evalCounter": 600}) > 0.1
+
+
+def test_ema_update_math():
+    """shadow = d*shadow + (1-d)*params after each inner update, exactly."""
+    from bigdl_tpu.optim import EMA, SGD
+
+    inner = SGD(learning_rate=0.5)
+    ema = EMA(inner, decay=0.9)
+    p = {"w": jnp.asarray([1.0, 2.0])}
+    st = ema.init_state(p)
+    np.testing.assert_allclose(np.asarray(st["shadow"]["w"]), [1.0, 2.0])
+    g = {"w": jnp.asarray([1.0, 1.0])}
+    p1, st1 = ema.update(g, p, st, jnp.float32(0.5))
+    np.testing.assert_allclose(np.asarray(p1["w"]), [0.5, 1.5])  # sgd step
+    np.testing.assert_allclose(np.asarray(st1["shadow"]["w"]),
+                               0.9 * np.array([1.0, 2.0])
+                               + 0.1 * np.array([0.5, 1.5]))
+    p2, st2 = ema.update(g, p1, st1, jnp.float32(0.5))
+    np.testing.assert_allclose(
+        np.asarray(st2["shadow"]["w"]),
+        0.9 * np.asarray(st1["shadow"]["w"]) + 0.1 * np.asarray(p2["w"]),
+        rtol=1e-6)
+
+
+def test_ema_through_optimizer_training():
+    """EMA(Adam) trains through the compiled step; the shadow weights are a
+    lagged average (differ from live, same structure) and serve a working
+    model via EMA.apply_to."""
+    from bigdl_tpu.optim import Adam, EMA, Evaluator, Top1Accuracy
+    from bigdl_tpu.utils.engine import Engine
+    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.models import LeNet5
+
+    from bigdl_tpu.common import set_seed
+
+    Engine.reset()
+    Engine.init()
+    set_seed(0)  # order-independent: the model init draws from the global
+    # RNG stream, and convergence at 3 epochs depends on the draw
+    model, opt = make_optimizer()
+    opt.set_optim_method(EMA(Adam(learning_rate=1e-3), decay=0.98))
+    opt.optimize()
+    live = jax.tree.leaves(model.params)
+    shadow = jax.tree.leaves(
+        opt.optim_method.ema_params(opt._final_opt_state))
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(live, shadow))
+    ema_model = EMA.apply_to(LeNet5(10).build(), opt)
+    val = DataSet.array(synthetic_mnist(256, seed=3))
+    acc, _ = Evaluator(ema_model).test(val, [Top1Accuracy()],
+                                       batch_size=64)[0][1].result()
+    assert acc > 0.8, acc
+
+
+def test_ema_apply_to_transfers_bn_state():
+    """apply_to must carry the trained BN running stats, not leave the
+    fresh model's zeros/ones (a BN model would otherwise eval at chance
+    with no error)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.common import set_seed
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.optim import Adam, EMA, Optimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+    from tests.test_e2e_lenet import synthetic_mnist
+
+    Engine.reset()
+    Engine.init()
+    set_seed(0)
+
+    def bn_model():
+        return (nn.Sequential()
+                .add(nn.Reshape((28, 28, 1)))
+                .add(nn.SpatialConvolution(1, 4, 3, 3, 1, 1, -1, -1))
+                .add(nn.SpatialBatchNormalization(4))
+                .add(nn.ReLU())
+                .add(nn.Reshape((28 * 28 * 4,)))
+                .add(nn.Linear(28 * 28 * 4, 10))
+                .add(nn.LogSoftMax()))
+
+    ds = DataSet.array(synthetic_mnist(256)).transform(
+        SampleToMiniBatch(64, drop_last=True))
+    opt = (Optimizer(bn_model(), ds, nn.ClassNLLCriterion())
+           .set_optim_method(EMA(Adam(1e-3), decay=0.95))
+           .set_end_when(Trigger.max_epoch(2)))
+    opt.optimize()
+    fresh = bn_model().build()
+    ema_model = EMA.apply_to(fresh, opt)
+    rm = np.asarray(jax.tree.leaves(ema_model.state)[0])
+    assert np.abs(rm).sum() > 0  # trained running stats, not init zeros
