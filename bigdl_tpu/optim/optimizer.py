@@ -213,10 +213,17 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       is_overwrite: bool = True):
+                       is_overwrite: bool = True,
+                       async_write: bool = False):
+        """async_write=True snapshots to host synchronously but performs
+        pickling + filesystem IO on a background thread
+        (file_io.save_checkpoint_async) — the train loop does not stall
+        on multi-GB writes; pending writes are joined before recovery
+        reads and at the end of the run."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.is_overwrite = is_overwrite
+        self.checkpoint_async = async_write
         return self
 
     def set_train_summary(self, summary):
@@ -522,6 +529,16 @@ class Optimizer:
         return self
 
     def _recover_from_checkpoint(self):
+        # in-flight writes must land before the directory scan; a FAILED
+        # write must not abort recovery (older snapshots remain valid, and
+        # sync-write errors would have been retried the same way)
+        try:
+            file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("async checkpoint write failed before "
+                           "recovery (continuing with older snapshots): %s",
+                           e)
+        self._ckpt_futures = []
         latest = file_io.latest_checkpoint(self.checkpoint_path)
         if latest is None:
             # failure before the first snapshot: the crashed attempt's
@@ -725,6 +742,8 @@ class Optimizer:
             self._maybe_validate(params, net_state, state)
             self._maybe_checkpoint(params, net_state, state, opt_state)
 
+        file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
+        self._ckpt_futures = []  # write errors surfaced above
         # sync the facade with the trained values
         model.params = params
         model.state = net_state
@@ -789,7 +808,19 @@ class Optimizer:
         # the opt_state pytree (momentum / Adam m,v,t slots) must be persisted
         # too — the reference serializes the whole optimMethod incl. its state
         # Table (optim/Optimizer.scala:284-322)
-        file_io.save_checkpoint(
+        is_async = getattr(self, "checkpoint_async", False)
+        if is_async:
+            def writer(*a, **kw):
+                # per-instance future tracking: this run joins only its own
+                # writes, never another Optimizer's
+                fut = file_io.save_checkpoint_async(*a, **kw)
+                self._ckpt_futures = [f for f in
+                                      getattr(self, "_ckpt_futures", [])
+                                      if not f.done()] + [fut]
+                return fut
+        else:
+            writer = file_io.save_checkpoint
+        writer(
             self.checkpoint_path, neval,
             {"params": params, "state": net_state},
             {"method": self.optim_method.state_dict(),
@@ -797,8 +828,9 @@ class Optimizer:
              "driver_state": {k: v for k, v in state.items()
                               if not k.startswith("_")}},
             overwrite=self.is_overwrite)
-        logger.info("checkpoint written at iteration %d -> %s", neval,
-                    self.checkpoint_path)
+        logger.info("checkpoint %s at iteration %d -> %s",
+                    "queued (async)" if is_async else "written",
+                    neval, self.checkpoint_path)
 
 
 class DistriOptimizer(Optimizer):

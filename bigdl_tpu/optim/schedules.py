@@ -211,7 +211,9 @@ class CosineDecay(LearningRateSchedule):
 
 class _PeakLR:
     """Proxy presenting the warmup PEAK as `learning_rate` to the
-    after-schedule while passing every other attribute through."""
+    after-schedule while passing every other attribute through — including
+    WRITES (EpochSchedule's regime side effects must land on the real
+    optimizer, not a throwaway proxy)."""
 
     def __init__(self, optim, peak):
         object.__setattr__(self, "_optim", optim)
@@ -219,6 +221,37 @@ class _PeakLR:
 
     def __getattr__(self, name):
         return getattr(self._optim, name)
+
+    def __setattr__(self, name, value):
+        setattr(self._optim, name, value)
+
+
+class _ShiftedState:
+    """Dict-like view of the driver state with a rebased evalCounter.
+    Reads of every OTHER key and ALL writes pass through to the real
+    state dict, so stateful schedules (Plateau's once-per-epoch marker)
+    keep working under Warmup/SequentialSchedule re-basing — a plain
+    dict copy would silently discard their bookkeeping."""
+
+    def __init__(self, base, eval_counter):
+        self._base = base
+        self._counter = eval_counter
+
+    def get(self, key, default=None):
+        if key == "evalCounter":
+            return self._counter
+        return self._base.get(key, default)
+
+    def __getitem__(self, key):
+        if key == "evalCounter":
+            return self._counter
+        return self._base[key]
+
+    def __setitem__(self, key, value):
+        self._base[key] = value
+
+    def __contains__(self, key):
+        return key == "evalCounter" or key in self._base
 
 
 class Warmup(LearningRateSchedule):
@@ -238,8 +271,7 @@ class Warmup(LearningRateSchedule):
         neval = state.get("evalCounter", 0)
         if neval < self.warmup_iteration:
             return optim.learning_rate + self.delta * neval
-        sub = dict(state)
-        sub["evalCounter"] = neval - self.warmup_iteration
+        sub = _ShiftedState(state, neval - self.warmup_iteration)
         peak = optim.learning_rate + self.delta * self.warmup_iteration
         return self.after.get_lr(_PeakLR(optim, peak), sub)
 
@@ -259,11 +291,9 @@ class SequentialSchedule(LearningRateSchedule):
         offset = 0
         for sched, n in self.entries:
             if neval < offset + n:
-                sub = dict(state)
-                sub["evalCounter"] = neval - offset
-                return sched.get_lr(optim, sub)
+                return sched.get_lr(optim,
+                                    _ShiftedState(state, neval - offset))
             offset += n
         sched, n = self.entries[-1]
-        sub = dict(state)
-        sub["evalCounter"] = neval - offset + n
-        return sched.get_lr(optim, sub)
+        return sched.get_lr(optim,
+                            _ShiftedState(state, neval - offset + n))

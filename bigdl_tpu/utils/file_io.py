@@ -201,6 +201,66 @@ def save_checkpoint(path: str, neval: int, model_blob: Any,
     return mp, op
 
 
+_ASYNC_EXECUTOR = None
+_ASYNC_FUTURES: list = []
+
+
+def save_checkpoint_async(path: str, neval: int, model_blob: Any,
+                          optim_blob: Any, overwrite: bool = True):
+    """Non-blocking save_checkpoint (net-new vs the reference — large
+    snapshots would otherwise stall the train loop for seconds).
+
+    The device→host copy happens SYNCHRONOUSLY here (the caller's arrays
+    are about to be donated back into the compiled step; a background
+    np.asarray would read freed buffers); only pickling + filesystem IO
+    run on the single background writer thread.  Local writes stay atomic
+    (LocalFileSystem tmp+rename).  Errors surface on the next
+    `wait_for_async_checkpoints()`/`join_checkpoints` call — or HERE at
+    submission when backpressure joins an older write.
+
+    Backpressure: at most 2 snapshots may be pending; a faster checkpoint
+    cadence than the storage can absorb blocks on the oldest write instead
+    of accumulating full host copies until OOM.  Returns the future."""
+    global _ASYNC_EXECUTOR
+    model_blob = _to_numpy(model_blob)
+    optim_blob = _to_numpy(optim_blob)
+    if _ASYNC_EXECUTOR is None:
+        from concurrent.futures import ThreadPoolExecutor
+        # one worker: checkpoints must land in submission order
+        _ASYNC_EXECUTOR = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bigdl-ckpt")
+    _ASYNC_FUTURES[:] = [f for f in _ASYNC_FUTURES if not f.done()]
+    while len(_ASYNC_FUTURES) >= 2:
+        oldest = _ASYNC_FUTURES.pop(0)
+        oldest.result()  # raises in the train loop, like a sync write
+    fut = _ASYNC_EXECUTOR.submit(
+        save_checkpoint, path, neval, model_blob, optim_blob, overwrite)
+    _ASYNC_FUTURES.append(fut)
+    return fut
+
+
+def join_checkpoints(futures) -> None:
+    """Join EVERY future, then re-raise the first error (a first-error
+    early return would leave later writes in flight with errors lost)."""
+    first_err = None
+    for f in futures:
+        try:
+            f.result()
+        except Exception as e:  # noqa: BLE001 — collected, re-raised below
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+
+
+def wait_for_async_checkpoints() -> None:
+    """Block until every pending async checkpoint is on disk; re-raises
+    the first write error (after all have been joined)."""
+    global _ASYNC_FUTURES
+    futs, _ASYNC_FUTURES = _ASYNC_FUTURES, []
+    join_checkpoints(futs)
+
+
 def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
     """Find the newest (model, optimMethod, neval) triple
     (getLatestFile, DistriOptimizer.scala:828-845)."""

@@ -357,3 +357,29 @@ def test_ema_apply_to_transfers_bn_state():
     ema_model = EMA.apply_to(fresh, opt)
     rm = np.asarray(jax.tree.leaves(ema_model.state)[0])
     assert np.abs(rm).sum() > 0  # trained running stats, not init zeros
+
+
+def test_warmup_preserves_plateau_bookkeeping():
+    """Warmup's counter re-basing must pass schedule writes through to the
+    REAL state dict: Plateau counts one observation per epoch, not one per
+    iteration (a dict copy would drop its _plateau_seen marker and the LR
+    would collapse patience-fold too fast)."""
+    from bigdl_tpu.optim import SGD, Warmup
+    from bigdl_tpu.optim.schedules import Plateau
+
+    sched = Warmup(0.0, 2, after=Plateau(monitor="score", patience=3,
+                                         factor=0.1, mode="max"))
+    sgd = SGD(learning_rate=0.1, learning_rate_schedule=sched)
+    state = {"evalCounter": 5, "epoch": 1, "score": 1.0}
+    for _ in range(10):  # many iterations inside ONE epoch
+        lr = sgd.get_learning_rate(state)
+    assert abs(lr - 0.1) < 1e-9  # patience must not tick per iteration
+    # non-improving epochs tick patience once each; the 3rd (epoch 4)
+    # fires the drop
+    for epoch in (2, 3):
+        state["epoch"] = epoch
+        lr = sgd.get_learning_rate(state)
+        assert abs(lr - 0.1) < 1e-9, (epoch, lr)
+    state["epoch"] = 4
+    lr = sgd.get_learning_rate(state)
+    assert abs(lr - 0.01) < 1e-9, lr
