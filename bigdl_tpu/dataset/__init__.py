@@ -36,7 +36,7 @@ __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "SampleToMiniBatch", "MTSampleToMiniBatch", "Identity", "SentenceSplitter",
            "SentenceTokenizer", "SentenceBiPadding", "Dictionary",
            "LabeledSentence", "TextToLabeledSentence",
-           "LabeledSentenceToSample"]
+           "LabeledSentenceToSample", "StreamingRecordDataSet"]
 
 
 class AbstractDataSet:
@@ -164,6 +164,114 @@ class DistributedDataSet(AbstractDataSet):
             yield self._all[i]
 
 
+class StreamingRecordDataSet(AbstractDataSet):
+    """Epoch-streaming BDRecord shards: records are read from disk every
+    pass instead of being materialized — the out-of-core path for corpora
+    near or beyond host memory (the reference streams SequenceFiles from
+    HDFS the same way, never caching the decoded records when
+    `.cache()` is not requested; DataSet.scala:319).
+
+    Shuffling permutes SHARD order per epoch (records inside a shard keep
+    file order — shard-granular shuffle, like Spark partition shuffling);
+    for record-level shuffling write more, smaller shards.  Under
+    `distributed=True` each process streams a strided, disjoint subset of
+    the shard list by rank (shard count must divide the process count —
+    silent tail-dropping would exclude shards from every eval pass), and
+    every process truncates its epoch to the SMALLEST rank's record count
+    for the current shard order, preserving the equal-step invariant the
+    per-step collectives require (see DistributedDataSet.data).  Shard
+    record counts come from a header-walk (recordio.count_records) — no
+    decoding.  `num_threads` streams through the native prefetcher within
+    each process for TRAINING passes; eval passes always use the
+    sequential reader so output order matches input order (Predictor
+    aligns predictions positionally).
+    """
+
+    def __init__(self, paths, seed: int = 1, num_threads: int = 0,
+                 distributed: bool = False,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.paths = [str(p) for p in paths]
+        if not self.paths:
+            raise FileNotFoundError("no record shards")
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(len(self.paths))
+        self.num_threads = num_threads
+        self.distributed = distributed
+        self._explicit_shard = (process_index, process_count)
+        self._counts = None
+
+    def _shard(self):
+        import jax
+        pi, pc = self._explicit_shard
+        if pi is not None and pc is not None:
+            return pi, pc
+        from ..utils.engine import Engine
+        if Engine._mesh is not None:
+            si, sc = Engine.data_shard_info()
+        else:  # no mesh yet: blind per-process slice (the default-DP layout)
+            si, sc = jax.process_index(), jax.process_count()
+        return (si if pi is None else pi, sc if pc is None else pc)
+
+    def _shard_counts(self):
+        if self._counts is None:
+            from ..utils.recordio import count_records
+            self._counts = [count_records(p) for p in self.paths]
+        return self._counts
+
+    def size(self) -> int:
+        return sum(self._shard_counts())
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._order)
+
+    def _plan(self, order):
+        """(my_paths, record_cap) for this epoch's shard order."""
+        if not self.distributed:
+            return [self.paths[i] for i in order], None
+        rank, count = self._shard()
+        if count > 1 and len(self.paths) % count:
+            raise ValueError(
+                f"streaming dataset: {len(self.paths)} shards not "
+                f"divisible by {count} processes — tail shards would be "
+                "silently excluded from every pass; re-shard the corpus")
+        if count <= 1:
+            return [self.paths[i] for i in order], None
+        counts = self._shard_counts()
+        per_rank = [sum(counts[i] for i in order[r::count])
+                    for r in range(count)]
+        cap = min(per_rank)  # equal steps on every host (collective safety)
+        return [self.paths[i] for i in order[rank::count]], cap
+
+    def data(self, train: bool) -> Iterator:
+        import pickle
+        order = self._order if train else np.arange(len(self.paths))
+        paths, cap = self._plan(order)
+        emitted = 0
+
+        def within_cap():
+            return cap is None or emitted < cap
+
+        if train and self.num_threads > 0:
+            from ..utils import native
+            if native.is_native_loaded() and native.has_prefetch():
+                with native.NativePrefetchReader(
+                        paths, num_threads=self.num_threads) as reader:
+                    for payload in reader:
+                        if not within_cap():
+                            return
+                        emitted += 1
+                        yield pickle.loads(payload)
+                return
+        from ..utils.recordio import read_records
+        for p in paths:
+            for rec in read_records(p):
+                if not within_cap():
+                    return
+                emitted += 1
+                yield rec
+
+
 class TransformedDataSet(AbstractDataSet):
     """DataSet + transformer chain (reference: DataSet.transform,
     DataSet.scala:70)."""
@@ -269,3 +377,21 @@ class DataSet:
         if records is None:
             records = [rec for p in paths for rec in read_records(p)]
         return DataSet.array(records, distributed=distributed, seed=seed)
+
+    @staticmethod
+    def record_stream(pattern, distributed: bool = False, seed: int = 1,
+                      num_threads: int = 0, process_index=None,
+                      process_count=None):
+        """Out-of-core variant of record_files: shards are re-read from
+        disk every epoch (shard-granular shuffle) instead of cached in
+        memory — see StreamingRecordDataSet."""
+        import glob as _glob
+        paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
+                 else list(pattern))
+        if not paths:
+            raise FileNotFoundError(f"no record shards match {pattern!r}")
+        return StreamingRecordDataSet(paths, seed=seed,
+                                      num_threads=num_threads,
+                                      distributed=distributed,
+                                      process_index=process_index,
+                                      process_count=process_count)

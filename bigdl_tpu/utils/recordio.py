@@ -26,7 +26,8 @@ import pickle
 import struct
 from typing import Any, Iterable, Iterator, List
 
-__all__ = ["write_records", "read_records", "write_record_bytes",
+__all__ = ["write_records", "read_records", "count_records",
+           "write_record_bytes",
            "read_record_bytes", "masked_crc32c"]
 
 
@@ -175,3 +176,22 @@ def read_records(path: str) -> Iterator[Any]:
         with opener(p) as reader:
             for payload in reader:
                 yield pickle.loads(payload)
+
+
+def count_records(path: str) -> int:
+    """Count records in one shard by walking the frame headers (length +
+    seek past payload) — no CRC check, no unpickling; used by streaming
+    datasets to size/balance a corpus without decoding it."""
+    import struct
+
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if not hdr:
+                return n
+            if len(hdr) < 8:
+                raise IOError(f"truncated record header in {path!r}")
+            (length,) = struct.unpack("<Q", hdr)
+            f.seek(4 + length + 4, 1)
+            n += 1

@@ -262,3 +262,138 @@ def test_gather_rows_heterogeneous_matches_np_stack():
     ref = np.stack(rows)
     assert got.dtype == ref.dtype
     np.testing.assert_array_equal(got, ref)
+
+
+class TestStreamingRecordDataSet:
+    """Out-of-core shard streaming (DataSet.record_stream)."""
+
+    def _shards(self, tmp_path, n_shards=4, per_shard=25):
+        from bigdl_tpu.utils.recordio import write_records
+        paths = []
+        k = 0
+        for s in range(n_shards):
+            p = str(tmp_path / f"s{s}.bd")
+            write_records(p, list(range(k, k + per_shard)))
+            k += per_shard
+            paths.append(p)
+        return paths
+
+    def test_streams_all_records_every_epoch(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet
+
+        paths = self._shards(tmp_path)
+        ds = DataSet.record_stream(paths)
+        assert ds.size() == 100
+        first = list(ds.data(train=True))
+        assert sorted(first) == list(range(100))
+        ds.shuffle()
+        second = list(ds.data(train=True))
+        assert sorted(second) == list(range(100))
+        # shard-granular shuffle: different shard order is possible, but
+        # within-shard order is preserved
+        for s in range(4):
+            blk = [x for x in second if s * 25 <= x < (s + 1) * 25]
+            assert blk == list(range(s * 25, (s + 1) * 25))
+
+    def test_eval_pass_is_deterministic(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet
+
+        paths = self._shards(tmp_path)
+        ds = DataSet.record_stream(paths)
+        ds.shuffle()
+        assert list(ds.data(train=False)) == list(range(100))
+
+    def test_native_threads_same_multiset(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.utils import native
+
+        if not (native.is_native_loaded() and native.has_prefetch()):
+            pytest.skip("native prefetch unavailable")
+        paths = self._shards(tmp_path)
+        ds = DataSet.record_stream(paths, num_threads=3)
+        assert sorted(ds.data(train=True)) == list(range(100))
+
+    def test_distributed_strided_disjoint(self, tmp_path):
+        """Real sharding path via explicit process_index/process_count:
+        ranks stream disjoint shard subsets covering the corpus."""
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+
+        paths = self._shards(tmp_path, n_shards=6)
+        seen = []
+        for rank in range(3):
+            ds = StreamingRecordDataSet(paths, distributed=True,
+                                        process_index=rank,
+                                        process_count=3)
+            seen.append(sorted(ds.data(train=True)))
+        flat = [x for part in seen for x in part]
+        assert sorted(flat) == sorted(set(flat))  # disjoint
+        assert len(flat) == 150  # 6 shards x 25, all covered
+
+    def test_distributed_indivisible_shards_rejected(self, tmp_path):
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+
+        paths = self._shards(tmp_path, n_shards=5)
+        ds = StreamingRecordDataSet(paths, distributed=True,
+                                    process_index=0, process_count=3)
+        with pytest.raises(ValueError, match="not.*divisible|divisible"):
+            list(ds.data(train=True))
+
+    def test_distributed_unequal_shards_equal_steps(self, tmp_path):
+        """Unequal shard sizes: every rank truncates to the smallest
+        rank's record count for the epoch (collective-step safety)."""
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+        from bigdl_tpu.utils.recordio import write_records
+
+        paths = []
+        for s, n in enumerate([30, 20]):  # rank0 shard bigger than rank1
+            p = str(tmp_path / f"u{s}.bd")
+            write_records(p, list(range(n)))
+            paths.append(p)
+        lens = []
+        for rank in range(2):
+            ds = StreamingRecordDataSet(paths, distributed=True,
+                                        process_index=rank, process_count=2)
+            lens.append(len(list(ds.data(train=True))))
+        assert lens[0] == lens[1] == 20
+
+    def test_eval_pass_sequential_even_with_threads(self, tmp_path):
+        """train=False must preserve input order (Predictor aligns outputs
+        positionally) even when num_threads requests the interleaving
+        prefetcher for training passes."""
+        from bigdl_tpu.dataset import DataSet
+
+        paths = self._shards(tmp_path)
+        ds = DataSet.record_stream(paths, num_threads=4)
+        assert list(ds.data(train=False)) == list(range(100))
+
+    def test_size_counts_without_decoding(self, tmp_path):
+        from bigdl_tpu.utils.recordio import count_records
+
+        paths = self._shards(tmp_path, n_shards=2, per_shard=7)
+        assert [count_records(p) for p in paths] == [7, 7]
+
+    def test_trains_through_optimizer(self, tmp_path):
+        """End-to-end: stream shards -> transform -> train (the dataset is
+        re-read from disk each epoch)."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.models import LeNet5
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+        from bigdl_tpu.utils.engine import Engine
+        from bigdl_tpu.utils.recordio import write_records
+        from tests.test_e2e_lenet import synthetic_mnist
+
+        Engine.reset()
+        Engine.init()
+        samples = synthetic_mnist(256)
+        write_records(str(tmp_path / "mnist.bd"), samples, shards=4)
+        paths = sorted(str(p) for p in tmp_path.glob("mnist.bd-*"))
+        ds = DataSet.record_stream(paths).transform(
+            SampleToMiniBatch(64, drop_last=True))
+        opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+               .set_optim_method(Adam(1e-3))
+               .set_end_when(Trigger.max_epoch(6)))
+        opt.optimize()
+        # shard-granular shuffle mixes less than record-level, so allow
+        # a couple more epochs than the in-memory path needs
+        assert opt.optim_method.hyper["loss"] < 1.0
