@@ -92,14 +92,25 @@ class ClassNLLCriterion(Criterion):
     1-based labels made 0 the natural pad sentinel — 0-based labels need an
     explicit one).  An out-of-range-high label yields NaN loss (JAX gathers
     fill out-of-bounds with NaN) — the reference instead threw
-    `curTarget >= 1 && curTarget <= nClasses`; watch the logged loss."""
+    `curTarget >= 1 && curTarget <= nClasses`; watch the logged loss.
+
+    `label_smoothing=eps` (net-new vs the reference) mixes the one-hot
+    target with the uniform distribution: loss = (1-eps)*NLL(target) +
+    eps*mean over classes of -log p — the standard regularizer for
+    large-vocab/ImageNet training.  Incompatible with per-class weights."""
 
     def __init__(self, weights=None, size_average: bool = True,
-                 one_based: bool = False):
+                 one_based: bool = False, label_smoothing: float = 0.0):
         super().__init__()
         self.weights = weights
         self.size_average = size_average
         self.one_based = one_based
+        if not 0.0 <= label_smoothing < 1.0:
+            raise ValueError(f"label_smoothing {label_smoothing}")
+        if label_smoothing and weights is not None:
+            raise ValueError("label_smoothing with per-class weights is "
+                             "not supported")
+        self.label_smoothing = label_smoothing
 
     def loss(self, output, target):
         t = target.astype(jnp.int32).reshape(-1)
@@ -108,6 +119,14 @@ class ClassNLLCriterion(Criterion):
         valid = t >= 0
         picked = jnp.take_along_axis(output, jnp.maximum(t, 0)[:, None],
                                      axis=1)[:, 0]
+        if self.label_smoothing:
+            eps = self.label_smoothing
+            uniform = -jnp.mean(output, axis=-1)  # -E_uniform[log p]
+            smoothed = jnp.where(valid,
+                                 (1 - eps) * (-picked) + eps * uniform, 0.0)
+            if self.size_average:
+                return jnp.sum(smoothed) / jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(smoothed)
         if self.weights is not None:
             w = jnp.take(self.weights, jnp.maximum(t, 0)) * valid
             total = -jnp.sum(w * picked)
@@ -124,9 +143,10 @@ class CrossEntropyCriterion(Criterion):
     logits."""
 
     def __init__(self, weights=None, size_average: bool = True,
-                 one_based: bool = False):
+                 one_based: bool = False, label_smoothing: float = 0.0):
         super().__init__()
-        self._nll = ClassNLLCriterion(weights, size_average, one_based)
+        self._nll = ClassNLLCriterion(weights, size_average, one_based,
+                                      label_smoothing)
 
     def loss(self, output, target):
         return self._nll.loss(jax.nn.log_softmax(output, axis=-1), target)

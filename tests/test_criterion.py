@@ -129,3 +129,39 @@ def test_dice():
     c = nn.DiceCoefficientCriterion()
     o = jnp.asarray([[1.0, 1.0]])
     np.testing.assert_allclose(float(c.forward(o, o)), 0.0, atol=1e-6)
+
+
+def test_label_smoothing_matches_torch():
+    """CrossEntropyCriterion(label_smoothing=eps) == torch
+    F.cross_entropy(..., label_smoothing=eps)."""
+    import pytest
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 7)).astype(np.float32)
+    labels = rng.integers(0, 7, size=16)
+    for eps in (0.0, 0.1, 0.3):
+        got = float(nn.CrossEntropyCriterion(label_smoothing=eps).loss(
+            jnp.asarray(logits), jnp.asarray(labels)))
+        ref = float(torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels),
+            label_smoothing=eps))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_label_smoothing_ignores_padding():
+    logits = jnp.asarray(np.random.default_rng(1)
+                         .normal(size=(4, 5)).astype(np.float32))
+    labels = jnp.asarray([2, -1, 0, -1])  # two padded rows
+    crit = nn.ClassNLLCriterion(label_smoothing=0.1)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    full = crit.loss(lp, labels)
+    sub = crit.loss(lp[jnp.asarray([0, 2])], jnp.asarray([2, 0]))
+    np.testing.assert_allclose(float(full), float(sub), rtol=1e-6)
+
+
+def test_label_smoothing_rejects_weights():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        nn.ClassNLLCriterion(weights=jnp.ones(5), label_smoothing=0.1)
