@@ -165,7 +165,8 @@ def _validate_generate(model, toks, num_tokens, max_len):
 
 
 def beam_generate(model, prompt, num_tokens: int, max_len: int,
-                  beam_size: int = 4, pad_token: int = 0, cache_dtype=None):
+                  beam_size: int = 4, pad_token: int = 0,
+                  eos_token: int = None, cache_dtype=None):
     """Beam-search decoding over the KV cache: keeps the `beam_size`
     highest-total-log-prob hypotheses per batch row; returns the best
     sequence(s), [t0+num_tokens] for a 1-D prompt else [B, t0+num_tokens].
@@ -174,13 +175,21 @@ def beam_generate(model, prompt, num_tokens: int, max_len: int,
     in LogSoftMax) so per-step scores sum to a sequence log-prob.
     beam_size=1 reduces exactly to greedy.  Per step, the KV caches are
     reordered along the row axis to follow the surviving hypotheses
-    (device-side jnp.take)."""
+    (device-side jnp.take).
+
+    eos_token: a finished hypothesis (one that emitted eos_token) stops
+    accumulating log-prob — its only continuation is `pad_token` at score
+    0 — so shorter finished sequences compete fairly against longer live
+    ones and are padded to length in the output."""
     prompt_arr = np.asarray(prompt, np.int32)
     toks = prompt_arr[None, :] if prompt_arr.ndim == 1 else prompt_arr
     B, t0 = toks.shape
     _validate_generate(model, toks, num_tokens, max_len)
     if beam_size < 1:
         raise ValueError(f"beam_size {beam_size}")
+    if eos_token is not None and eos_token == pad_token:
+        raise ValueError("eos_token must differ from pad_token (padding "
+                         "marks the post-EOS tail)")
 
     from ..common import get_policy
     dtype = cache_dtype or get_policy().compute_dtype
@@ -208,11 +217,18 @@ def beam_generate(model, prompt, num_tokens: int, max_len: int,
     # the first scored step, else the top-k would pick duplicates
     scores = np.full((B, beam_size), -np.inf, np.float64)
     scores[:, 0] = 0.0
+    finished = np.zeros((B, beam_size), bool)
     for pos in range(t0 - 1, t0 + num_tokens - 1):
         logits, caches = step(model.params, model.state, caches,
                               jnp.asarray(buf[:, pos]), pos)
         lp = np.asarray(logits, np.float64).reshape(B, beam_size, -1)
         V = lp.shape[-1]
+        if eos_token is not None and finished.any():
+            # a finished beam's only continuation is pad at logprob 0:
+            # its score freezes and it keeps competing in the top-k
+            lp = np.where(finished[:, :, None], -np.inf, lp)
+            lp[:, :, pad_token] = np.where(finished, 0.0,
+                                           lp[:, :, pad_token])
         flat = (scores[:, :, None] + lp).reshape(B, beam_size * V)
         k = min(beam_size, flat.shape[1])
         top = np.argpartition(flat, -k, axis=-1)[:, -k:]
@@ -232,6 +248,11 @@ def beam_generate(model, prompt, num_tokens: int, max_len: int,
                 caches = tuple({k2: jnp.take(c[k2], gidx, axis=0)
                                 for k2 in c} for c in caches)
         buf[:, pos + 1] = tok.reshape(-1)
+        if eos_token is not None:
+            finished = np.take_along_axis(finished, src, axis=1) | \
+                (tok == eos_token)
+            if finished.all():
+                break  # buf is pad-prefilled; remaining steps are no-ops
     out = buf.reshape(B, beam_size, max_len)[:, 0, : t0 + num_tokens]
     return out[0] if prompt_arr.ndim == 1 else out
 

@@ -204,3 +204,30 @@ class TestBeamGenerate:
                             max_len=10, beam_size=3)
         assert out.shape == (3, 6)
         assert (out[:, :2] == [[1, 2], [3, 4], [5, 6]]).all()
+
+
+def test_beam_eos_freezes_finished_hypotheses():
+    """With eos_token set, a hypothesis that emits EOS stops accumulating
+    log-prob (pad-only continuation at score 0) and comes back padded."""
+    import numpy as np
+    import pytest
+    from bigdl_tpu.models import TransformerLM, beam_generate
+    from bigdl_tpu.models.transformer_lm import greedy_generate
+    from bigdl_tpu.common import set_seed
+
+    set_seed(11)
+    vocab, t = 16, 12
+    model = TransformerLM(vocab_size=vocab, max_len=t, d_model=32,
+                          num_heads=4, num_layers=2).build()
+    # DETERMINISTIC EOS emission: make the model's own greedy next token
+    # the EOS — the top beam necessarily emits it at the first scored step
+    eos = int(np.asarray(greedy_generate(model, [1, 2], 1, t))[-1])
+    out = beam_generate(model, [[1, 2]], num_tokens=8, max_len=t,
+                        beam_size=4, eos_token=eos, pad_token=0)
+    row = np.asarray(out)[0]
+    where = np.where(row == eos)[0]
+    assert where.size > 0, row  # EOS must actually appear
+    assert (row[int(where[0]) + 1:] == 0).all(), row
+    # eos == pad is a config error
+    with pytest.raises(ValueError):
+        beam_generate(model, [[1]], 2, t, eos_token=0, pad_token=0)
