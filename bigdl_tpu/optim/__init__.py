@@ -11,6 +11,7 @@ from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
                           L1L2Regularizer)
 from .trigger import Trigger
 from .validation import (ValidationResult, AccuracyResult, LossResult,
+                         Perplexity, PerplexityResult,
                          ValidationMethod, Top1Accuracy, Top5Accuracy, Loss,
                          MAE, HitRatio, NDCG, TreeNNAccuracy)
 from .metrics import Metrics

@@ -765,9 +765,13 @@ class Optimizer:
             logger.info("Validation %s: %s", method.name, res)
             if method.name in ("Top1Accuracy", "Top5Accuracy"):
                 state["score"] = val
-            elif method.name == "Loss":
-                # early-stopping triggers (Trigger.plateau) monitor this
+            elif method.name in ("Loss", "Perplexity"):
+                # early-stopping triggers (Trigger.plateau) monitor this;
+                # perplexity is loss-like (lower is better)
                 state["val_loss"] = val
+            # every metric is also exposed under its own name so custom
+            # triggers/schedules can monitor it directly
+            state[method.name] = val
             if self.validation_summary is not None:
                 self.validation_summary.add_scalar(
                     method.name, val, state["neval"] - 1)

@@ -12,6 +12,7 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["ValidationResult", "AccuracyResult", "LossResult",
+           "Perplexity", "PerplexityResult",
            "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss", "MAE",
            "HitRatio", "NDCG", "TreeNNAccuracy"]
 
@@ -120,6 +121,46 @@ class Loss(ValidationMethod):
         l = float(self.criterion.loss(jnp.asarray(output), jnp.asarray(target)))
         n = int(np.asarray(target).shape[0])
         return LossResult(l * n, n)
+
+
+class PerplexityResult(ValidationResult):
+    """Aggregates total token NLL + token count; result() = exp(mean)."""
+
+    def __init__(self, nll: float, count: int):
+        self.nll, self.count = nll, count
+
+    def result(self):
+        # np.exp: overflows to inf (a diverged model or raw-logit misuse
+        # must report ppl=inf, not crash the validation logging)
+        return (float(np.exp(self.nll / max(self.count, 1))), self.count)
+
+    def __add__(self, other):
+        return PerplexityResult(self.nll + other.nll,
+                                self.count + other.count)
+
+    def __repr__(self):
+        p, n = self.result()
+        return f"Perplexity(ppl: {p:.4f}, tokens: {n})"
+
+
+class Perplexity(ValidationMethod):
+    """exp(mean per-token NLL) over [B, T, vocab] log-prob outputs and
+    [B, T] integer targets — the LM metric (net-new vs the 2017 reference,
+    whose only sequence metric is per-batch Loss; pairs with TransformerLM
+    / SimpleRNN outputs which end in LogSoftMax).  Negative targets are
+    padding and excluded from both the NLL sum and the token count."""
+
+    name = "Perplexity"
+
+    def __call__(self, output, target):
+        o = np.asarray(output, np.float64)
+        t = np.asarray(target).astype(np.int64)
+        o2 = o.reshape(-1, o.shape[-1])
+        t2 = t.reshape(-1)
+        valid = t2 >= 0
+        picked = o2[np.arange(t2.shape[0]), np.maximum(t2, 0)]
+        nll = float(-np.sum(picked[valid]))
+        return PerplexityResult(nll, int(valid.sum()))
 
 
 class MAE(ValidationMethod):

@@ -383,3 +383,29 @@ def test_warmup_preserves_plateau_bookkeeping():
     state["epoch"] = 4
     lr = sgd.get_learning_rate(state)
     assert abs(lr - 0.01) < 1e-9, lr
+
+
+def test_perplexity_metric():
+    """exp(mean token NLL) with padding exclusion; aggregation across
+    batches matches one big batch."""
+    from bigdl_tpu.optim import Perplexity
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(2, 5, 7)).astype(np.float32)
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    tgt = np.array([[1, 2, 3, -1, -1], [0, 6, 5, 4, -1]])
+    m = Perplexity()
+    r = m(lp, tgt)
+    ppl, n = r.result()
+    assert n == 7  # 3 + 4 valid tokens
+    manual = -np.mean([lp[b, t, tgt[b, t]]
+                       for b in range(2) for t in range(5)
+                       if tgt[b, t] >= 0])
+    np.testing.assert_allclose(ppl, np.exp(manual), rtol=1e-6)
+    # additive aggregation == single evaluation
+    r2 = m(lp[:1], tgt[:1]) + m(lp[1:], tgt[1:])
+    np.testing.assert_allclose(r2.result()[0], ppl, rtol=1e-12)
+    # uniform log-probs -> ppl == vocab
+    uni = np.full((1, 4, 7), -np.log(7.0))
+    np.testing.assert_allclose(m(uni, np.zeros((1, 4), int)).result()[0],
+                               7.0, rtol=1e-6)
