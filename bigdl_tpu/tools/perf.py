@@ -34,7 +34,7 @@ _MODELS = {"inception_v1": ("inception", 1000),
 
 
 def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
-        profile_dir: str = None):
+        profile_dir: str = None, num_experts: int = 0):
     from ..models.run import _build_model, build_criterion
     from ..optim import SGD, Optimizer, Trigger
     from ..utils.engine import Engine
@@ -43,7 +43,11 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
     Engine.init()
     mesh = Engine.mesh()
     zoo_name, classes = _MODELS[model_name]
-    model, input_hw, crit = _build_model(zoo_name, classes)
+    if num_experts and zoo_name != "transformer":
+        raise ValueError(f"--num-experts applies to the transformer only; "
+                         f"{model_name} would silently bench the dense "
+                         "model")
+    model, input_hw, crit = _build_model(zoo_name, classes, num_experts)
     criterion = build_criterion(crit)
     model.build(jax.random.key(0))
     opt = Optimizer(model, dataset=None, criterion=criterion,
@@ -81,7 +85,8 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
         one()
     fetch_scalar(one())
     dt, detail = measure_step_seconds(one, n2=max(iters, 8))
-    out = {"model": model_name, "batch_size": batch_size,
+    out = {"model": model_name,
+        **({"num_experts": num_experts} if num_experts else {}), "batch_size": batch_size,
            "step_seconds": dt, "records_per_second": batch_size / dt,
            "compile_seconds": compile_s, "timing": detail,
            "device": str(jax.devices()[0])}
@@ -102,9 +107,13 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler xplane trace of the step here")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="transformer only: bench the Switch-style MoE "
+                         "variant (parallel/expert.MoEFFN)")
     args = ap.parse_args(argv)
     print(json.dumps(run(args.model, args.batch_size, args.iters,
-                         args.warmup, profile_dir=args.profile_dir)))
+                         args.warmup, profile_dir=args.profile_dir,
+                         num_experts=args.num_experts)))
 
 
 if __name__ == "__main__":
