@@ -229,6 +229,41 @@ class Module:
         """name -> params dict (BigDL: getParametersTable, used by summaries)."""
         return {self.name: self.params}
 
+    def summary(self, print_fn=print) -> str:
+        """Keras/torchsummary-style parameter table (net-new ergonomics vs
+        the reference, whose closest analog is the bare __repr__ tree):
+        one row per leaf module with its parameter count and dtypes, plus
+        totals.  Returns the rendered string (also sent to print_fn)."""
+        if self.params is None:
+            self.build()
+        rows = []
+
+        def count(p):
+            leaves = jax.tree.leaves(p)
+            return (sum(l.size for l in leaves),
+                    ",".join(sorted({str(l.dtype) for l in leaves})) or "-")
+
+        def walk(module, params, depth):
+            n, dt = count(params)
+            label = "  " * depth + type(module).__name__
+            rows.append((label, n, dt))
+            if isinstance(module, Container):
+                for m, p in zip(module.modules, params):
+                    walk(m, p, depth + 1)
+
+        walk(self, self.params, 0)
+        width = max(len(r[0]) for r in rows) + 2
+        total = rows[0][1]  # the root row already counted everything
+        body = [f"{lbl:<{width}}{n:>12,}  {dt}" for lbl, n, dt in rows]
+        header = f"{'Layer':<{width}}{'Params':>12}  Dtypes"
+        rule = "-" * max(len(header), max(len(b) for b in body))
+        lines = ([header, rule] + body
+                 + [rule, f"{'Total':<{width}}{total:>12,}"])
+        text = "\n".join(lines)
+        if print_fn is not None:
+            print_fn(text)
+        return text
+
     # -- native-format persistence ------------------------------------
     # (reference: Module.save/Module.load, nn/Module.scala:41 over JVM
     # serialization in utils/File.scala; here: pickle of the module with

@@ -320,3 +320,19 @@ def test_gradient_check_small_mlp():
         fd = (f(p_plus) - f(p_minus)) / (2 * eps)
         np.testing.assert_allclose(float(g[0]["weight"][idx]), float(fd),
                                    rtol=1e-2, atol=1e-3)
+
+
+def test_module_summary():
+    """summary(): one row per module, accurate totals, container nesting."""
+    m = (nn.Sequential()
+         .add(nn.Linear(4, 8))
+         .add(nn.ReLU())
+         .add(nn.Linear(8, 2))).build(rng())
+    text = m.summary(print_fn=None)
+    assert "Sequential" in text and text.count("Linear") == 2
+    total = 4 * 8 + 8 + 8 * 2 + 2
+    assert f"{total:,}" in text.splitlines()[-1]
+    # a parameter-free leaf renders with 0 params
+    relu_line = [l for l in text.splitlines() if "ReLU" in l][0]
+    assert " 0  " in relu_line or relu_line.rstrip().endswith("-") or \
+        " 0 " in relu_line
