@@ -14,6 +14,7 @@ from .transformer_lm import (PositionalEmbedding, TransformerBlock,
                              TransformerLM)
 from .treelstm_sentiment import TreeLSTMSentiment, encode_tree
 from .vgg import Vgg_16, Vgg_19, VggForCifar10
+from .vit import ViT
 
 __all__ = [
     "AlexNet", "Autoencoder", "Inception_Layer_v1", "Inception_Layer_v2",
@@ -23,5 +24,5 @@ __all__ = [
     "TextClassifier", "TransformerBlock", "TransformerLM",
     "TreeLSTMSentiment", "beam_generate", "cached_generate",
     "encode_tree", "init_kv_cache",
-    "Vgg_16", "Vgg_19", "VggForCifar10",
+    "Vgg_16", "Vgg_19", "VggForCifar10", "ViT",
 ]

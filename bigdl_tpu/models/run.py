@@ -65,6 +65,14 @@ def _build_model(name: str, class_num: int, num_experts: int = 0):
     if name == "autoencoder":
         from .autoencoder import Autoencoder
         return Autoencoder(32), (28, 28, 1), "mse"
+    if name == "vit":
+        # tiny-config default sized for the synthetic/CLI smoke path; the
+        # canonical ImageNet config is ViT() defaults in models/vit.py
+        from .vit import ViT
+        return (ViT(image_size=32, patch_size=4, class_num=class_num,
+                    d_model=64, num_heads=4, num_layers=4,
+                    num_experts=num_experts),
+                (32, 32, 3), "nll")
     if name == "transformer":
         # token-sequence LM (long-context flagship); class_num = vocab size,
         # input spec ("tokens", seq_len) drives the synthetic/record loaders

@@ -1,0 +1,49 @@
+"""Vision Transformer (ViT) — net-new model family vs the 2017 reference
+(whose vision stack is conv-only: LeNet/VGG/ResNet/Inception/AlexNet,
+SURVEY.md §2.11).  Built entirely from the library's own blocks: patch
+embedding is a stride=patch convolution (the standard trick — one MXU
+matmul per patch), positions come from transformer_lm.PositionalEmbedding,
+the encoder reuses TransformerBlock with causal=False (full bidirectional
+attention; flash-attention core on TPU), and classification is mean-pool
+over tokens + Linear, matching the common pooled-ViT variant.
+
+MoE-ViT falls out for free: num_experts > 0 swaps each block's MLP for
+the expert-parallel MoEFFN (parallel/expert.py).
+"""
+
+from __future__ import annotations
+
+from ..nn import (GELU, LayerNorm, Linear, LogSoftMax, Mean, Reshape,
+                  Sequential, SpatialConvolution)
+from .transformer_lm import PositionalEmbedding, TransformerBlock
+
+__all__ = ["ViT"]
+
+
+def ViT(image_size: int = 224, patch_size: int = 16, class_num: int = 1000,
+        d_model: int = 384, num_heads: int = 6, num_layers: int = 8,
+        mlp_ratio: int = 4, in_channels: int = 3, dropout: float = 0.0,
+        num_experts: int = 0, expert_axis=None) -> Sequential:
+    """[B, H, W, C] images -> [B, class_num] log-probs."""
+    if image_size % patch_size:
+        raise ValueError(f"image_size {image_size} not divisible by "
+                         f"patch_size {patch_size}")
+    tokens = (image_size // patch_size) ** 2
+    model = (Sequential()
+             # patch embed: non-overlapping stride=patch conv = per-patch
+             # linear projection, then flatten the spatial grid to tokens
+             .add(SpatialConvolution(in_channels, d_model, patch_size,
+                                     patch_size, patch_size, patch_size,
+                                     0, 0))
+             .add(Reshape((tokens, d_model)))
+             .add(PositionalEmbedding(tokens, d_model)))
+    for _ in range(num_layers):
+        model.add(TransformerBlock(d_model, num_heads, mlp_ratio=mlp_ratio,
+                                   dropout=dropout, causal=False,
+                                   num_experts=num_experts,
+                                   expert_axis=expert_axis))
+    model.add(LayerNorm(d_model))
+    model.add(Mean(dimension=1))           # pool over tokens -> [B, E]
+    model.add(Linear(d_model, class_num))
+    model.add(LogSoftMax())
+    return model

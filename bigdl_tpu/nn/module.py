@@ -247,8 +247,13 @@ class Module:
             n, dt = count(params)
             label = "  " * depth + type(module).__name__
             rows.append((label, n, dt))
-            if isinstance(module, Container):
-                for m, p in zip(module.modules, params):
+            # Container AND Graph (which subclasses Module directly) both
+            # keep child params list-aligned with .modules — recurse on the
+            # structural property so imported Caffe/TF Graphs break down too
+            children = getattr(module, "modules", None)
+            if children is not None and isinstance(params, list) and \
+                    len(children) == len(params):
+                for m, p in zip(children, params):
                     walk(m, p, depth + 1)
 
         walk(self, self.params, 0)

@@ -141,3 +141,49 @@ def test_alexnet_shape():
     assert out.shape == (2, 10)
     # log-probabilities (LogSoftMax head)
     assert np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
+
+
+def test_vit_forward_shape_and_training():
+    """ViT: patch-embed + bidirectional transformer encoder; trains on the
+    separable synthetic task through the standard Optimizer."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.common import set_seed
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import ViT
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init()
+    set_seed(0)
+    model = ViT(image_size=28, patch_size=7, class_num=10, d_model=32,
+                num_heads=4, num_layers=2, in_channels=1)
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    out, _ = model.build(jax.random.key(0)).apply(
+        model.params, model.state, x, training=False, rng=None)
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(jnp.exp(out)).sum(-1), 1.0,
+                               rtol=1e-4)  # log-probs
+
+    r = np.random.default_rng(0)
+    images = r.normal(0.0, 0.1, size=(256, 28, 28, 1)).astype(np.float32)
+    labels = r.integers(0, 10, size=256)
+    for i, l in enumerate(labels):
+        rr, c = divmod(int(l), 5)
+        images[i, 4 + rr * 10: 12 + rr * 10, 2 + c * 5: 7 + c * 5, 0] += 1.5
+    samples = [Sample(images[i], np.int32(labels[i])) for i in range(256)]
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(32, drop_last=True))
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(1e-3))
+           .set_end_when(Trigger.max_epoch(6)))
+    opt.optimize()
+    assert opt.optim_method.hyper["loss"] < 1.0
+
+
+def test_vit_rejects_indivisible_patches():
+    from bigdl_tpu.models import ViT
+
+    import pytest
+    with pytest.raises(ValueError):
+        ViT(image_size=28, patch_size=5)
