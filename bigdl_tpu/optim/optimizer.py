@@ -716,12 +716,11 @@ class Optimizer:
                                             getattr(k, "idx",
                                                     getattr(k, "name", k))))
                                 for k in kp)
-                            # multi-host: leaves sharded across processes are
-                            # not host-fetchable directly
-                            if (hasattr(leaf, "is_fully_addressable")
-                                    and not leaf.is_fully_addressable):
-                                from jax.experimental import multihost_utils
-                                leaf = multihost_utils.process_allgather(leaf)
+                            # multi-host: process-sharded leaves are not
+                            # host-fetchable directly (shared helper skips
+                            # replicated leaves, which np.asarray reads
+                            # locally)
+                            leaf = self._host_fetchable(leaf)
                             self.train_summary.add_histogram(
                                 name, np.asarray(leaf), neval)
                 state["neval"] = neval + 1
@@ -798,15 +797,48 @@ class Optimizer:
 
     _forward_fn = None
 
+    @staticmethod
+    def _host_fetchable(tree):
+        """Make every leaf host-materializable on rank 0.
+
+        Multi-host leaves that are sharded across processes (ZeRO optimizer
+        slices, TP weights) are NOT addressable from one host —
+        np.asarray would raise — so they are process_allgather'd.  This is
+        a COLLECTIVE: every process must call it, which is why the rank-0
+        write gate in _maybe_checkpoint comes AFTER this step.  Replicated
+        leaves pass through (np.asarray reads the local replica)."""
+        def fetch(leaf):
+            if hasattr(leaf, "is_fully_addressable") and \
+                    not leaf.is_fully_addressable and \
+                    not getattr(leaf, "is_fully_replicated", False):
+                from jax.experimental import multihost_utils
+                return multihost_utils.process_allgather(
+                    leaf, tiled=True)
+            return leaf
+        return jax.tree.map(fetch, tree)
+
     def _maybe_checkpoint(self, params, net_state, state, opt_state=None):
-        if (self.checkpoint_trigger is None or self.checkpoint_path is None or
-                not self.checkpoint_trigger(state)):
+        if self.checkpoint_trigger is None or self.checkpoint_path is None:
             return
+        fire = bool(self.checkpoint_trigger(state))
+        if jax.process_count() > 1:
+            # rank 0 DECIDES for everyone: triggers can read rank-divergent
+            # state (per-shard validation scores), and a divergent decision
+            # would deadlock the process_allgather collective below — some
+            # ranks gathering, others already returned
+            from jax.experimental import multihost_utils
+            fire = bool(multihost_utils.broadcast_one_to_all(
+                np.int32(fire)))
+        if not fire:
+            return
+        # collective gather of process-sharded leaves BEFORE the rank gate
+        params = self._host_fetchable(params)
+        net_state = self._host_fetchable(net_state)
+        opt_state = self._host_fetchable(opt_state)
         if jax.process_index() != 0:
-            # multi-host: params/opt_state are replicated (DataParallel), so
-            # rank 0's snapshot is the complete model; other ranks writing the
-            # same files would race (reference: only the Spark DRIVER
-            # checkpoints, DistriOptimizer.scala:394-416)
+            # multi-host: rank 0's snapshot is the complete model; other
+            # ranks writing the same files would race (reference: only the
+            # Spark DRIVER checkpoints, DistriOptimizer.scala:394-416)
             return
         neval = state["neval"] - 1
         # the opt_state pytree (momentum / Adam m,v,t slots) must be persisted

@@ -147,3 +147,66 @@ def test_two_process_streaming_shards(tmp_path):
         assert o["loss"] < 1.5, o
     assert by_rank[0]["digest"] == pytest.approx(by_rank[1]["digest"],
                                                  rel=1e-6)
+
+
+_ZERO_CKPT_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import numpy as np
+    from bigdl_tpu.utils.engine import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.parallel import ShardedDataParallel
+
+    mesh = Engine.init()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    # same separable corpus as _WORKER above (keep in sync)
+    r = np.random.default_rng(1234)
+    n, classes = 128, 10
+    xs = r.normal(0.0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
+    ys = r.integers(0, classes, size=n)
+    for i, l in enumerate(ys):
+        row, col = divmod(int(l), 5)
+        xs[i, 4 + row * 10: 12 + row * 10, 2 + col * 5: 7 + col * 5, 0] += 1.5
+    samples = [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]
+    ds = DataSet.rdd(samples).transform(SampleToMiniBatch(32, drop_last=True))
+
+    ckpt = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ckpt")
+    opt = (Optimizer(LeNet5(classes), ds, nn.ClassNLLCriterion(),
+                     strategy=ShardedDataParallel(min_size=1))
+           .set_optim_method(Adam(learning_rate=3e-3))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_checkpoint(ckpt, Trigger.every_epoch()))
+    opt.optimize()
+
+    from bigdl_tpu.utils import file_io
+    latest = file_io.latest_checkpoint(ckpt)
+    ok = latest is not None
+    if ok and rank == 0:
+        blob = file_io.load(latest[1])  # optimMethod.<n>: ZeRO slots live here
+        leaves = [np.asarray(l) for l in
+                  __import__("jax").tree.leaves(blob["opt_state"])]
+        ok = all(np.all(np.isfinite(l)) for l in leaves if l.dtype.kind == "f")
+    print(json.dumps({"rank": rank, "ok": bool(ok),
+                      "loss": opt.optim_method.hyper["loss"]}), flush=True)
+""")
+
+
+def test_two_process_zero_checkpoint(tmp_path):
+    """Multi-host + ZeRO (ShardedDataParallel): checkpointing must
+    process_allgather the process-sharded optimizer slots (a collective on
+    every rank) before rank 0 writes — np.asarray on a non-addressable
+    global array would otherwise crash the run."""
+    (tmp_path / "ckpt").mkdir()
+    outs = spawn_multihost_workers(_ZERO_CKPT_WORKER, tmp_path)
+    by_rank = {o["rank"]: o for o in outs}
+    assert set(by_rank) == {0, 1}
+    for o in outs:
+        assert o["ok"], o
