@@ -123,6 +123,39 @@ def _accumulated_grads(model, criterion, collect_aux_losses, apply_remat,
     return lsum / accum, new_ns, grads
 
 
+def _local_rows(tree):
+    """This process's rows of batch-sharded global outputs.
+
+    Multi-host: np.asarray on a global array raises (other hosts' rows are
+    not addressable).  make_array_from_process_local_data places each
+    process's contiguous rows on its own devices, so concatenating the
+    addressable shards by global row offset (deduped — a replicating
+    model axis repeats rows across local devices) recovers exactly the
+    rows this process fed in.  Column-sharded outputs (a tensor-parallel
+    head leaving the CLASS axis sharded) would silently truncate classes,
+    so they fail loudly instead."""
+    def local(garr):
+        if not hasattr(garr, "addressable_shards"):
+            return np.asarray(garr)
+        by_start = {}
+        for s in garr.addressable_shards:
+            start = s.index[0].start or 0
+            if start in by_start:
+                continue  # replicated duplicate: skip before the D2H copy
+            for d, sl in zip(garr.shape[1:], s.index[1:]):
+                if (sl.start or 0) != 0 or (sl.stop is None and d or
+                                            sl.stop) != d:
+                    raise NotImplementedError(
+                        "multi-host metric extraction needs outputs "
+                        "replicated along non-batch axes; got a shard "
+                        f"covering {s.index} of {garr.shape} — add an "
+                        "out_sharding/constraint gathering the output")
+            by_start[start] = np.asarray(s.data)
+        return np.concatenate([by_start[k] for k in sorted(by_start)],
+                              axis=0)
+    return jax.tree.map(local, tree)
+
+
 def _put_batch(batch, sharding):
     """Host batch -> sharded global device arrays.
 
@@ -775,15 +808,52 @@ class Optimizer:
                 self.validation_summary.add_scalar(
                     method.name, val, state["neval"] - 1)
 
+    @staticmethod
+    def _reduce_results(totals):
+        """Sum each ValidationResult's numeric fields across processes
+        (every Result class is a flat struct of floats/ints with +
+        semantics — AccuracyResult(correct,count), LossResult(loss,count),
+        PerplexityResult(nll,count)...).  Collective: all ranks call it."""
+        from jax.experimental import multihost_utils
+        for tot in totals:
+            fields = [(k, v) for k, v in vars(tot).items()
+                      if isinstance(v, (int, float))]
+            vec = np.asarray([float(v) for _, v in fields], np.float64)
+            summed = np.asarray(
+                multihost_utils.process_allgather(vec)).sum(axis=0)
+            for (k, orig), v in zip(fields, summed):
+                setattr(tot, k, int(v) if isinstance(orig, int) else
+                        float(v))
+        return totals
+
     def _run_validation(self, params, net_state):
         if self._forward_fn is None:
             self._forward_fn = self._build_forward(self._mesh)
         totals = [None] * len(self.validation_methods)
         data_sh = self.strategy.batch_sharding(self._mesh)
-        for batch in self.validation_dataset.data(train=False):
+        multi = jax.process_count() > 1
+        it = iter(self.validation_dataset.data(train=False))
+        while True:
+            batch = next(it, None)
+            if multi:
+                # every step is collective (global batch + allgather), so
+                # ALL ranks must agree to continue: when any rank runs dry
+                # (uneven shards) everyone stops — a lone rank raising or
+                # looping would strand the others inside a collective
+                from jax.experimental import multihost_utils
+                have = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.int32(batch is not None)))
+                if not have.all():
+                    break
+            elif batch is None:
+                break
             inp = _put_batch(batch.get_input(), data_sh)
             out = self._forward_fn(params, net_state, inp)
-            out_np = _trim(out, batch.valid)
+            # multi-host: score THIS process's rows against its local
+            # targets, then sum result structs across processes below
+            out_local = _local_rows(out) if multi else out
+            out_np = _trim(out_local, batch.valid)
             tgt_np = _trim(batch.get_target(), batch.valid)
             for i, m in enumerate(self.validation_methods):
                 r = m(out_np, tgt_np)
@@ -793,6 +863,8 @@ class Optimizer:
                 "validation dataset produced no batches — fewer samples "
                 "than the batch size with drop_last=True? Use "
                 "SampleToMiniBatch(..., pad_last=True) for evaluation")
+        if multi and totals:
+            totals = self._reduce_results(totals)
         return list(zip(self.validation_methods, totals))
 
     _forward_fn = None
@@ -948,7 +1020,13 @@ class _ShardedForward:
         n = (inp[0] if isinstance(inp, (list, tuple)) else inp).shape[0]
         placed = _put_batch(jax.tree.map(pad, inp), data_sh)
         with mesh:  # PartitionSpec constraints inside modules must bind
-            return self._fwd(params, net_state, placed), n
+            out = self._fwd(params, net_state, placed)
+        if jax.process_count() > 1:
+            # global outputs are not host-addressable from one process;
+            # each process fed the full rows, so its local shard IS the
+            # complete (redundantly computed) answer
+            out = _local_rows(out)
+        return out, n
 
 
 class Evaluator:

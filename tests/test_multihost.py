@@ -49,18 +49,22 @@ _WORKER = textwrap.dedent("""
     # DistributedDataSet: each process keeps its process_index-th shard
     ds = DataSet.rdd(samples).transform(SampleToMiniBatch(32, drop_last=True))
 
-    from bigdl_tpu.optim import Adam
+    from bigdl_tpu.optim import Adam, Top1Accuracy
     model = LeNet5(classes)
     opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
            .set_optim_method(Adam(learning_rate=3e-3))
-           .set_end_when(Trigger.max_epoch(8)))
+           .set_end_when(Trigger.max_epoch(8))
+           # per-shard local scoring + cross-process result reduction:
+           # every rank must report the SAME global accuracy
+           .set_validation(Trigger.every_epoch(), ds, [Top1Accuracy()]))
     trained = opt.optimize()
 
     # verify the model learned AND both processes agree bit-for-bit
     w, _ = trained.get_parameters()
     digest = float(np.abs(np.asarray(w)).sum())
     loss = opt.optim_method.hyper["loss"]  # driver state Table (SGD.scala)
-    print(json.dumps({"rank": rank, "loss": loss, "digest": digest}),
+    print(json.dumps({"rank": rank, "loss": loss, "digest": digest,
+                      "score": opt.optim_method.hyper.get("score")}),
           flush=True)
 """)
 
@@ -75,6 +79,9 @@ def test_two_process_training(tmp_path):
     # replicated parameters must be identical across processes
     assert by_rank[0]["digest"] == pytest.approx(by_rank[1]["digest"],
                                                  rel=1e-6)
+    # validation ran multi-host: global accuracy, identical on every rank
+    assert by_rank[0]["score"] == pytest.approx(by_rank[1]["score"])
+    assert by_rank[0]["score"] > 0.8, by_rank
 
 
 _STREAM_WORKER = textwrap.dedent("""
