@@ -18,6 +18,7 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "DTypePolicy",
@@ -109,6 +110,22 @@ class _RngStream:
                 self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
+
+    def get_state(self):
+        """Serializable snapshot of the stream position (for
+        checkpoint/resume: restoring replays the exact same key
+        sequence)."""
+        with self._lock:
+            key_data = (None if self._key is None
+                        else np.asarray(jax.random.key_data(self._key)))
+            return {"seed": self._seed, "key_data": key_data}
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed = int(state["seed"])
+            kd = state.get("key_data")
+            self._key = (None if kd is None
+                         else jax.random.wrap_key_data(jnp.asarray(kd)))
 
 
 _default_stream = _RngStream(int(os.environ.get("BIGDL_TPU_SEED", "0")))

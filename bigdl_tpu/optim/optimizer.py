@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..common import get_policy, next_rng_key
+from ..common import get_default_rng, get_policy, next_rng_key
 from ..dataset import AbstractDataSet, MiniBatch, SampleToMiniBatch
 from ..dataset.sample import Sample
 from ..nn.module import Criterion, Module
@@ -137,6 +137,14 @@ def _local_rows(tree):
     def local(garr):
         if not hasattr(garr, "addressable_shards"):
             return np.asarray(garr)
+        if jax.process_count() > 1 and                 getattr(garr, "is_fully_replicated", False):
+            # every process holds ALL rows: "this process's rows" is
+            # ambiguous, and slicing by rank would bake in layout
+            # assumptions — callers must keep outputs sharded over the
+            # data axis for per-rank extraction
+            raise NotImplementedError(
+                "multi-host metric extraction: output batch axis is "
+                "replicated; keep outputs sharded over the data axis")
         by_start = {}
         for s in garr.addressable_shards:
             start = s.index[0].start or 0
@@ -148,8 +156,8 @@ def _local_rows(tree):
                     raise NotImplementedError(
                         "multi-host metric extraction needs outputs "
                         "replicated along non-batch axes; got a shard "
-                        f"covering {s.index} of {garr.shape} — add an "
-                        "out_sharding/constraint gathering the output")
+                        f"covering {s.index} of {garr.shape} — keep the "
+                        "class/feature axes unsharded in the output")
             by_start[start] = np.asarray(s.data)
         return np.concatenate([by_start[k] for k in sorted(by_start)],
                               axis=0)
@@ -558,6 +566,11 @@ class Optimizer:
             self.optim_method.load_state_dict(oblob["method"])
             self._resume_state = oblob["driver_state"]
             self._resume_opt_state = oblob.get("opt_state")
+            if oblob.get("rng_state") is not None:
+                # replay the GLOBAL key stream exactly (dropout masks,
+                # init draws); dataset shuffle RNGs are per-dataset and
+                # not captured — a resumed run's epoch order may differ
+                get_default_rng().set_state(oblob["rng_state"])
         self._compiled = None
         return self
 
@@ -845,6 +858,14 @@ class Optimizer:
                     multihost_utils.process_allgather(
                         np.int32(batch is not None)))
                 if not have.all():
+                    if have.any():
+                        # uneven shards: some ranks still had batches that
+                        # are now skipped — the metric covers fewer samples
+                        logger.warning(
+                            "validation stopped early on %d/%d ranks with "
+                            "batches remaining (uneven dataset shards); "
+                            "metrics cover fewer samples", int(have.sum()),
+                            have.size)
                     break
             elif batch is None:
                 break
@@ -933,6 +954,7 @@ class Optimizer:
             {"params": params, "state": net_state},
             {"method": self.optim_method.state_dict(),
              "opt_state": jax.tree.map(np.asarray, opt_state),
+             "rng_state": get_default_rng().get_state(),
              "driver_state": {k: v for k, v in state.items()
                               if not k.startswith("_")}},
             overwrite=self.is_overwrite)

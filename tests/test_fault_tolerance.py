@@ -240,3 +240,35 @@ def test_async_checkpoint_write_error_surfaces(tmp_path):
                                   {"o": 1})
     with pytest.raises(Exception):
         file_io.wait_for_async_checkpoints()
+
+
+def test_checkpoint_restores_rng_stream(tmp_path):
+    """The global RNG stream position rides the optimMethod snapshot:
+    resume_from replays the exact key sequence the interrupted run would
+    have produced (dropout masks, shuffle draws)."""
+    import jax
+
+    from bigdl_tpu.common import get_default_rng, next_rng_key, set_seed
+    from bigdl_tpu.utils import file_io
+    from bigdl_tpu.utils.engine import Engine
+    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+
+    Engine.reset()
+    Engine.init()
+    set_seed(7)
+    model, opt = make_optimizer(samples=synthetic_mnist(128))
+    opt.set_end_when(Trigger.max_epoch(1))
+    opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+    opt.optimize()
+    # the keys the ORIGINAL stream would produce next
+    expect = [np.asarray(jax.random.key_data(next_rng_key()))
+              for _ in range(3)]
+    # clobber the stream, then resume: positions must be restored
+    set_seed(12345)
+    latest = file_io.latest_checkpoint(str(tmp_path))
+    model2, opt2 = make_optimizer(samples=synthetic_mnist(128))
+    opt2.resume_from(latest[0], latest[1])
+    got = [np.asarray(jax.random.key_data(next_rng_key()))
+           for _ in range(3)]
+    for a, b in zip(expect, got):
+        np.testing.assert_array_equal(a, b)
