@@ -201,8 +201,17 @@ _ZERO_CKPT_WORKER = textwrap.dedent("""
         leaves = [np.asarray(l) for l in
                   __import__("jax").tree.leaves(blob["opt_state"])]
         ok = all(np.all(np.isfinite(l)) for l in leaves if l.dtype.kind == "f")
+
+    # multi-host bulk eval (_ShardedForward + _local_rows): every process
+    # feeds the full rows and gets back complete host-local predictions
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+    res = Evaluator(opt.model).test(DataSet.array(samples),
+                                    [Top1Accuracy()], batch_size=32)
+    acc, n_eval = res[0][1].result()
     print(json.dumps({"rank": rank, "ok": bool(ok),
-                      "loss": opt.optim_method.hyper["loss"]}), flush=True)
+                      "loss": opt.optim_method.hyper["loss"],
+                      "eval_acc": float(acc), "eval_n": int(n_eval)}),
+          flush=True)
 """)
 
 
@@ -217,3 +226,6 @@ def test_two_process_zero_checkpoint(tmp_path):
     assert set(by_rank) == {0, 1}
     for o in outs:
         assert o["ok"], o
+        # bulk eval returned complete per-host results on both ranks
+        assert o["eval_n"] == 128 and o["eval_acc"] > 0.5, o
+    assert by_rank[0]["eval_acc"] == pytest.approx(by_rank[1]["eval_acc"])
