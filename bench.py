@@ -57,14 +57,60 @@ _PEAK_BF16 = (
 )
 
 
+# Stall watchdog: the tunneled backend can lose an RPC mid-run (observed
+# 2026-07-31: roofline completed, then the next compile blocked forever in
+# wait_woken while a fresh probe process reached the chip fine).  Such a hang
+# would eat the driver's whole bench budget and land NO json line, so a
+# daemon thread watches a heartbeat that every log line / stage transition
+# refreshes; on stall it emits partial results (or a bench_error) and exits.
+_BEAT = {"t": time.monotonic(), "stage": "init"}
+_STALL_STATE = {"results": {}, "errors": {}, "skipped": [], "meta": None}
+# stages that legitimately hold ONE long silent device/subprocess call and
+# get the --compile-stall-seconds allowance: backend init, XLA compiles,
+# jaxpr tracing, the roofline's compile+timed 8192^3 matmul chains, the
+# scaling subprocess (own timeout _SCALING_TIMEOUT=420s > the short limit),
+# and timing ("time:*"): per-rep heartbeats bound most silences to one rep,
+# but the fetch of one n2=16 chain is a single blocking call that can pass
+# 300s on slow backends (resnet50 under --platform cpu)
+_LONG_STAGES = ("init", "compile", "trace", "roofline", "scaling", "time")
+_EMIT_LOCK = threading.Lock()
+_EMITTED = [None]  # thread ident of the claimant
+_EMIT_DONE = threading.Event()  # set once the final line is on stdout
+
+
+def _claim_emit() -> bool:
+    """Exactly one THREAD may write the final JSON line (the watchdog can
+    race a main thread whose hung RPC resolves right after the idle check).
+    Re-entrant for the claimant so its nested _fail/print paths still work."""
+    me = threading.get_ident()
+    with _EMIT_LOCK:
+        if _EMITTED[0] is None:
+            _EMITTED[0] = me
+            return True
+        return _EMITTED[0] == me
+
+
+def _beat(stage=None):
+    _BEAT["t"] = time.monotonic()
+    if stage is not None:
+        _BEAT["stage"] = stage
+
+
 def _log(msg):
+    _beat()
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
 def _fail(err, stage):
+    if not _claim_emit():
+        # another thread claimed the final line; claiming precedes writing,
+        # so wait for the write to land before killing the process
+        _EMIT_DONE.wait(timeout=60)
+        os._exit(1)
     print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "error",
                       "vs_baseline": None, "stage": stage, "error": str(err)}))
     sys.stdout.flush()
+    _EMIT_DONE.set()
     os._exit(1)
 
 
@@ -208,13 +254,16 @@ def _bench_config(name, build, peak_flops):
     opt_state = opt.optim_method.init_state(params)
     lr_arr, rng = jnp.float32(lr), jax.random.key(1)
 
+    _beat(f"compile:{name}")
     t0 = time.perf_counter()
     lowered = step.lower(params, net_state, opt_state, inp, tgt, lr_arr, rng)
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
 
+    _beat(f"trace:{name}")
     flops_step, flops_detail = _step_flops(
         step, compiled, (params, net_state, opt_state, inp, tgt, lr_arr, rng))
+    _beat(f"time:{name}")
 
     box = {"params": params, "net_state": net_state, "opt_state": opt_state}
 
@@ -226,7 +275,7 @@ def _bench_config(name, build, peak_flops):
 
     from bigdl_tpu.utils.timing import measure_step_seconds
     dt, timing = measure_step_seconds(
-        run, log=lambda m: _log(f"{name}: {m}"))
+        run, log=lambda m: _log(f"{name}: {m}"), progress=_beat)
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name)
@@ -257,11 +306,14 @@ def _bench_infer(name, build, peak_flops):
         return out, jnp.mean(out.astype(jnp.float32)) * 0
 
     tok0 = jnp.float32(0)
+    _beat(f"compile:{name}")
     t0 = time.perf_counter()
     compiled = jax.jit(forward).lower(params, inp, tok0).compile()
     compile_s = time.perf_counter() - t0
+    _beat(f"trace:{name}")
     flops_step, flops_detail = _step_flops(forward, compiled,
                                            (params, inp, tok0))
+    _beat(f"time:{name}")
 
     box = {"tok": tok0}
 
@@ -269,7 +321,8 @@ def _bench_infer(name, build, peak_flops):
         out, box["tok"] = compiled(params, inp, box["tok"])
         return out
 
-    dt, timing = measure_step_seconds(run, log=lambda m: _log(f"{name}: {m}"))
+    dt, timing = measure_step_seconds(run, log=lambda m: _log(f"{name}: {m}"),
+                                      progress=_beat)
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
@@ -389,8 +442,19 @@ def main(argv=None):
                     help="soft wall-clock budget: remaining configs are "
                          "skipped (recorded, not failed) once exceeded so "
                          "one JSON line is always produced")
+    ap.add_argument("--stall-seconds", type=float, default=300.0,
+                    help="watchdog: max silent seconds between progress "
+                         "marks before the run is declared hung")
+    ap.add_argument("--compile-stall-seconds", type=float, default=900.0,
+                    help="watchdog allowance for stages holding one long "
+                         "legitimate silent call: init, compile, trace, "
+                         "roofline, scaling, and timing-chain fetches "
+                         "(--stall-seconds covers the remaining, "
+                         "quick-transition stages)")
     args = ap.parse_args(argv)
     t_start = time.perf_counter()
+    _beat("init")
+    _start_watchdog(args.stall_seconds, args.compile_stall_seconds)
 
     if args.platform:
         import jax as _jax
@@ -404,6 +468,7 @@ def main(argv=None):
 
     table_peak = _table_peak_flops(devices[0])
     measured_peak = None
+    _beat("roofline")
     if is_tpu_like(devices[0]):
         try:
             # measure_roofline self-checks reproducibility (reps must agree)
@@ -425,7 +490,12 @@ def main(argv=None):
                  f"(table: {table_peak and table_peak/1e12} TFLOP/s)")
     peak = max(filter(None, (table_peak, measured_peak)), default=None)
 
-    results, errors, skipped = {}, {}, []
+    results = _STALL_STATE["results"]
+    errors = _STALL_STATE["errors"]
+    skipped = _STALL_STATE["skipped"]
+    _STALL_STATE["meta"] = dict(args=args, table_peak=table_peak,
+                                measured_peak=measured_peak, peak=peak,
+                                devices=devices, t_start=t_start)
     for name in args.configs:
         elapsed = time.perf_counter() - t_start
         if (results or errors) and elapsed > args.budget_seconds:
@@ -436,6 +506,7 @@ def main(argv=None):
             _log(f"budget exceeded ({elapsed:.0f}s): skipping {name}")
             continue
         try:
+            _beat(f"build:{name}")
             bench_fn = (_bench_infer if name in INFER_CONFIGS
                         else _bench_config)
             results[name] = bench_fn(name, CONFIGS[name], peak)
@@ -443,6 +514,19 @@ def main(argv=None):
             errors[name] = f"{type(e).__name__}: {e}"
             _log(f"config {name} failed: {errors[name]}")
 
+    if not _claim_emit():
+        # the watchdog declared a stall and claimed the final line (our
+        # hung RPC must have resolved late); returning now would tear down
+        # the interpreter and freeze the daemon thread mid-print — wait
+        # for its line to land, then say nothing
+        _EMIT_DONE.wait(timeout=60)
+        return
+    _assemble_and_print(args, results, errors, skipped, table_peak,
+                        measured_peak, peak, devices, t_start)
+
+
+def _assemble_and_print(args, results, errors, skipped, table_peak,
+                        measured_peak, peak, devices, t_start, stall=None):
     primary = (results.get("resnet50_bf16") or results.get("resnet50") or
                # prefer any TRAIN config as the headline; infer-only last
                next((r for k, r in results.items()
@@ -450,6 +534,7 @@ def main(argv=None):
                next(iter(results.values()), None))
     if primary is None:
         _fail("; ".join(f"{k}: {v}" for k, v in errors.items()) or
+              (stall and f"stalled in {stall['stage']}") or
               "no configs ran", "bench")
 
     primary_is_train = primary.get("mode") != "inference"
@@ -478,16 +563,81 @@ def main(argv=None):
         out["config_errors"] = errors
     if skipped:
         out["configs_skipped_budget"] = skipped
-    if not args.no_scaling:
+    if stall:
+        out["stall"] = stall
+    if not args.no_scaling and not stall:
         # headroom for the scaling subprocess's own timeout so the total
         # stays inside the budget the driver is assumed to allow
         if time.perf_counter() - t_start < args.budget_seconds - \
                 _SCALING_TIMEOUT:
+            _beat("scaling")
             out["scaling_virtual_cpu"] = _scaling_table()
         else:
             out["scaling_skipped_budget"] = True
             _log("budget: skipping virtual-mesh scaling table")
     print(json.dumps(out))
+    sys.stdout.flush()
+    _EMIT_DONE.set()
+
+
+def _start_watchdog(stall_seconds, compile_stall_seconds):
+    """Daemon thread: if no heartbeat for `stall_seconds` (stages known to
+    hold long silent device calls — init/compile — get the larger
+    allowance), print whatever is complete and exit.  Partial results are a
+    valid JSON line; an empty run becomes a bench_error naming the stage."""
+
+    def watch():
+        while True:
+            time.sleep(10)
+            # read stage BEFORE t: a stage transition writes t then stage,
+            # so this order can never pair a stale timestamp with a fresh
+            # short-limit stage (which would declare a false stall at the
+            # moment a long compile hands off to a timing stage)
+            stage = _BEAT["stage"]
+            limit = (compile_stall_seconds
+                     if stage.split(":")[0] in _LONG_STAGES
+                     else stall_seconds)
+            idle = time.monotonic() - _BEAT["t"]
+            if idle > limit:
+                if not _claim_emit():
+                    return  # main thread already claimed the final line
+                _log(f"WATCHDOG: no progress for {idle:.0f}s in stage "
+                     f"'{stage}' (limit {limit:.0f}s) — lost-RPC hang; "
+                     "emitting partial results")
+                st = _STALL_STATE
+                if st["meta"] is None or not st["results"]:
+                    prior = "; ".join(f"{k}: {v}"
+                                      for k, v in st["errors"].items())
+                    _fail(TimeoutError(
+                        f"no progress for {idle:.0f}s in {stage}" +
+                        (f" (earlier config errors: {prior})" if prior
+                         else "")), f"stall:{stage}")
+                # snapshot the live dicts (atomic C-level copies under the
+                # GIL): the main thread's hung RPC can resolve late and
+                # keep inserting while json.dumps iterates
+                results = dict(st["results"])
+                errors = dict(st["errors"])
+                skipped = list(st["skipped"])
+                stall = {"stage": stage, "idle_seconds": round(idle, 1)}
+                try:
+                    attempted = set(results) | set(errors) | set(skipped)
+                    cur = stage.split(":", 1)[-1]
+                    stall["configs_not_attempted"] = [
+                        c for c in st["meta"]["args"].configs
+                        if c not in attempted and c != cur]
+                    _assemble_and_print(results=results, errors=errors,
+                                        skipped=skipped, stall=stall,
+                                        **st["meta"])
+                except Exception as e:  # noqa: BLE001 — line must land
+                    _fail(f"stall in {stage}; emit of partial results "
+                          f"failed: {type(e).__name__}: {e}",
+                          f"stall:{stage}")
+                # partial results are a valid, self-describing JSON line
+                # (the "stall" field names the hung stage) — exit 0 like
+                # the budget-skip path so the driver records it
+                os._exit(0)
+
+    threading.Thread(target=watch, daemon=True, name="bench-watchdog").start()
 
 
 def _scaling_table():

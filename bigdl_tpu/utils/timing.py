@@ -40,11 +40,15 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
-def measure_chain(run, n1=4, n2=16, reps=3):
+def measure_chain(run, n1=4, n2=16, reps=3, progress=None):
     """Differenced chained timing of `run()` (must return a device value that
     depends on all prior `run()` calls, e.g. the loss of a step that threads
-    its params).  Returns (seconds_per_run, details dict)."""
+    its params).  Returns (seconds_per_run, details dict).  `progress` (no
+    args, no output) is called after every rep so a caller's stall watchdog
+    sees a heartbeat at least once per chain instead of one long silence."""
     fetch_scalar(run())  # drain queue + any lazy backend state
+    if progress:
+        progress()
     times = {}
     for n in (n1, n2):
         best = float("inf")
@@ -55,6 +59,8 @@ def measure_chain(run, n1=4, n2=16, reps=3):
                 out = run()
             fetch_scalar(out)
             best = min(best, time.perf_counter() - t0)
+            if progress:
+                progress()
         times[n] = best
     dt = (times[n2] - times[n1]) / (n2 - n1)
     overhead = max(times[n1] - n1 * dt, 0.0)
@@ -63,7 +69,7 @@ def measure_chain(run, n1=4, n2=16, reps=3):
                 "fixed_overhead_seconds": round(overhead, 6)}
 
 
-def measure_sync(run, iters=6) -> float:
+def measure_sync(run, iters=6, progress=None) -> float:
     """Median per-call timing with a host fetch per call (upper-bounds the
     true step time by one tunnel round-trip)."""
     fetch_scalar(run())
@@ -72,15 +78,18 @@ def measure_sync(run, iters=6) -> float:
         t0 = time.perf_counter()
         fetch_scalar(run())
         ts.append(time.perf_counter() - t0)
+        if progress:
+            progress()
     ts.sort()
     return ts[len(ts) // 2]
 
 
-def measure_step_seconds(run, n1=4, n2=16, reps=3, log=None):
+def measure_step_seconds(run, n1=4, n2=16, reps=3, log=None, progress=None):
     """Best-effort step time: differenced chain, falling back to the synced
     median when the differencing is inconsistent (noise/backlog)."""
-    dt, detail = measure_chain(run, n1=n1, n2=n2, reps=reps)
-    dt_sync = measure_sync(run)
+    dt, detail = measure_chain(run, n1=n1, n2=n2, reps=reps,
+                               progress=progress)
+    dt_sync = measure_sync(run, progress=progress)
     detail["step_seconds_sync"] = round(dt_sync, 6)
     if dt <= 0 or dt > dt_sync * 1.5:
         if log:
