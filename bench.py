@@ -462,6 +462,14 @@ def main(argv=None):
             _jax.config.update("jax_platforms", args.platform)
         except RuntimeError:
             pass
+    # persistent XLA cache: warm compiles across processes — the difference
+    # between LeNet's pathological 800s+ compile fitting the budget or
+    # stalling (utils/platform.py; BIGDL_TPU_XLA_CACHE=0 disables)
+    from bigdl_tpu.utils.platform import enable_compilation_cache
+    cache_dir = enable_compilation_cache()
+    if cache_dir:
+        _log(f"XLA compilation cache: {cache_dir}")
+
     jax, devices = _init_backend()
 
     from bigdl_tpu.utils.timing import is_tpu_like, measure_roofline

@@ -62,6 +62,17 @@ __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
            "ConfigurationError"]
 
 
+def _as_dataset(dataset):
+    """Coerce a plain sequence of Samples — the RDD[Sample] analog every
+    reference entry point accepts (Optimizer.apply, Evaluator.scala:48,
+    Predictor.scala:39) — into a DataSet; other inputs pass through."""
+    if isinstance(dataset, (list, tuple)) and dataset and \
+            isinstance(dataset[0], Sample):
+        from ..dataset import DataSet
+        return DataSet.array(list(dataset))
+    return dataset
+
+
 def _trim(x, valid: int):
     """Drop padded rows (possibly from nested/table outputs) after eval."""
     if isinstance(x, (list, tuple)):
@@ -188,10 +199,7 @@ class Optimizer:
                  batch_size: Optional[int] = None,
                  end_trigger: Optional[Trigger] = None,
                  strategy: Optional[ShardingStrategy] = None):
-        if isinstance(dataset, (list, tuple)) and dataset and \
-                isinstance(dataset[0], Sample):
-            from ..dataset import DataSet
-            dataset = DataSet.array(list(dataset))
+        dataset = _as_dataset(dataset)
         if batch_size is not None:
             dataset = dataset.transform(
                 SampleToMiniBatch(batch_size, drop_last=True))
@@ -1064,6 +1072,10 @@ class Evaluator:
 
     def test(self, dataset, methods: Sequence[ValidationMethod],
              batch_size: Optional[int] = None):
+        coerced = _as_dataset(dataset)
+        if coerced is not dataset and batch_size is None:
+            batch_size = 128  # raw samples need batching; cluster default
+        dataset = coerced
         if batch_size is not None:
             dataset = dataset.transform(
                 SampleToMiniBatch(batch_size, pad_last=True))
@@ -1094,10 +1106,7 @@ class Predictor:
         return _trim(out, n)
 
     def predict(self, dataset):
-        if isinstance(dataset, (list, tuple)) and dataset and \
-                isinstance(dataset[0], Sample):
-            from ..dataset import DataSet
-            dataset = DataSet.array(list(dataset))
+        dataset = _as_dataset(dataset)
         if isinstance(dataset, AbstractDataSet):
             dataset = dataset.transform(
                 SampleToMiniBatch(self.batch_size, pad_last=True))

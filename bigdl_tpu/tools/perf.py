@@ -38,7 +38,9 @@ def run(model_name: str, batch_size: int, iters: int = 20, warmup: int = 3,
     from ..models.run import _build_model, build_criterion
     from ..optim import SGD, Optimizer, Trigger
     from ..utils.engine import Engine
+    from ..utils.platform import enable_compilation_cache
 
+    enable_compilation_cache()
     Engine.reset()
     Engine.init()
     mesh = Engine.mesh()

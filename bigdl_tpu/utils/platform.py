@@ -11,7 +11,54 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["force_cpu"]
+__all__ = ["force_cpu", "enable_compilation_cache"]
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax at a persistent XLA compilation cache (verified working on
+    the tunneled axon backend: cross-process warm compiles).
+
+    Why it matters here: XLA compiles of some small models are pathologically
+    slow on this backend (LeNet's train step: 809s in one measured run,
+    >905s in another, vs 27s for ResNet-50 — see docs/benchmarking.md), so a
+    warm on-disk cache is the difference between a bench config fitting the
+    harness budget or stalling out.
+
+    `path` defaults to $BIGDL_TPU_XLA_CACHE_DIR or ~/.cache/bigdl_tpu/xla;
+    set BIGDL_TPU_XLA_CACHE=0 to disable.  Returns the cache dir in use, or
+    None when disabled/unavailable (backend already initialized with a
+    different cache config is fine — jax applies this lazily per compile).
+    """
+    import os
+
+    from . import config as _config
+
+    if not _config.get_bool("XLA_CACHE", True):
+        return None
+    path = path or _config.get_str(
+        "XLA_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "bigdl_tpu", "xla"))
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None
+    import jax
+
+    # thresholds first, each individually guarded (an older jax missing one
+    # knob should not forfeit the cache — it just keeps its own default);
+    # cache everything: even sub-second entries save tunnel round-trips,
+    # and the pathological compiles are exactly the ones worth keeping
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 — unknown option on older jax
+            pass
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — cache genuinely unavailable
+        return None
+    return path
 
 
 def force_cpu(n_devices: Optional[int] = None) -> bool:

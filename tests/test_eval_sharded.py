@@ -107,3 +107,14 @@ def test_module_evaluate_defaults_and_validation():
     assert n == 40
     with _pytest.raises(ValueError):
         model.evaluate(DataSet.array(_samples(8)))  # no methods
+
+
+def test_evaluator_accepts_raw_sample_list():
+    """Evaluator.test over a plain list of Samples — the RDD[Sample] analog
+    (Evaluator.scala:48); mirrors Predictor.predict's list acceptance."""
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+    Engine.init()
+    model = LeNet5(10).build(jax.random.key(0))
+    res = Evaluator(model).test(_samples(48), [Top1Accuracy()])
+    _, n = res[0][1].result()
+    assert n == 48
