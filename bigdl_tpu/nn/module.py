@@ -337,12 +337,9 @@ class Module:
                 "[Top1Accuracy()] (AbstractModule.evaluate vMethods)")
         from ..optim.optimizer import Evaluator
         self.training_mode = False
-        if batch_size is None:
-            # un-batched Sample datasets need batching (the reference's
-            # batchSize parameter has a cluster-derived default)
-            first = next(iter(dataset.data(train=False)), None)
-            if first is not None and not hasattr(first, "get_input"):
-                batch_size = 128
+        # list coercion + batch-size defaulting live in Evaluator.test so
+        # every entry point (this facade, Evaluator, Validator) accepts the
+        # same inputs
         return Evaluator(self).test(dataset, methods, batch_size=batch_size)
 
     def is_training(self) -> bool:

@@ -1072,10 +1072,14 @@ class Evaluator:
 
     def test(self, dataset, methods: Sequence[ValidationMethod],
              batch_size: Optional[int] = None):
-        coerced = _as_dataset(dataset)
-        if coerced is not dataset and batch_size is None:
-            batch_size = 128  # raw samples need batching; cluster default
-        dataset = coerced
+        dataset = _as_dataset(dataset)
+        if batch_size is None:
+            # un-batched Sample datasets need batching (the reference's
+            # batchSize parameter has a cluster-derived default); peek one
+            # element — dataset.data() returns a fresh iterator each call
+            first = next(iter(dataset.data(train=False)), None)
+            if first is not None and not hasattr(first, "get_input"):
+                batch_size = 128
         if batch_size is not None:
             dataset = dataset.transform(
                 SampleToMiniBatch(batch_size, pad_last=True))

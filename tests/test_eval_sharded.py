@@ -118,3 +118,14 @@ def test_evaluator_accepts_raw_sample_list():
     res = Evaluator(model).test(_samples(48), [Top1Accuracy()])
     _, n = res[0][1].result()
     assert n == 48
+
+
+def test_module_evaluate_accepts_raw_sample_list():
+    """The facade inherits Evaluator.test's coercion — same inputs at every
+    entry point (module.evaluate / Evaluator / Validator)."""
+    from bigdl_tpu.optim import Top1Accuracy
+    Engine.init()
+    model = LeNet5(10).build(jax.random.key(0))
+    res = model.evaluate(_samples(24), [Top1Accuracy()])
+    _, n = res[0][1].result()
+    assert n == 24
