@@ -324,3 +324,47 @@ def test_criterion_grads_match_torch():
     xt = _t(x, requires_grad=True)
     torch.nn.MSELoss()(xt, _t(y)).backward()
     np.testing.assert_allclose(g, xt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_gru_sequence_grads_match_torch_autograd():
+    """BPTT through our fused-gate GRU scan vs torch AUTOGRAD over the same
+    equations.  torch.nn.GRUCell is a different GRU variant (reset gate
+    applied AFTER the hidden matmul, r*(W_hn h); ours — the original GRU and
+    the reference nn/GRU.scala — applies it BEFORE, W_cand (r*h)), so the
+    cells are not weight-mappable.  The forward golden already pins our
+    equations against a numpy loop; here torch's tape differentiates the
+    identical unrolled math, independently checking the lax.scan VJP."""
+    H, I, T, B = 6, 4, 3, 2
+    m = nn.Recurrent(nn.GRU(I, H)).build(rng())
+    p = m.params[0]
+    gk = np.asarray(p["gate_kernel"])   # (I+H, 2H) -> (r, u)
+    gb = np.asarray(p["gate_bias"])
+    ck = np.asarray(p["cand_kernel"])   # (I+H, H)
+    cb = np.asarray(p["cand_bias"])
+    x = _np((B, T, I), 30)
+    cot = _np((B, T, H), 31)
+
+    gp, gx = _our_grads(m, x, jnp.asarray(cot))
+
+    gk_t = _t(gk, requires_grad=True)
+    gb_t = _t(gb, requires_grad=True)
+    ck_t = _t(ck, requires_grad=True)
+    cb_t = _t(cb, requires_grad=True)
+    xt = _t(x, requires_grad=True)
+    h = torch.zeros(B, H)
+    total = torch.zeros(())
+    for t in range(T):
+        zin = torch.cat([xt[:, t], h], dim=-1)
+        gates = torch.sigmoid(zin @ gk_t + gb_t)
+        r, u = gates[:, :H], gates[:, H:]
+        cin = torch.cat([xt[:, t], r * h], dim=-1)
+        cand = torch.tanh(cin @ ck_t + cb_t)
+        h = (1.0 - u) * h + u * cand
+        total = total + (h * _t(cot[:, t])).sum()
+    total.backward()
+
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+    for ours, theirs in ((gp[0]["gate_kernel"], gk_t), (gp[0]["gate_bias"], gb_t),
+                         (gp[0]["cand_kernel"], ck_t), (gp[0]["cand_bias"], cb_t)):
+        np.testing.assert_allclose(np.asarray(ours), theirs.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
