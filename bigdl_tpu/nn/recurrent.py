@@ -39,6 +39,30 @@ class Cell(Module):
         raise NotImplementedError
 
     def step(self, params, x_t, hidden):
+        """One timestep.  Dense cells implement project_inputs/step_projected
+        and inherit this delegation (a (1,B,I) projection), so the single-step
+        path and Recurrent's hoisted scan share ONE set of equations; conv
+        cells override step() directly."""
+        proj = self.project_inputs(params, x_t[None])
+        if proj is None:
+            raise NotImplementedError
+        xp_t = jax.tree.map(lambda p: p[0], proj)
+        return self.step_projected(params, xp_t, hidden)
+
+    # -- input-projection hoisting (TPU optimization) ----------------------
+    # The x-half of every gate projection is state-independent, so it can
+    # leave the scan: ONE (T*B, I) @ (I, G) MXU gemm up front instead of T
+    # small gemms interleaved with the sequential dependency.  Exact same
+    # math (blocked matmul: [x,h] @ K == x@Kx + h@Kh), so cells that
+    # implement the pair are used automatically by Recurrent; cells that
+    # don't (conv cells) fall back to step().
+
+    def project_inputs(self, params, xs):
+        """xs time-major (T, B, I) -> pytree scanned in place of xs, or None
+        when the cell doesn't support hoisting."""
+        return None
+
+    def step_projected(self, params, xp_t, hidden):
         raise NotImplementedError
 
     # a bare cell applied to (batch, features) input acts on one step with zero state
@@ -49,6 +73,16 @@ class Cell(Module):
 
 def _uniform(rng, shape, stdv):
     return jax.random.uniform(rng, shape, get_policy().param_dtype, -stdv, stdv)
+
+
+def _project(xs, w):
+    """(T, B, I) @ (I, G) as one flat MXU gemm, f32 accumulation."""
+    cd = get_policy().compute_dtype
+    t, b, i = xs.shape
+    flat = xs.reshape(t * b, i).astype(cd)
+    proj = lax.dot_general(flat, w.astype(cd), (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    return proj.reshape(t, b, -1)
 
 
 class RnnCell(Cell):
@@ -69,17 +103,21 @@ class RnnCell(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
-    def step(self, params, x_t, h):
+    def project_inputs(self, params, xs):
+        return _project(xs, params["w_ih"])
+
+    def step_projected(self, params, xp_t, h):
         c = get_policy().compute_dtype
-        pre = (x_t.astype(c) @ params["w_ih"].astype(c)
-               + h.astype(c) @ params["w_hh"].astype(c) + params["bias"])
-        h_new = self.activation(pre).astype(x_t.dtype)
+        pre = xp_t + h.astype(c) @ params["w_hh"].astype(c) + params["bias"]
+        h_new = self.activation(pre).astype(h.dtype)
         return h_new, h_new
 
 
 class LSTM(Cell):
-    """LSTM cell (reference: nn/LSTM.scala).  The four gate projections are fused
-    into one (in+hidden, 4*hidden) matmul so each scan step is a single MXU gemm.
+    """LSTM cell (reference: nn/LSTM.scala).  The four gate projections are
+    fused into one (in+hidden, 4*hidden) kernel; under Recurrent's scan the
+    x-half is hoisted out as one big (T*B, in) gemm and each step runs only
+    the state-dependent (B, hidden) @ (hidden, 4*hidden) gemm.
     Gate order: input, forget, cell(gain), output."""
 
     def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
@@ -100,21 +138,23 @@ class LSTM(Cell):
         return (jnp.zeros((batch_size, self.hidden_size), dtype),
                 jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x_t, hidden):
+    def step_projected(self, params, xp_t, hidden):
         h, cst = hidden
         cd = get_policy().compute_dtype
-        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
-        gates = lax.dot_general(z, params["kernel"].astype(cd),
-                                (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        gates = xp_t + lax.dot_general(
+            h.astype(cd), params["kernel"][self.input_size:].astype(cd),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         gates = gates + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         g = jnp.tanh(g)
         c_new = f * cst.astype(jnp.float32) + i * g
         h_new = o * jnp.tanh(c_new)
-        h_new = h_new.astype(x_t.dtype)
-        return h_new, (h_new, c_new.astype(x_t.dtype))
+        h_new = h_new.astype(h.dtype)
+        return h_new, (h_new, c_new.astype(h.dtype))
+
+    def project_inputs(self, params, xs):
+        return _project(xs, params["kernel"][: self.input_size])
 
 
 class LSTMPeephole(Cell):
@@ -142,13 +182,12 @@ class LSTMPeephole(Cell):
         return (jnp.zeros((batch_size, self.hidden_size), dtype),
                 jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x_t, hidden):
+    def step_projected(self, params, xp_t, hidden):
         h, cst = hidden
         cd = get_policy().compute_dtype
-        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
-        gates = lax.dot_general(z, params["kernel"].astype(cd),
-                                (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        gates = xp_t + lax.dot_general(
+            h.astype(cd), params["kernel"][self.input_size:].astype(cd),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         gates = gates + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         cf = cst.astype(jnp.float32)
@@ -157,8 +196,11 @@ class LSTMPeephole(Cell):
         g = jnp.tanh(g)
         c_new = f * cf + i * g
         o = jax.nn.sigmoid(o + params["peep_o"] * c_new)
-        h_new = (o * jnp.tanh(c_new)).astype(x_t.dtype)
-        return h_new, (h_new, c_new.astype(x_t.dtype))
+        h_new = (o * jnp.tanh(c_new)).astype(h.dtype)
+        return h_new, (h_new, c_new.astype(h.dtype))
+
+    def project_inputs(self, params, xs):
+        return _project(xs, params["kernel"][: self.input_size])
 
 
 class GRU(Cell):
@@ -183,24 +225,29 @@ class GRU(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
-    def step(self, params, x_t, h):
+    def step_projected(self, params, xp_t, h):
         cd = get_policy().compute_dtype
-        z = jnp.concatenate([x_t, h], axis=-1).astype(cd)
+        I = self.input_size
+        xp_gate, xp_cand = xp_t
         gates = jax.nn.sigmoid(
-            lax.dot_general(z, params["gate_kernel"].astype(cd),
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+            xp_gate + lax.dot_general(
+                h.astype(cd), params["gate_kernel"][I:].astype(cd),
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
             + params["gate_bias"])
         r, u = jnp.split(gates, 2, axis=-1)
-        zc = jnp.concatenate([x_t, (r * h.astype(jnp.float32)).astype(x_t.dtype)],
-                             axis=-1).astype(cd)
+        rh = (r * h.astype(jnp.float32)).astype(cd)
         cand = jnp.tanh(
-            lax.dot_general(zc, params["cand_kernel"].astype(cd),
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+            xp_cand + lax.dot_general(
+                rh, params["cand_kernel"][I:].astype(cd),
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
             + params["cand_bias"])
-        h_new = ((1.0 - u) * h.astype(jnp.float32) + u * cand).astype(x_t.dtype)
+        h_new = ((1.0 - u) * h.astype(jnp.float32) + u * cand).astype(h.dtype)
         return h_new, h_new
+
+    def project_inputs(self, params, xs):
+        I = self.input_size
+        return (_project(xs, params["gate_kernel"][:I]),
+                _project(xs, params["cand_kernel"][:I]))
 
 
 class ConvLSTMPeephole(Cell):
@@ -305,11 +352,22 @@ class Recurrent(Container):
         h0 = cell.init_hidden(x.shape[0], x.dtype)
         xs = jnp.moveaxis(x, 1, 0)  # time-major for scan
 
-        def body(h, x_t):
-            out, h_new = cell.step(cp, x_t, h)
-            return h_new, out
+        proj = cell.project_inputs(cp, xs)
+        if proj is not None:
+            # input half of the gate projections hoisted to one big gemm
+            # (after dropout, so masks still apply); the scan body carries
+            # only the state-dependent hidden gemm
+            def body(h, xp_t):
+                out, h_new = cell.step_projected(cp, xp_t, h)
+                return h_new, out
 
-        h_last, outs = lax.scan(body, h0, xs)
+            h_last, outs = lax.scan(body, h0, proj)
+        else:
+            def body(h, x_t):
+                out, h_new = cell.step(cp, x_t, h)
+                return h_new, out
+
+            h_last, outs = lax.scan(body, h0, xs)
         out = jnp.moveaxis(outs, 0, 1)  # back to (batch, time, ...)
         if self._return_state:
             return (out, h_last), state

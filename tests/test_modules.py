@@ -336,3 +336,39 @@ def test_module_summary():
     relu_line = [l for l in text.splitlines() if "ReLU" in l][0]
     assert " 0  " in relu_line or relu_line.rstrip().endswith("-") or \
         " 0 " in relu_line
+
+
+def test_cell_step_matches_step_projected_paths():
+    """Cell.step (the public single-step API, also Cell._apply's path) must
+    agree with Recurrent's hoisted step_projected scan — same equations,
+    shared via the base-class delegation — for every dense cell; and the
+    conv cell (no hoisting) still round-trips through the scan fallback."""
+    import numpy as np
+    from bigdl_tpu.nn import GRU, LSTM, LSTMPeephole, Recurrent, RnnCell
+
+    B, T, I, H = 3, 4, 5, 6
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(B, T, I)).astype(np.float32))
+    for cell_fn in (lambda: RnnCell(I, H), lambda: LSTM(I, H),
+                    lambda: LSTMPeephole(I, H), lambda: GRU(I, H)):
+        m = Recurrent(cell_fn()).build(jax.random.key(0))
+        cell = m.modules[0]
+        out_scan = np.asarray(m.forward(x))
+        # manual unroll through the public step() API
+        h = cell.init_hidden(B, x.dtype)
+        outs = []
+        for t in range(T):
+            o, h = cell.step(m.params[0], x[:, t], h)
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(np.stack(outs, axis=1), out_scan,
+                                   rtol=1e-5, atol=1e-6)
+
+    # non-hoisted fallback: ConvLSTMPeephole has project_inputs -> None and
+    # goes through the plain-step scan branch
+    from bigdl_tpu.nn import ConvLSTMPeephole
+    xc = jnp.asarray(np.random.default_rng(1).normal(
+        size=(2, 3, 4, 4, 3)).astype(np.float32))  # (B, T, H, W, C)
+    mc = Recurrent(ConvLSTMPeephole(3, 5, 3)).build(jax.random.key(1))
+    assert mc.modules[0].project_inputs(mc.params[0], xc) is None
+    out = np.asarray(mc.forward(xc))
+    assert out.shape == (2, 3, 4, 4, 5) and np.isfinite(out).all()
