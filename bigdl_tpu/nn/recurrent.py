@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..common import conv_accum_dtype, get_policy
+from ..utils import config as _config
 from .module import Container, Module
 
 __all__ = ["Cell", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "ConvLSTMPeephole",
@@ -39,10 +40,11 @@ class Cell(Module):
         raise NotImplementedError
 
     def step(self, params, x_t, hidden):
-        """One timestep.  Dense cells implement project_inputs/step_projected
-        and inherit this delegation (a (1,B,I) projection), so the single-step
-        path and Recurrent's hoisted scan share ONE set of equations; conv
-        cells override step() directly."""
+        """One timestep.  Cells that implement project_inputs/step_projected
+        (all built-ins, dense and conv) inherit this delegation (a (1,B,...)
+        projection), so the single-step path and Recurrent's hoisted scan
+        share ONE set of equations; custom cells may override step()
+        directly and Recurrent falls back to scanning it."""
         proj = self.project_inputs(params, x_t[None])
         if proj is None:
             raise NotImplementedError
@@ -53,9 +55,10 @@ class Cell(Module):
     # The x-half of every gate projection is state-independent, so it can
     # leave the scan: ONE (T*B, I) @ (I, G) MXU gemm up front instead of T
     # small gemms interleaved with the sequential dependency.  Exact same
-    # math (blocked matmul: [x,h] @ K == x@Kx + h@Kh), so cells that
-    # implement the pair are used automatically by Recurrent; cells that
-    # don't (conv cells) fall back to step().
+    # math (blocked matmul: [x,h] @ K == x@Kx + h@Kh).  Cells implementing
+    # the pair are hoisted automatically by Recurrent; a cell may return
+    # None from project_inputs (custom step()-only cells always do, conv
+    # cells do above a size threshold) to take the plain-step scan branch.
 
     def project_inputs(self, params, xs):
         """xs time-major (T, B, I) -> pytree scanned in place of xs, or None
@@ -291,16 +294,38 @@ class ConvLSTMPeephole(Cell):
                       dtype)
         return (z, z)
 
-    def step(self, params, x_t, hidden):
-        h, cst = hidden
+    def _gate_conv(self, x, kernel):
         n = self.SPATIAL_NDIM
-        z = jnp.concatenate([x_t, h], axis=-1)
         pad = self.kernel // 2
-        gates = lax.conv_general_dilated(
-            z, params["kernel"].astype(z.dtype),
-            (self.stride,) * n, [(pad, pad)] * n,
+        return lax.conv_general_dilated(
+            x, kernel.astype(x.dtype), (self.stride,) * n, [(pad, pad)] * n,
             dimension_numbers=self._DIM_NUMBERS[n],
-            preferred_element_type=conv_accum_dtype()) + params["bias"]
+            preferred_element_type=conv_accum_dtype())
+
+    #: hoisting materializes (T, B, *spatial, 4*output) gate projections in
+    #: HBM for the whole scan (~4x the scan's own stacked output) — above
+    #: this element count, fall back to the per-step conv instead of
+    #: risking an OOM the un-hoisted code never had
+    HOIST_MAX_ELEMENTS = int(_config.get_int("RNN_HOIST_MAX_ELEMENTS",
+                                             1 << 28))
+
+    def project_inputs(self, params, xs):
+        # conv is linear in input channels, so conv([x,h], K) splits exactly
+        # into conv(x, Kx) + conv(h, Kh); fold T into batch for ONE conv
+        t, b = xs.shape[0], xs.shape[1]
+        import math as _math
+        proj_elems = (t * b * 4 * self.output_size *
+                      _math.prod(xs.shape[2:2 + self.SPATIAL_NDIM]))
+        if proj_elems > self.HOIST_MAX_ELEMENTS:
+            return None
+        flat = xs.reshape((t * b,) + xs.shape[2:])
+        proj = self._gate_conv(flat, params["kernel"][..., : self.input_size, :])
+        return proj.reshape((t, b) + proj.shape[1:])
+
+    def step_projected(self, params, xp_t, hidden):
+        h, cst = hidden
+        gates = xp_t + self._gate_conv(
+            h, params["kernel"][..., self.input_size:, :]) + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         cf = cst.astype(jnp.float32)
         if self.with_peephole:
@@ -312,8 +337,8 @@ class ConvLSTMPeephole(Cell):
         if self.with_peephole:
             o = o + params["peep_o"] * c_new
         o = jax.nn.sigmoid(o)
-        h_new = (o * jnp.tanh(c_new)).astype(x_t.dtype)
-        return h_new, (h_new, c_new.astype(x_t.dtype))
+        h_new = (o * jnp.tanh(c_new)).astype(h.dtype)
+        return h_new, (h_new, c_new.astype(h.dtype))
 
 
 class ConvLSTMPeephole3D(ConvLSTMPeephole):

@@ -363,12 +363,78 @@ def test_cell_step_matches_step_projected_paths():
         np.testing.assert_allclose(np.stack(outs, axis=1), out_scan,
                                    rtol=1e-5, atol=1e-6)
 
-    # non-hoisted fallback: ConvLSTMPeephole has project_inputs -> None and
-    # goes through the plain-step scan branch
+    # the split-kernel hoisting must equal the ORIGINAL fused formulation
+    # conv([x,h], K): an inline independent reference, so a consistent-but-
+    # wrong slice split in project_inputs/step_projected cannot self-verify
+    from jax import lax as _lax
     from bigdl_tpu.nn import ConvLSTMPeephole
     xc = jnp.asarray(np.random.default_rng(1).normal(
         size=(2, 3, 4, 4, 3)).astype(np.float32))  # (B, T, H, W, C)
     mc = Recurrent(ConvLSTMPeephole(3, 5, 3)).build(jax.random.key(1))
-    assert mc.modules[0].project_inputs(mc.params[0], xc) is None
     out = np.asarray(mc.forward(xc))
-    assert out.shape == (2, 3, 4, 4, 5) and np.isfinite(out).all()
+    assert out.shape == (2, 3, 4, 4, 5)
+    p = mc.params[0]
+    hh = np.zeros((2, 4, 4, 5), np.float32)
+    cc = np.zeros((2, 4, 4, 5), np.float32)
+    fused = []
+    for t in range(3):
+        z = jnp.concatenate([xc[:, t], jnp.asarray(hh)], axis=-1)
+        gates = np.asarray(_lax.conv_general_dilated(
+            z, p["kernel"], (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))) + np.asarray(p["bias"])
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i = 1 / (1 + np.exp(-(i + np.asarray(p["peep_i"]) * cc)))
+        f = 1 / (1 + np.exp(-(f + np.asarray(p["peep_f"]) * cc)))
+        g = np.tanh(g)
+        cc = f * cc + i * g
+        o = 1 / (1 + np.exp(-(o + np.asarray(p["peep_o"]) * cc)))
+        hh = o * np.tanh(cc)
+        fused.append(hh)
+    np.testing.assert_allclose(np.stack(fused, axis=1), out,
+                               rtol=1e-4, atol=1e-5)
+
+    # fused-formulation reference for the dense peephole LSTM too (LSTM/GRU
+    # already have independent torch goldens)
+    from bigdl_tpu.nn import LSTMPeephole
+    mlp = Recurrent(LSTMPeephole(I, H)).build(jax.random.key(3))
+    out_lp = np.asarray(mlp.forward(x))
+    pp = mlp.params[0]
+    K, bb = np.asarray(pp["kernel"]), np.asarray(pp["bias"])
+    hh = np.zeros((B, H), np.float32)
+    cc = np.zeros((B, H), np.float32)
+    fused = []
+    for t in range(T):
+        gates = np.concatenate([np.asarray(x[:, t]), hh], axis=-1) @ K + bb
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i = 1 / (1 + np.exp(-(i + np.asarray(pp["peep_i"]) * cc)))
+        f = 1 / (1 + np.exp(-(f + np.asarray(pp["peep_f"]) * cc)))
+        g = np.tanh(g)
+        cc = f * cc + i * g
+        o = 1 / (1 + np.exp(-(o + np.asarray(pp["peep_o"]) * cc)))
+        hh = o * np.tanh(cc)
+        fused.append(hh)
+    np.testing.assert_allclose(np.stack(fused, axis=1), out_lp,
+                               rtol=1e-4, atol=1e-5)
+
+    # the non-hoisted scan branch stays for custom user cells that only
+    # implement step()
+    from bigdl_tpu.nn.recurrent import Cell
+
+    class _PlainSum(Cell):
+        hidden_size = I
+
+        def _init(self, rng_):
+            return {}
+
+        def init_hidden(self, batch_size, dtype=jnp.float32):
+            return jnp.zeros((batch_size, I), dtype)
+
+        def step(self, params, x_t, h):
+            h_new = h + x_t
+            return h_new, h_new
+
+    mp = Recurrent(_PlainSum()).build(jax.random.key(2))
+    assert mp.modules[0].project_inputs({}, x) is None
+    out_p = np.asarray(mp.forward(x))
+    np.testing.assert_allclose(out_p[:, -1], np.asarray(x).sum(axis=1),
+                               rtol=1e-6)
