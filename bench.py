@@ -418,12 +418,18 @@ def _cfg_lstm():
 
 
 CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
-           "lenet": _cfg_lenet, "inception_v1": _cfg_inception_v1,
+           "inception_v1": _cfg_inception_v1,
            "textcnn": _cfg_textcnn, "lstm": _cfg_lstm,
            "transformer_lm": _cfg_transformer_lm,
-           # inference (Predictor/Evaluator path, fwd-only MFU); last so the
-           # soft budget never skips a train config in its favor
-           "resnet50_infer_bf16": _cfg_resnet50_bf16}
+           # inference (Predictor/Evaluator path, fwd-only MFU); after the
+           # fast-compiling train configs so the soft budget prefers them
+           "resnet50_infer_bf16": _cfg_resnet50_bf16,
+           # LAST: lenet's small-channel conv backward is pathological to
+           # compile on this backend (800-900s, twice coincident with a
+           # compile-service crash — docs/benchmarking.md); running it last
+           # means a stall there costs only lenet, never the configs after
+           # it (exactly what the 2026-07-31 run lost)
+           "lenet": _cfg_lenet}
 INFER_CONFIGS = {"resnet50_infer_bf16"}
 
 

@@ -302,21 +302,22 @@ class ConvLSTMPeephole(Cell):
             dimension_numbers=self._DIM_NUMBERS[n],
             preferred_element_type=conv_accum_dtype())
 
-    #: hoisting materializes (T, B, *spatial, 4*output) gate projections in
-    #: HBM for the whole scan (~4x the scan's own stacked output) — above
-    #: this element count, fall back to the per-step conv instead of
-    #: risking an OOM the un-hoisted code never had
-    HOIST_MAX_ELEMENTS = int(_config.get_int("RNN_HOIST_MAX_ELEMENTS",
-                                             1 << 28))
-
     def project_inputs(self, params, xs):
         # conv is linear in input channels, so conv([x,h], K) splits exactly
-        # into conv(x, Kx) + conv(h, Kh); fold T into batch for ONE conv
-        t, b = xs.shape[0], xs.shape[1]
+        # into conv(x, Kx) + conv(h, Kh); fold T into batch for ONE conv.
+        # Hoisting materializes (T, B, *spatial, 4*output) gate projections
+        # in HBM for the whole scan (~4x the scan's own stacked output) —
+        # above BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS, fall back to the per-step
+        # conv instead of risking an OOM the un-hoisted code never had.
+        # t == 1 (the Cell.step delegation) is exempt: a one-step projection
+        # is the very gates tensor the fused per-step conv materializes too,
+        # so there is no fallback with a smaller working set.
         import math as _math
+        t, b = xs.shape[0], xs.shape[1]
         proj_elems = (t * b * 4 * self.output_size *
                       _math.prod(xs.shape[2:2 + self.SPATIAL_NDIM]))
-        if proj_elems > self.HOIST_MAX_ELEMENTS:
+        if t > 1 and proj_elems > _config.get_int("RNN_HOIST_MAX_ELEMENTS",
+                                                  1 << 28):
             return None
         flat = xs.reshape((t * b,) + xs.shape[2:])
         proj = self._gate_conv(flat, params["kernel"][..., : self.input_size, :])

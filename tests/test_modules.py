@@ -341,8 +341,9 @@ def test_module_summary():
 def test_cell_step_matches_step_projected_paths():
     """Cell.step (the public single-step API, also Cell._apply's path) must
     agree with Recurrent's hoisted step_projected scan — same equations,
-    shared via the base-class delegation — for every dense cell; and the
-    conv cell (no hoisting) still round-trips through the scan fallback."""
+    shared via the base-class delegation — for every dense cell; the conv
+    cell's hoisted split must equal the original fused conv formulation;
+    and custom step()-only cells still take the plain scan fallback."""
     import numpy as np
     from bigdl_tpu.nn import GRU, LSTM, LSTMPeephole, Recurrent, RnnCell
 
@@ -438,3 +439,29 @@ def test_cell_step_matches_step_projected_paths():
     out_p = np.asarray(mp.forward(x))
     np.testing.assert_allclose(out_p[:, -1], np.asarray(x).sum(axis=1),
                                rtol=1e-6)
+
+
+def test_convlstm_hoist_cap_falls_back_without_crashing(monkeypatch):
+    """Over BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS the sequence projection is
+    refused (per-step scan fallback), but the t=1 Cell.step delegation is
+    exempt — a one-step projection is the same gates tensor the fused conv
+    would materialize, so there is no smaller-footprint fallback to prefer.
+    Regression: with the cap applied at t=1 too, forward() raised
+    NotImplementedError in exactly the regime the cap was meant to protect."""
+    import numpy as np
+    from bigdl_tpu.nn import ConvLSTMPeephole, Recurrent
+
+    monkeypatch.setenv("BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS", "1")
+    xc = jnp.asarray(np.random.default_rng(2).normal(
+        size=(2, 3, 4, 4, 3)).astype(np.float32))
+    m = Recurrent(ConvLSTMPeephole(3, 5, 3)).build(jax.random.key(0))
+    cell = m.modules[0]
+    xs_tm = jnp.moveaxis(xc, 1, 0)
+    assert cell.project_inputs(m.params[0], xs_tm) is None  # sequence: refused
+    out = np.asarray(m.forward(xc))                          # fallback works
+    assert out.shape == (2, 3, 4, 4, 5) and np.isfinite(out).all()
+
+    # and it computes the same thing as the unguarded hoisted path
+    monkeypatch.setenv("BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS", str(1 << 28))
+    out_hoisted = np.asarray(m.forward(xc))
+    np.testing.assert_allclose(out, out_hoisted, rtol=1e-5, atol=1e-6)
