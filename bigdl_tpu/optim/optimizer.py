@@ -59,7 +59,7 @@ logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
            "Predictor", "Validator", "DistriValidator", "LocalValidator",
-           "ConfigurationError"]
+           "ConfigurationError", "TrainingPreempted"]
 
 
 def _as_dataset(dataset):
@@ -84,6 +84,16 @@ class ConfigurationError(ValueError):
     """A deterministic setup error (empty validation set, bad shapes): the
     fault-tolerance retry loop re-raises it immediately instead of burning
     retries — transient-failure recovery cannot fix configuration."""
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised after a SIGTERM-triggered final checkpoint (spot/preemptible
+    TPU eviction).  Net-new vs the reference (its executor count was fixed,
+    Engine.scala:326-338; preemption is a TPU-cloud reality): the training
+    loop converts the signal into one forced synchronous snapshot and this
+    exception, which the retry loop re-raises immediately — the process is
+    being evicted, recovery happens on the NEXT incarnation via the normal
+    checkpoint-resume path."""
 
 
 def _any_deleted(tree) -> bool:
@@ -539,10 +549,40 @@ class Optimizer:
         # starting weights, not a previous run's (the guard inside
         # _optimize_impl keeps it stable across retry re-entries only)
         self._initial_blob = None
+        self._preempted = False
+        old_handlers = {}
+        if self.checkpoint_path is not None and \
+                config.get_bool("PREEMPTION_CHECKPOINT", True):
+            import signal as _signal
+
+            def _on_preempt(signum, frame):
+                # signal-safe: set a flag ONLY — logging here can hit a
+                # reentrant call into the very stream the interrupted main
+                # thread was writing; the flag is logged when observed at
+                # the next step boundary
+                self._preempted = True
+
+            try:
+                old_handlers[_signal.SIGTERM] = _signal.signal(
+                    _signal.SIGTERM, _on_preempt)
+            except ValueError:
+                pass  # not the main thread: no signal-based preemption
+        try:
+            return self._optimize_with_retry(retries, max_retries, window,
+                                             last_failure)
+        finally:
+            if old_handlers:
+                import signal as _signal
+                for sig, h in old_handlers.items():
+                    _signal.signal(sig, h)
+
+    def _optimize_with_retry(self, retries, max_retries, window,
+                             last_failure) -> Module:
         while True:
             try:
                 return self._optimize_impl()
-            except (KeyboardInterrupt, ConfigurationError):
+            except (KeyboardInterrupt, ConfigurationError,
+                    TrainingPreempted):
                 raise
             except Exception:
                 now = time.monotonic()
@@ -779,8 +819,23 @@ class Optimizer:
                                 name, np.asarray(leaf), neval)
                 state["neval"] = neval + 1
                 state["evalCounter"] = state.get("evalCounter", 0) + 1
-                self._maybe_validate(params, net_state, state)
-                self._maybe_checkpoint(params, net_state, state, opt_state)
+                # decide preempt/fire ONCE (collective in multi-host) so the
+                # eviction grace period is not spent on a validation pass
+                preempt, fire = self._checkpoint_decision(state)
+                if not preempt:
+                    self._maybe_validate(params, net_state, state)
+                if fire:
+                    self._write_checkpoint(params, net_state, state,
+                                           opt_state, preempt=preempt)
+                if preempt:
+                    self._drain_ckpt_futures()
+                    logger.warning("preemption signal observed: final "
+                                   "checkpoint written, stopping")
+                    raise TrainingPreempted(
+                        "SIGTERM: final checkpoint written at iteration "
+                        f"{state['neval'] - 1}; resume with "
+                        "Optimizer.resume_from or the retry loop of the "
+                        "next incarnation")
             if pending_loss is not None:
                 state["loss"] = float(pending_loss)
                 pending_loss = None
@@ -792,8 +847,19 @@ class Optimizer:
             state["epoch"] += 1
             # every_epoch triggers observe the epoch increment (state-only
             # predicate, Trigger.scala:37): fire validation/checkpoint now
-            self._maybe_validate(params, net_state, state)
-            self._maybe_checkpoint(params, net_state, state, opt_state)
+            preempt, fire = self._checkpoint_decision(state)
+            if not preempt:
+                self._maybe_validate(params, net_state, state)
+            if fire:
+                self._write_checkpoint(params, net_state, state, opt_state,
+                                       preempt=preempt)
+            if preempt:
+                self._drain_ckpt_futures()
+                logger.warning("preemption signal observed: final "
+                               "checkpoint written, stopping")
+                raise TrainingPreempted(
+                    f"SIGTERM: final checkpoint written at epoch "
+                    f"{state['epoch'] - 1}")
 
         file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
         self._ckpt_futures = []  # write errors surfaced above
@@ -906,7 +972,7 @@ class Optimizer:
         slices, TP weights) are NOT addressable from one host —
         np.asarray would raise — so they are process_allgather'd.  This is
         a COLLECTIVE: every process must call it, which is why the rank-0
-        write gate in _maybe_checkpoint comes AFTER this step.  Replicated
+        write gate in _write_checkpoint comes AFTER this step.  Replicated
         leaves pass through (np.asarray reads the local replica)."""
         def fetch(leaf):
             if hasattr(leaf, "is_fully_addressable") and \
@@ -918,20 +984,46 @@ class Optimizer:
             return leaf
         return jax.tree.map(fetch, tree)
 
-    def _maybe_checkpoint(self, params, net_state, state, opt_state=None):
-        if self.checkpoint_trigger is None or self.checkpoint_path is None:
-            return
-        fire = bool(self.checkpoint_trigger(state))
+    def _checkpoint_decision(self, state, force=False):
+        """(preempt, fire), globally CONSISTENT in multi-host.
+
+        Divergent per-rank decisions would deadlock the process_allgather
+        inside the write (some ranks gathering, others already returned), so
+        both bits are OR-reduced across ranks: triggers may read
+        rank-divergent state (per-shard validation scores) and SIGTERM
+        delivery is per-process — a maintenance event can evict ONE host,
+        and that host's signal must still force everyone's final snapshot.
+        Every rank with a checkpoint path reaches this collective every
+        call (no trigger-dependent early return — checkpoint_path is the
+        only rank-consistent guard)."""
+        preempt = force or getattr(self, "_preempted", False)
+        if self.checkpoint_path is None:
+            return False, False
+        fire = preempt or (self.checkpoint_trigger is not None and
+                           bool(self.checkpoint_trigger(state)))
         if jax.process_count() > 1:
-            # rank 0 DECIDES for everyone: triggers can read rank-divergent
-            # state (per-shard validation scores), and a divergent decision
-            # would deadlock the process_allgather collective below — some
-            # ranks gathering, others already returned
             from jax.experimental import multihost_utils
-            fire = bool(multihost_utils.broadcast_one_to_all(
-                np.int32(fire)))
-        if not fire:
-            return
+            bits = multihost_utils.process_allgather(
+                np.asarray([preempt, fire], np.int32))
+            preempt = bool(bits[:, 0].max())
+            fire = preempt or bool(bits[:, 1].max())
+        return preempt, fire
+
+    def _drain_ckpt_futures(self):
+        """Join pending async writes, logging (not raising) failures — used
+        on the preemption path where only the final sync snapshot matters."""
+        try:
+            file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("async checkpoint write failed before "
+                           "preemption stop (final sync snapshot is the "
+                           "trustworthy one): %s", e)
+        self._ckpt_futures = []
+
+    def _write_checkpoint(self, params, net_state, state, opt_state=None,
+                          preempt=False):
+        """The snapshot write; `preempt` must come from _checkpoint_decision
+        so it is rank-consistent."""
         # collective gather of process-sharded leaves BEFORE the rank gate
         params = self._host_fetchable(params)
         net_state = self._host_fetchable(net_state)
@@ -945,7 +1037,9 @@ class Optimizer:
         # the opt_state pytree (momentum / Adam m,v,t slots) must be persisted
         # too — the reference serializes the whole optimMethod incl. its state
         # Table (optim/Optimizer.scala:284-322)
-        is_async = getattr(self, "checkpoint_async", False)
+        # forced writes (preemption grace period) are synchronous: the
+        # process is about to exit and must not race its own shutdown
+        is_async = getattr(self, "checkpoint_async", False) and not preempt
         if is_async:
             def writer(*a, **kw):
                 # per-instance future tracking: this run joins only its own
@@ -966,9 +1060,10 @@ class Optimizer:
              "driver_state": {k: v for k, v in state.items()
                               if not k.startswith("_")}},
             overwrite=self.is_overwrite)
-        logger.info("checkpoint %s at iteration %d -> %s",
+        logger.info("checkpoint %s at iteration %d -> %s%s",
                     "queued (async)" if is_async else "written",
-                    neval, self.checkpoint_path)
+                    neval, self.checkpoint_path,
+                    " (preemption final snapshot)" if preempt else "")
 
 
 class DistriOptimizer(Optimizer):

@@ -1,0 +1,106 @@
+"""Graceful-preemption checkpointing (SIGTERM → final snapshot → stop).
+
+Net-new vs the reference (its executor topology was fixed at init,
+Engine.scala:326-338): on spot/preemptible TPUs the eviction signal is a
+SIGTERM with a grace period, and the training loop must convert it into
+one forced synchronous checkpoint plus TrainingPreempted.  Driven in a
+subprocess so the signal handling is exercised for real.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+CHILD = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    import sys, json
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    from bigdl_tpu import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import (Optimizer, Adam, Trigger,
+                                 TrainingPreempted)
+
+    r = np.random.default_rng(0)
+    samples = [Sample(r.normal(size=(28, 28)).astype(np.float32),
+                      np.int32(r.integers(0, 10))) for _ in range(256)]
+    Engine.init()
+    opt = Optimizer(LeNet5(10), samples, nn.ClassNLLCriterion(),
+                    batch_size=64)
+    opt.set_optim_method(Adam(1e-3))
+    opt.set_checkpoint({ckpt!r}, Trigger.several_iteration(10**9))
+    opt.set_end_when(Trigger.max_epoch(10**6))   # run until preempted
+    print("READY", flush=True)
+    try:
+        opt.optimize()
+    except TrainingPreempted as e:
+        print("PREEMPTED:" + str(e), flush=True)
+        sys.exit(17)
+    sys.exit(3)  # finished without preemption: the test failed to signal
+""")
+
+
+def _spawn(repo, ckpt):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(
+        [sys.executable, "-c", CHILD.format(repo=repo, ckpt=ckpt)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+
+
+def test_sigterm_writes_final_checkpoint_and_resume_works(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = str(tmp_path / "ckpt")
+    proc = _spawn(repo, ckpt)
+    try:
+        # wait for the child to be inside optimize() (it prints READY just
+        # before), then give it time to enter the step loop and deliver
+        # SIGTERM mid-training
+        line = proc.stdout.readline()
+        assert "READY" in line, line
+        time.sleep(20)
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 17, (proc.returncode, out, err[-2000:])
+    assert "PREEMPTED:" in out, (out, err[-2000:])
+
+    # the forced snapshot exists and a fresh process resumes from it
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from bigdl_tpu.utils import file_io
+    latest = file_io.latest_checkpoint(ckpt)
+    assert latest is not None, os.listdir(ckpt)
+    model_path, optim_path, neval = latest
+    assert neval >= 1
+
+    from bigdl_tpu import Engine
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import Optimizer, Adam, Trigger
+    r = np.random.default_rng(0)
+    samples = [Sample(r.normal(size=(28, 28)).astype(np.float32),
+                      np.int32(r.integers(0, 10))) for _ in range(128)]
+    Engine.init()
+    opt = Optimizer(LeNet5(10), samples, nn.ClassNLLCriterion(),
+                    batch_size=64)
+    opt.set_optim_method(Adam(1e-3))
+    opt.resume_from(model_path, optim_path)
+    # resumed iteration counter carries on from the preempted run
+    assert opt._resume_state["neval"] > 1
+    opt.set_end_when(Trigger.max_iteration(
+        opt._resume_state["neval"] + 2))
+    trained = opt.optimize()   # a couple more steps complete cleanly
+    assert trained is not None
