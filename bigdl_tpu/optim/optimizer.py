@@ -264,6 +264,10 @@ class Optimizer:
     def set_validation(self, trigger: Trigger, dataset, methods:
                        Sequence[ValidationMethod], batch_size: int = None):
         self.validation_trigger = trigger
+        coerced = _as_dataset(dataset)  # raw Sample lists, like every entry
+        if coerced is not dataset and batch_size is None:
+            batch_size = 128  # raw samples need batching; cluster default
+        dataset = coerced
         if batch_size is not None:
             dataset = dataset.transform(
                 SampleToMiniBatch(batch_size, pad_last=True))
@@ -567,6 +571,9 @@ class Optimizer:
                     _signal.SIGTERM, _on_preempt)
             except ValueError:
                 pass  # not the main thread: no signal-based preemption
+        # rank-consistent (checkpoint_path and the env knob must agree
+        # across ranks): gates the per-step preemption collectives
+        self._preemption_armed = bool(old_handlers)
         try:
             return self._optimize_with_retry(retries, max_retries, window,
                                              last_failure)
@@ -626,13 +633,7 @@ class Optimizer:
         # in-flight writes must land before the directory scan; a FAILED
         # write must not abort recovery (older snapshots remain valid, and
         # sync-write errors would have been retried the same way)
-        try:
-            file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
-        except Exception as e:  # noqa: BLE001
-            logger.warning("async checkpoint write failed before "
-                           "recovery (continuing with older snapshots): %s",
-                           e)
-        self._ckpt_futures = []
+        self._drain_ckpt_futures(context="recovery")
         latest = file_io.latest_checkpoint(self.checkpoint_path)
         if latest is None:
             # failure before the first snapshot: the crashed attempt's
@@ -819,11 +820,15 @@ class Optimizer:
                                 name, np.asarray(leaf), neval)
                 state["neval"] = neval + 1
                 state["evalCounter"] = state.get("evalCounter", 0) + 1
-                # decide preempt/fire ONCE (collective in multi-host) so the
-                # eviction grace period is not spent on a validation pass
-                preempt, fire = self._checkpoint_decision(state)
+                # preemption skips validation (the eviction grace period is
+                # for the snapshot); otherwise validation runs FIRST so
+                # score-reading checkpoint triggers (max_score, plateau)
+                # see this boundary's fresh result — reference order
+                preempt = self._global_preempted()
                 if not preempt:
                     self._maybe_validate(params, net_state, state)
+                preempt, fire = self._checkpoint_decision(state,
+                                                          force=preempt)
                 if fire:
                     self._write_checkpoint(params, net_state, state,
                                            opt_state, preempt=preempt)
@@ -847,9 +852,10 @@ class Optimizer:
             state["epoch"] += 1
             # every_epoch triggers observe the epoch increment (state-only
             # predicate, Trigger.scala:37): fire validation/checkpoint now
-            preempt, fire = self._checkpoint_decision(state)
+            preempt = self._global_preempted()
             if not preempt:
                 self._maybe_validate(params, net_state, state)
+            preempt, fire = self._checkpoint_decision(state, force=preempt)
             if fire:
                 self._write_checkpoint(params, net_state, state, opt_state,
                                        preempt=preempt)
@@ -1009,15 +1015,28 @@ class Optimizer:
             fire = preempt or bool(bits[:, 1].max())
         return preempt, fire
 
-    def _drain_ckpt_futures(self):
+    def _global_preempted(self) -> bool:
+        """The preemption flag, OR-reduced across ranks so every rank skips
+        (or runs) validation together — a divergent skip would deadlock
+        validation's own sharded-forward collectives.  No collective unless
+        preemption is armed (checkpoint path + env knob, rank-consistent)."""
+        pre = getattr(self, "_preempted", False)
+        if getattr(self, "_preemption_armed", False) and \
+                jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            pre = bool(multihost_utils.process_allgather(
+                np.int32(pre)).max())
+        return pre
+
+    def _drain_ckpt_futures(self, context="preemption stop"):
         """Join pending async writes, logging (not raising) failures — used
-        on the preemption path where only the final sync snapshot matters."""
+        where recovery/shutdown must proceed on older snapshots regardless."""
         try:
             file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
         except Exception as e:  # noqa: BLE001
-            logger.warning("async checkpoint write failed before "
-                           "preemption stop (final sync snapshot is the "
-                           "trustworthy one): %s", e)
+            logger.warning("async checkpoint write failed before %s "
+                           "(older/final snapshots remain the trustworthy "
+                           "ones): %s", context, e)
         self._ckpt_futures = []
 
     def _write_checkpoint(self, params, net_state, state, opt_state=None,

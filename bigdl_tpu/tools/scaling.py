@@ -45,6 +45,17 @@ def collective_counts(hlo_text: str) -> dict:
     return counts
 
 
+def _devices(n: int):
+    import jax
+
+    devices = jax.devices()[:n]
+    assert len(devices) == n, (
+        f"need {n} devices, have {len(devices)} — launch with "
+        f"JAX_PLATFORMS=cpu (fresh process) so the virtual-device config "
+        f"can take effect")
+    return devices
+
+
 def _build(n_devices: int, batch_per_device: int):
     import jax
     import jax.numpy as jnp
@@ -55,11 +66,7 @@ def _build(n_devices: int, batch_per_device: int):
     from ..nn import ClassNLLCriterion
     from ..optim import Optimizer, SGD, Trigger
 
-    devices = jax.devices()[:n_devices]
-    assert len(devices) == n_devices, (
-        f"need {n_devices} devices, have {len(devices)} — launch with "
-        f"JAX_PLATFORMS=cpu (fresh process) so the virtual-device config "
-        f"can take effect")
+    devices = _devices(n_devices)
     mesh = Mesh(np.asarray(devices).reshape(n_devices), ("data",))
     model = LeNet5(10).build(jax.random.key(0))
     opt = Optimizer(model, dataset=None, criterion=ClassNLLCriterion(),
@@ -115,15 +122,98 @@ def measure(n_devices: int, batch_per_device: int = 64) -> dict:
     }
 
 
+def strategy_signatures(n_devices: int) -> dict:
+    """Collective signature of every parallelism strategy, compiled on the
+    virtual mesh: evidence that each strategy lowers to the expected ICI
+    collectives (not a Python-side simulation of them).
+
+    Expected shapes — DP: gradient all-reduce; ZeRO/ShardedDP:
+    reduce-scatter (or windowed all-reduce) + all-gather of sharded
+    params/opt-state; DP x TP: all-reduces on both the gradient and the
+    activation path; ring SP: collective-permute chain (the shard_map
+    ppermute ring); Ulysses SP: all-to-alls re-sharding heads<->sequence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..models.lenet import LeNet5
+    from ..nn import ClassNLLCriterion
+    from ..optim import Optimizer, SGD, Trigger
+    from ..parallel.ring_attention import ring_attention, ulysses_attention
+    from ..parallel.sharding import (DataParallel, ShardedDataParallel,
+                                     TensorParallel)
+
+    devices = _devices(n_devices)
+    out = {}
+
+    def train_step_hlo(mesh, strategy):
+        model = LeNet5(10).build(jax.random.key(0))
+        opt = Optimizer(model, dataset=None, criterion=ClassNLLCriterion(),
+                        end_trigger=Trigger.max_iteration(1),
+                        strategy=strategy)
+        opt.set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
+        step, param_sh, data_sh = opt._build_step(mesh)
+        batch = 8 * mesh.devices.size
+        args = (jax.device_put(model.params, param_sh), model.state,
+                opt.optim_method.init_state(model.params),
+                jax.device_put(jnp.zeros((batch, 28, 28, 1), jnp.float32),
+                               data_sh),
+                jax.device_put(jnp.ones((batch,), jnp.int32), data_sh),
+                jnp.float32(0.05), jax.random.key(1))
+        return step.lower(*args).compile().as_text()
+
+    mesh1d = Mesh(np.asarray(devices).reshape(n_devices), ("data",))
+    out[f"dp{n_devices}"] = collective_counts(
+        train_step_hlo(mesh1d, DataParallel()))
+    out[f"zero{n_devices}"] = collective_counts(
+        train_step_hlo(mesh1d, ShardedDataParallel(min_size=1)))
+    if n_devices % 2 == 0:
+        mesh2d = Mesh(np.asarray(devices).reshape(n_devices // 2, 2),
+                      ("data", "model"))
+
+        def tp_rule(path, leaf):
+            # shard every even last axis: TensorParallel's default rule has
+            # a 2^16-element floor that (correctly) leaves LeNet's small
+            # weights replicated, which would make this signature a plain
+            # DP one — the point here is the ENGAGED-TP collective shape
+            from jax.sharding import PartitionSpec as P
+            if leaf.ndim >= 2 and leaf.shape[-1] % 2 == 0:
+                return P(*([None] * (leaf.ndim - 1) + ["model"]))
+            return P()
+
+        out[f"dp{n_devices // 2}xtp2"] = collective_counts(
+            train_step_hlo(mesh2d, TensorParallel(rule=tp_rule)))
+
+    seq_mesh = Mesh(np.asarray(devices).reshape(n_devices), ("seq",))
+    B, H, T, D = 2, n_devices, 4 * n_devices, 8
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32)
+               for kk in jax.random.split(jax.random.key(2), 3))
+    out[f"ring_sp{n_devices}"] = collective_counts(
+        jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh=seq_mesh, causal=True, batch_axis=None)
+        ).lower(q, k, v).compile().as_text())
+    out[f"ulysses_sp{n_devices}"] = collective_counts(
+        jax.jit(lambda a, b, c: ulysses_attention(
+            a, b, c, mesh=seq_mesh, causal=True, batch_axis=None)
+        ).lower(q, k, v).compile().as_text())
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--batch-per-device", type=int, default=64)
+    ap.add_argument("--no-strategies", action="store_true",
+                    help="skip the per-strategy collective signatures")
     args = ap.parse_args(argv)
 
     from ..utils.platform import force_cpu
     force_cpu(args.devices)
-    print(json.dumps(measure(args.devices, args.batch_per_device)))
+    result = measure(args.devices, args.batch_per_device)
+    if not args.no_strategies:
+        result["strategy_collectives"] = strategy_signatures(args.devices)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

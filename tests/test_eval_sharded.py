@@ -129,3 +129,20 @@ def test_module_evaluate_accepts_raw_sample_list():
     res = model.evaluate(_samples(24), [Top1Accuracy()])
     _, n = res[0][1].result()
     assert n == 24
+
+
+def test_set_validation_accepts_raw_sample_list():
+    """set_validation joins the raw-Sample-list contract of every other
+    entry point (found by an end-to-end drive: _run_validation crashed on
+    'list' object has no attribute 'data' while training ran fine)."""
+    from bigdl_tpu.optim import Adam, Optimizer, Top1Accuracy, Trigger
+    import bigdl_tpu.nn as nn
+    Engine.init()
+    samples = _samples(96)
+    opt = Optimizer(LeNet5(10), samples, nn.ClassNLLCriterion(),
+                    batch_size=32)
+    opt.set_optim_method(Adam(1e-3))
+    opt.set_validation(Trigger.several_iteration(2), samples[:32],
+                       [Top1Accuracy()])
+    opt.set_end_when(Trigger.max_iteration(5))
+    assert opt.optimize() is not None
