@@ -1,0 +1,67 @@
+"""Persistent XLA compilation cache (utils/platform.enable_compilation_cache).
+
+The mitigation for this backend's pathological remote compiles
+(docs/benchmarking.md): entries must be written to the configured dir and
+reused across processes. Driven in subprocesses so the cache config lands
+before any compile, as in real bench runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+
+def _run(cache_dir, repo):
+    code = textwrap.dedent(f"""
+        import json, os, sys, time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {repo!r})
+        os.environ["BIGDL_TPU_XLA_CACHE_DIR"] = {cache_dir!r}
+        from bigdl_tpu.utils.platform import enable_compilation_cache
+        path = enable_compilation_cache()
+        assert path == {cache_dir!r}, path
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return jnp.tanh(x @ x) * 2 + 1
+        x = jnp.ones((333, 333))
+        t0 = time.time()
+        float(f(x).sum())
+        print(json.dumps({{"seconds": time.time() - t0}}))
+    """)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_cache_written_and_reused_across_processes(tmp_path):
+    cache = str(tmp_path / "xla")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _run(cache, repo)
+    entries = os.listdir(cache)
+    assert entries, "no cache entries written"
+    mtimes = {e: os.path.getmtime(os.path.join(cache, e)) for e in entries}
+    _run(cache, repo)  # second process: must REUSE, not rewrite, the entry
+    jit_entries = [e for e in os.listdir(cache) if e.startswith("jit_f")]
+    assert jit_entries
+    for e in jit_entries:
+        assert os.path.getmtime(os.path.join(cache, e)) == mtimes.get(e), \
+            "jit_f cache entry rewritten on warm run"
+
+
+def test_cache_disabled_by_env(tmp_path):
+    import importlib
+    env_backup = dict(os.environ)
+    try:
+        os.environ["BIGDL_TPU_XLA_CACHE"] = "0"
+        from bigdl_tpu.utils.platform import enable_compilation_cache
+        assert enable_compilation_cache(str(tmp_path / "nope")) is None
+        assert not (tmp_path / "nope").exists()
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
