@@ -55,7 +55,6 @@ def test_cache_written_and_reused_across_processes(tmp_path):
 
 
 def test_cache_disabled_by_env(tmp_path):
-    import importlib
     env_backup = dict(os.environ)
     try:
         os.environ["BIGDL_TPU_XLA_CACHE"] = "0"

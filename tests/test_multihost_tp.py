@@ -1,8 +1,8 @@
-"""Multi-host DP x TP integration: 2 real processes x 2 virtual CPU devices
-forming a (data=2, model=2) global mesh, training with a TensorParallel
-rule — the cross-host form of the dryrun's flagship sharding.
+"""Multi-host DP x TP integration: real OS processes x 2 virtual CPU devices
+forming a (model, data) global mesh, training with a TensorParallel rule —
+the cross-host form of the dryrun's flagship sharding.
 
-Extends tests/test_multihost.py (pure DP) to the 2-D mesh: TP shards cross
+Extends tests/test_multihost.py (pure DP) to 2-D meshes: TP shards cross
 process boundaries, so every compiled step's collectives ride the Gloo
 inter-process backend — evidence the net-new parallelism (SURVEY.md §7)
 works beyond one host."""
@@ -13,14 +13,16 @@ import pytest
 
 from conftest import spawn_multihost_workers
 
-_WORKER = textwrap.dedent("""
+# one template for every process count: the two scenarios must not drift
+# (they once disagreed on incidental seeds/epochs)
+_WORKER_TEMPLATE = textwrap.dedent("""
     import json
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
 
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from bigdl_tpu.utils.engine import Engine
     import bigdl_tpu.nn as nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
@@ -30,9 +32,12 @@ _WORKER = textwrap.dedent("""
     # 'model' FIRST: the global device list orders process 0's devices
     # before process 1's (row-major reshape), so the leading axis is the
     # one that spans processes — TP collectives must ride the inter-process
-    # backend, not stay intra-host
-    mesh = Engine.init(mesh_shape={"model": 2, "data": 2})
-    assert jax.process_count() == 2
+    # backend, not stay intra-host.  With data > processes the data axis
+    # crosses process boundaries too (the closest one machine gets to the
+    # v5e-pod topology, BASELINE.md "linear 8 -> 64").
+    mesh = Engine.init(mesh_shape={{"model": 2, "data": {data}}})
+    assert jax.process_count() == {nproc}
+    assert jax.device_count() == {data} * 2
     rank = jax.process_index()
 
     r = np.random.default_rng(7)  # SAME data on every process
@@ -57,26 +62,37 @@ _WORKER = textwrap.dedent("""
     opt = (Optimizer(model, ds, nn.ClassNLLCriterion(),
                      strategy=TensorParallel(rule=tp_rule))
            .set_optim_method(Adam(1e-2))
-           .set_end_when(Trigger.max_epoch(20)))
+           .set_end_when(Trigger.max_epoch({epochs})))
     trained = opt.optimize()
 
-    # the TP-sharded weight spans both processes; gather it for the digest
+    # the TP-sharded weight spans processes; gather it for the digest
     from jax.experimental import multihost_utils
     w1 = multihost_utils.process_allgather(trained.params[0]["weight"],
                                            tiled=True)
     digest = float(np.abs(np.asarray(w1)).sum())
     loss = opt.optim_method.hyper["loss"]
-    print(json.dumps({"rank": rank, "loss": loss, "digest": digest}),
+    print(json.dumps({{"rank": rank, "loss": loss, "digest": digest}}),
           flush=True)
 """)
 
 
-def test_two_process_dp_tp_training(tmp_path):
-    outs = spawn_multihost_workers(_WORKER, tmp_path)
+def _run_dp_tp(tmp_path, nproc, epochs):
+    worker = _WORKER_TEMPLATE.format(nproc=nproc, data=nproc, epochs=epochs)
+    outs = spawn_multihost_workers(worker, tmp_path, n=nproc)
     by_rank = {o["rank"]: o for o in outs}
-    assert set(by_rank) == {0, 1}
+    assert set(by_rank) == set(range(nproc))
     for o in outs:
         assert o["loss"] < 0.5, o  # learned the separable blobs
-    # the allgathered TP weight must agree bit-for-bit across processes
-    assert by_rank[0]["digest"] == pytest.approx(by_rank[1]["digest"],
-                                                 rel=1e-6)
+        # the allgathered TP weight must agree across all processes
+        assert o["digest"] == pytest.approx(by_rank[0]["digest"], rel=1e-6)
+
+
+def test_two_process_dp_tp_training(tmp_path):
+    _run_dp_tp(tmp_path, nproc=2, epochs=20)
+
+
+def test_four_process_dp_tp_training(tmp_path):
+    """8 global devices across 4 OS processes — both mesh axes span
+    process boundaries (the 2-process case's model axis does, but its data
+    axis stays intra-process)."""
+    _run_dp_tp(tmp_path, nproc=4, epochs=12)
