@@ -463,12 +463,14 @@ class _EmitCtx:
         self.ch: Optional[int] = None      # channels of the current tensor
         self.spatial = True                # current tensor is 4-D NHWC
         self.flat_ch: Optional[int] = None  # channels before the flatten
+        self.topology: List[tuple] = []    # (name, type, bottoms, top)
 
     def layer(self, type_s: str, bottoms, blobs=(), extra: bytes = b"",
               top: str = None) -> str:
         name = f"{type_s.lower()}_{self.n}"
         self.n += 1
         top = top or name
+        self.topology.append((name, type_s, list(bottoms), top))
         body = (pbwire.field_string(1, name) +
                 pbwire.field_string(2, type_s))
         for b in bottoms:
@@ -495,13 +497,29 @@ class CaffePersister:
 
     @classmethod
     def save(cls, model, params, path: str, net_name: str = "bigdl_tpu",
-             state=None):
+             state=None, prototxt_path: str = None):
+        """Binary NetParameter to `path`; with `prototxt_path`, also a text
+        net definition (layer name/type/bottom/top topology, weight-free) —
+        the two-file contract of the reference's
+        CaffePersister.saveToCaffe(prototxtPath, modelPath)."""
         if state is None:
             state = getattr(model, "state", None)
         ctx = _EmitCtx()
         cls._emit(model, params, state, "data", ctx)
         with open(path, "wb") as f:
             f.write(b"".join([pbwire.field_string(1, net_name)] + ctx.chunks))
+        if prototxt_path is not None:
+            lines = [f'name: "{net_name}"']
+            for name, type_s, bottoms, top in ctx.topology:
+                lines.append("layer {")
+                lines.append(f'  name: "{name}"')
+                lines.append(f'  type: "{type_s}"')
+                for b in bottoms:
+                    lines.append(f'  bottom: "{b}"')
+                lines.append(f'  top: "{top}"')
+                lines.append("}")
+            with open(prototxt_path, "w") as f:
+                f.write("\n".join(lines) + "\n")
         return path
 
     @staticmethod
@@ -775,6 +793,8 @@ class CaffePersister:
             " (reference also persisted a fixed layer set)")
 
 
-def save_caffe(model, params, path: str, state=None):
+def save_caffe(model, params, path: str, state=None,
+               prototxt_path: str = None):
     """(reference: Module.saveCaffe via CaffePersister)."""
-    return CaffePersister.save(model, params, path, state=state)
+    return CaffePersister.save(model, params, path, state=state,
+                               prototxt_path=prototxt_path)

@@ -380,6 +380,148 @@ class Module:
         # seen-set is shared across recursion
         yield from walk(self)
 
+    # -- facade parity: weight interchange, prediction, interop savers ---
+    # (AbstractModule.scala's public surface beyond the training core)
+
+    def update_output(self, input):
+        """Alias of forward for reference-API parity (updateOutput is the
+        compute half of AbstractModule.forward; this facade never separates
+        them because timing lives in get_times' profiler instead)."""
+        return self.forward(input)
+
+    def get_scale_w(self) -> float:
+        return self.scale_w
+
+    def get_scale_b(self) -> float:
+        return self.scale_b
+
+    def inputs(self, *nodes):
+        """Graph-building parity (`layer.inputs(node...)`,
+        AbstractModule.scala / nn/Graph.scala): identical to calling the
+        module on node(s) — returns the ModuleNode wired to `nodes`."""
+        from .graph import _node
+        return _node(self, list(nodes) if len(nodes) != 1 else nodes[0])
+
+    def clear_state(self):
+        """Drop cached activations (AbstractModule.clearState) — slims the
+        facade before serialization or cloning; parameters are untouched."""
+        self.output = None
+        self.grad_input = None
+        return self
+
+    def copy_status(self, src: "Module"):
+        """Copy cached output/gradInput (+ running state) from `src`
+        (AbstractModule.copyStatus)."""
+        self.output = src.output
+        self.grad_input = src.grad_input
+        if src.state is not None:
+            self.state = src.state
+        return self
+
+    def get_weights_bias(self):
+        """Parameter leaves in deterministic tree order
+        (AbstractModule.getWeightsBias: Array[Tensor])."""
+        if self.params is None:
+            self.build()
+        return [np.asarray(leaf) for leaf in jax.tree.leaves(self.params)]
+
+    def set_weights_bias(self, arrays):
+        """Install leaves produced by get_weights_bias (or any same-shaped
+        sequence) back into the parameter tree
+        (AbstractModule.setWeightsBias)."""
+        if self.params is None:
+            self.build()
+        leaves, treedef = jax.tree.flatten(self.params)
+        if len(arrays) != len(leaves):
+            raise ValueError(f"expected {len(leaves)} arrays, "
+                             f"got {len(arrays)}")
+        new = []
+        for i, (a, leaf) in enumerate(zip(arrays, leaves)):
+            a = jnp.asarray(a, leaf.dtype)
+            if a.shape != leaf.shape:
+                # no silent reshape: a same-element-count array in the
+                # wrong layout (e.g. a transposed Linear weight from
+                # another framework) would install scrambled weights
+                raise ValueError(
+                    f"set_weights_bias: array {i} has shape {a.shape}, "
+                    f"parameter expects {leaf.shape}")
+            new.append(a)
+        self.attach(jax.tree.unflatten(treedef, new), self.state)
+        return self
+
+    def save_weights(self, path: str, overwrite: bool = True):
+        """Weights-only snapshot (AbstractModule.saveWeights) — loadable
+        into any architecture-identical module via load_weights."""
+        from ..utils import file_io
+        file_io.save({"format": "bigdl_tpu-weights-v1",
+                      "weights": self.get_weights_bias()},
+                     path, overwrite=overwrite)
+        return self
+
+    def load_weights(self, path: str):
+        """(AbstractModule.loadWeights)"""
+        from ..utils import file_io
+        blob = file_io.load(path)
+        if not (isinstance(blob, dict) and
+                blob.get("format") == "bigdl_tpu-weights-v1"):
+            raise ValueError(f"{path!r} is not a bigdl_tpu weights file")
+        return self.set_weights_bias(blob["weights"])
+
+    def load_model_weights(self, src: "Module"):
+        """Copy another (architecture-identical) module's weights
+        (AbstractModule.loadModelWeights / copyWeights)."""
+        if src.params is None:
+            src.build()
+        # device arrays pass straight through set_weights_bias — no
+        # host round trip
+        return self.set_weights_bias(jax.tree.leaves(src.params))
+
+    copy_weights = load_model_weights
+
+    def predict(self, dataset, batch_size: int = 128):
+        """Bulk inference over a dataset or raw Sample list
+        (AbstractModule.predict -> Predictor, SURVEY.md §3.4)."""
+        from ..optim.optimizer import Predictor
+        self.training_mode = False
+        return Predictor(self, batch_size=batch_size).predict(dataset)
+
+    def predict_class(self, dataset, batch_size: int = 128):
+        """(AbstractModule.predictClass)"""
+        from ..optim.optimizer import Predictor
+        self.training_mode = False
+        return Predictor(self, batch_size=batch_size).predict_class(dataset)
+
+    def save_caffe(self, prototxt_path: str, model_path: str = None):
+        """(AbstractModule.saveCaffe(prototxtPath, modelPath) ->
+        CaffePersister).  Two-arg form writes the text net definition to
+        `prototxt_path` AND the binary caffemodel to `model_path`; one-arg
+        form writes only the binary caffemodel to the given path."""
+        from ..interop.caffe import save_caffe
+        if self.params is None:
+            self.build()
+        if model_path is None:
+            save_caffe(self, self.params, prototxt_path, state=self.state)
+        else:
+            save_caffe(self, self.params, model_path, state=self.state,
+                       prototxt_path=prototxt_path)
+        return self
+
+    def save_tf(self, path: str):
+        """(AbstractModule.saveTF -> TensorflowSaver)"""
+        from ..interop.tensorflow import save_tf
+        if self.params is None:
+            self.build()
+        save_tf(self, self.params, path, state=self.state)
+        return self
+
+    def save_torch(self, path: str):
+        """(AbstractModule.saveTorch -> TorchFile)"""
+        from ..interop.torchfile import save_torch_module
+        if self.params is None:
+            self.build()
+        save_torch_module(self, self.params, path)
+        return self
+
     def set_name(self, name: str):
         self.name = name
         return self

@@ -465,3 +465,65 @@ def test_convlstm_hoist_cap_falls_back_without_crashing(monkeypatch):
     monkeypatch.setenv("BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS", str(1 << 28))
     out_hoisted = np.asarray(m.forward(xc))
     np.testing.assert_allclose(out, out_hoisted, rtol=1e-5, atol=1e-6)
+
+
+def test_facade_parity_surface(tmp_path):
+    """The AbstractModule public-surface tail (AbstractModule.scala):
+    weight interchange (getWeightsBias/setWeightsBias/saveWeights/
+    loadWeights/loadModelWeights), predict/predictClass, updateOutput,
+    scale getters, inputs(), clearState, copyStatus, and the interop
+    saver delegates."""
+    import os
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.nn.graph import ModuleNode
+
+    def mk():
+        return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3),
+                             nn.LogSoftMax())
+
+    m = mk().build(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(5, 4)),
+                    jnp.float32)
+    y0 = np.asarray(m.forward(x))
+
+    m2 = mk().build(jax.random.key(9))
+    m2.set_weights_bias(m.get_weights_bias())
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), y0, rtol=1e-6)
+
+    m3 = mk().build(jax.random.key(5))
+    m.save_weights(str(tmp_path / "wb.bin"))
+    m3.load_weights(str(tmp_path / "wb.bin"))
+    np.testing.assert_allclose(np.asarray(m3.forward(x)), y0, rtol=1e-6)
+
+    m4 = mk()
+    m4.load_model_weights(m)   # also covers the copy_weights alias
+    np.testing.assert_allclose(np.asarray(m4.forward(x)), y0, rtol=1e-6)
+
+    samples = [Sample(np.asarray(x[i]), np.int32(0)) for i in range(5)]
+    pc = m.predict_class(samples)
+    assert pc.shape == (5,) and (pc == y0.argmax(-1)).all()
+
+    assert np.allclose(np.asarray(m.update_output(x)), y0)
+    assert m.get_scale_w() == 1.0 and m.get_scale_b() == 1.0
+    assert isinstance(nn.Linear(4, 2).inputs(nn.Input()), ModuleNode)
+    m.clear_state()
+    assert m.output is None and m.grad_input is None
+
+    conv = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2)).build(jax.random.key(1))
+    conv.save_caffe(str(tmp_path / "net.prototxt"), str(tmp_path / "net.caffemodel"))
+    conv.save_tf(str(tmp_path / "graph.pb"))
+    conv.save_torch(str(tmp_path / "net.t7"))
+    for f in ("net.caffemodel", "graph.pb", "net.t7"):
+        assert os.path.getsize(tmp_path / f) > 100, f
+    # two-arg saveCaffe writes BOTH files; the prototxt is a text net def
+    proto = (tmp_path / "net.prototxt").read_text()
+    assert proto.startswith('name:') and 'type: "Convolution"' in proto
+    # wrong-layout arrays are rejected, not silently reshaped
+    import pytest as _pytest
+    bad = [np.asarray(a) for a in m.get_weights_bias()]
+    i2d = next(i for i, a in enumerate(bad) if a.ndim == 2)
+    bad[i2d] = bad[i2d].T
+    with _pytest.raises(ValueError, match="shape"):
+        mk().build(jax.random.key(2)).set_weights_bias(bad)
