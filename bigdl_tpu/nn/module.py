@@ -172,14 +172,42 @@ class Module:
         self.grads = _tree_add(self.grads, self._scale_param_grads(gp))
 
     def _scale_param_grads(self, gp):
-        if self.scale_w == 1.0 and self.scale_b == 1.0:
+        """Facade-path scaling: same tree the compiled step uses, so the
+        two paths cannot diverge."""
+        st = self._grad_scale_tree()
+        if st is None:
             return gp
-        def scale(path, leaf):
-            key = path[-1].key if hasattr(path[-1], "key") else ""
-            if key == "bias":
-                return leaf * self.scale_b
-            return leaf * self.scale_w
-        return jax.tree_util.tree_map_with_path(scale, gp)
+        return jax.tree.map(lambda g, s: g * s, gp, st)
+
+    def _grad_scale_tree(self, params=None):
+        """Per-leaf gradient scale factors matching the params tree
+        (scaleW/scaleB, AbstractModule.scala:73; the reference applies them
+        inside accGradParameters so layer-wise LR scaling reaches the
+        DISTRIBUTED update too — DistriOptimizer.scala:729
+        isLayerwiseScaled).  Container-level scales reach leaves because
+        Container.set_scale_w/b PROPAGATE to children (the reference's
+        Container.setScaleW semantics) — set scales through the setters,
+        not by attribute assignment.  Returns None when every module's
+        scales are 1 so the compiled step skips the multiply entirely."""
+        if params is None:
+            if self.params is None:
+                self.build()
+            params = self.params
+        if all(m.scale_w == 1.0 and m.scale_b == 1.0
+               for m in self.unique_modules()):
+            return None
+
+        def walk(mod, p):
+            if hasattr(mod, "modules") and isinstance(p, list):
+                return [walk(c, cp) for c, cp in zip(mod.modules, p)]
+
+            def f(path, leaf):
+                key = path[-1].key if hasattr(path[-1], "key") else ""
+                return float(mod.scale_b if key == "bias" else mod.scale_w)
+
+            return jax.tree_util.tree_map_with_path(f, p)
+
+        return walk(self, params)
 
     # -- parameter access ----------------------------------------------
 
@@ -564,6 +592,22 @@ class Container(Module):
     def add(self, module: Module):
         """BigDL: Container.add (nn/Container.scala:54)."""
         self.modules.append(module)
+        return self
+
+    def set_scale_w(self, s: float):
+        """Propagates to children (reference Container.setScaleW) so the
+        per-leaf grad-scale tree — used by BOTH the facade backward and the
+        compiled train step — sees container-level scales."""
+        self.scale_w = s
+        for m in self.modules:
+            m.set_scale_w(s)
+        return self
+
+    def set_scale_b(self, s: float):
+        """Propagates to children (reference Container.setScaleB)."""
+        self.scale_b = s
+        for m in self.modules:
+            m.set_scale_b(s)
         return self
 
     def __len__(self):

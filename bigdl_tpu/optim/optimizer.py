@@ -418,6 +418,7 @@ class Optimizer:
         model, criterion, optim = self.model, self.criterion, self.optim_method
         wire = get_policy().wire_dtype
         clip_norm, clip_const = self.grad_clip_norm, self.grad_clip_const
+        grad_scales = model._grad_scale_tree()  # layer-wise scaleW/scaleB
         from .regularizer import apply_regularizer_grads
 
         remat = self.remat_policy
@@ -464,6 +465,11 @@ class Optimizer:
 
                 (loss, new_net_state), grads = jax.value_and_grad(
                     apply_remat(loss_fn), has_aux=True)(params)
+            if grad_scales is not None:
+                # layer-wise LR scaling (scaleW/scaleB): the reference
+                # applies it in accGradParameters, i.e. BEFORE wire
+                # compression/aggregation — static factors, compiled in
+                grads = jax.tree.map(lambda g, s: g * s, grads, grad_scales)
             # bf16 wire: cross-chip gradient reduction happens on these values —
             # casting here makes the GSPMD all-reduce ride ICI at bf16, the
             # reference's FP16CompressedTensor format
