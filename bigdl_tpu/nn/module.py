@@ -45,6 +45,16 @@ __all__ = ["Module", "Container", "Criterion"]
 _uid_counter = itertools.count()
 
 
+#: bumped by every set_scale_w/set_scale_b anywhere — lets cached
+#: grad-scale trees (facade) and compiled steps (Optimizer) detect scale
+#: changes without parent/child cache-invalidation plumbing
+_SCALE_EPOCH = [0]
+
+
+def scale_epoch() -> int:
+    return _SCALE_EPOCH[0]
+
+
 def _tree_add(a, b):
     return jax.tree.map(jnp.add, a, b)
 
@@ -193,10 +203,21 @@ class Module:
             if self.params is None:
                 self.build()
             params = self.params
-        if all(m.scale_w == 1.0 and m.scale_b == 1.0
-               for m in self.unique_modules()):
-            return None
+            # static between set_scale calls — cache per scale epoch so the
+            # facade backward's common all-ones case costs one int compare
+            cached = getattr(self, "_scale_tree_cache", None)
+            if cached is not None and cached[0] == _SCALE_EPOCH[0]:
+                return cached[1]
+        tree = None
+        if not all(m.scale_w == 1.0 and m.scale_b == 1.0
+                   for m in self.unique_modules()):
+            tree = self._walk_scales(self, params)
+        if params is self.params:
+            self._scale_tree_cache = (_SCALE_EPOCH[0], tree)
+        return tree
 
+    @staticmethod
+    def _walk_scales(root, params):
         def walk(mod, p):
             if hasattr(mod, "modules") and isinstance(p, list):
                 return [walk(c, cp) for c, cp in zip(mod.modules, p)]
@@ -207,7 +228,7 @@ class Module:
 
             return jax.tree_util.tree_map_with_path(f, p)
 
-        return walk(self, params)
+        return walk(root, params)
 
     # -- parameter access ----------------------------------------------
 
@@ -558,11 +579,22 @@ class Module:
         return self.name
 
     def set_scale_w(self, s: float):
+        """Layer-wise weight-gradient scale (AbstractModule.scala:73).
+        Propagates to children when this module has any (`self.modules`):
+        the reference's Container.setScaleW semantics, and Graph/MapTable
+        get the same behavior for free."""
         self.scale_w = s
+        for m in getattr(self, "modules", ()):
+            m.set_scale_w(s)
+        _SCALE_EPOCH[0] += 1
         return self
 
     def set_scale_b(self, s: float):
+        """(AbstractModule.setScaleB; propagation as set_scale_w)."""
         self.scale_b = s
+        for m in getattr(self, "modules", ()):
+            m.set_scale_b(s)
+        _SCALE_EPOCH[0] += 1
         return self
 
     def clone_module(self) -> "Module":
@@ -592,22 +624,6 @@ class Container(Module):
     def add(self, module: Module):
         """BigDL: Container.add (nn/Container.scala:54)."""
         self.modules.append(module)
-        return self
-
-    def set_scale_w(self, s: float):
-        """Propagates to children (reference Container.setScaleW) so the
-        per-leaf grad-scale tree — used by BOTH the facade backward and the
-        compiled train step — sees container-level scales."""
-        self.scale_w = s
-        for m in self.modules:
-            m.set_scale_w(s)
-        return self
-
-    def set_scale_b(self, s: float):
-        """Propagates to children (reference Container.setScaleB)."""
-        self.scale_b = s
-        for m in self.modules:
-            m.set_scale_b(s)
         return self
 
     def __len__(self):

@@ -465,10 +465,14 @@ class Optimizer:
 
                 (loss, new_net_state), grads = jax.value_and_grad(
                     apply_remat(loss_fn), has_aux=True)(params)
+            grads = apply_regularizer_grads(model, params, grads)
             if grad_scales is not None:
                 # layer-wise LR scaling (scaleW/scaleB): the reference
-                # applies it in accGradParameters, i.e. BEFORE wire
-                # compression/aggregation — static factors, compiled in
+                # applies it in accGradParameters to BOTH the data gradient
+                # and the regularizer contribution (accRegularization takes
+                # scaleW), before wire compression/aggregation — static
+                # factors, compiled in.  scaleW=0 therefore freezes a layer
+                # completely, weight decay included.
                 grads = jax.tree.map(lambda g, s: g * s, grads, grad_scales)
             # bf16 wire: cross-chip gradient reduction happens on these values —
             # casting here makes the GSPMD all-reduce ride ICI at bf16, the
@@ -476,7 +480,6 @@ class Optimizer:
             if wire is not None:
                 grads = jax.tree.map(
                     lambda g: g.astype(wire).astype(jnp.float32), grads)
-            grads = apply_regularizer_grads(model, params, grads)
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree.map(lambda g: jnp.clip(g, lo, hi), grads)
@@ -726,8 +729,16 @@ class Optimizer:
             self._initial_blob = (jax.tree.map(np.asarray, model.params),
                                   jax.tree.map(np.asarray, model.state))
 
+        from ..nn.module import scale_epoch
+        if self._compiled is not None and \
+                getattr(self, "_compiled_scale_epoch", None) != scale_epoch():
+            # scaleW/scaleB changed since the step was compiled (they are
+            # baked in as static factors) — recompile, don't silently keep
+            # the old scaling
+            self._compiled = None
         if self._compiled is None:
             self._compiled = self._build_step(mesh)
+            self._compiled_scale_epoch = scale_epoch()
         step_fn, param_sh, data_sh = self._compiled
 
         params = jax.device_put(model.params, param_sh)

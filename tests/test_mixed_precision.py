@@ -1,0 +1,107 @@
+"""bf16 mixed-precision coverage (DTypePolicy compute_dtype=bfloat16).
+
+The MFU-target bench config trains ResNet-50 under this policy
+(bench.py::_cfg_resnet50_bf16) but no test exercised it — a dtype bug in
+any layer's compute path would only surface on the real chip.  Contract
+under test: params stay f32, forward/backward run, values agree with the
+f32 path within bf16 tolerance, and end-to-end training converges.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.common import DTypePolicy, get_policy, set_policy
+
+
+@pytest.fixture(autouse=True)
+def _restore_policy():
+    old = get_policy()
+    yield
+    set_policy(old)
+
+
+def _models():
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    return [
+        ("lenet", lambda: LeNet5(10), (4, 28, 28, 1), "img"),
+        ("resnet20", lambda: ResNet(20, class_num=10, dataset="cifar10"),
+         (2, 32, 32, 3), "img"),
+        ("lstm", lambda: nn.Sequential(
+            nn.Recurrent(nn.LSTM(8, 12)), nn.Select(1, -1),
+            nn.Linear(12, 5), nn.LogSoftMax()), (4, 6, 8), "img"),
+        ("transformer", lambda: TransformerLM(
+            vocab_size=50, max_len=8, d_model=16, num_heads=2,
+            num_layers=1), (2, 8), "tok"),
+    ]
+
+
+@pytest.mark.parametrize("name,build,shape,kind",
+                         _models(), ids=[m[0] for m in _models()])
+def test_bf16_forward_backward_matches_f32(name, build, shape, kind):
+    r = np.random.default_rng(3)
+    if kind == "tok":
+        x = jnp.asarray(r.integers(0, 50, size=shape), jnp.int32)
+    else:
+        x = jnp.asarray(r.normal(size=shape), jnp.float32)
+
+    def run():
+        m = build()
+        m.build(jax.random.key(0))
+        # params must be created in param_dtype regardless of compute dtype
+        for leaf in jax.tree.leaves(m.params):
+            assert leaf.dtype == jnp.float32, (name, leaf.dtype)
+
+        def loss(p, xx):
+            out, _ = m.apply(p, m.state, xx, training=True,
+                             rng=jax.random.key(1))
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+
+        val, g = jax.value_and_grad(loss)(m.params, x)
+        gl = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(leaf)).all() for leaf in gl), name
+        return float(val), gl
+
+    set_policy(DTypePolicy())              # f32 reference
+    v32, g32 = run()
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    v16, g16 = run()
+
+    # bf16 has ~3 decimal digits; activations/grads agree loosely.
+    # Compare the CONCATENATED gradient vector — per-leaf relative error is
+    # meaningless for near-zero leaves (e.g. BN betas), where bf16 noise
+    # relative to the activation scale dwarfs the f32 value
+    assert v16 == pytest.approx(v32, rel=0.05), (name, v32, v16)
+    va = np.concatenate([np.asarray(a).ravel() for a in g32])
+    vb = np.concatenate([np.asarray(b).ravel() for b in g16])
+    rel_l2 = np.linalg.norm(va - vb) / (np.linalg.norm(va) + 1e-12)
+    assert rel_l2 < 0.15, (name, rel_l2)
+
+
+def test_bf16_training_converges():
+    """End-to-end: the bench's mixed-precision configuration (f32 params,
+    bf16 compute, bf16 wire) trains to high accuracy."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_e2e_lenet import synthetic_mnist
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Adam, Evaluator, Optimizer, Top1Accuracy, \
+        Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    Engine.reset()
+    Engine.init()
+    samples = synthetic_mnist(512)
+    opt = Optimizer(LeNet5(10), samples, nn.ClassNLLCriterion(),
+                    batch_size=128)
+    opt.set_optim_method(Adam(1e-3))
+    opt.set_end_when(Trigger.max_epoch(4))
+    trained = opt.optimize()
+    acc, n = Evaluator(trained).test(
+        samples[:256], [Top1Accuracy()])[0][1].result()
+    assert n == 256 and acc > 0.95, acc

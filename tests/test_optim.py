@@ -467,3 +467,61 @@ def test_container_level_scale_propagates():
     st = m._grad_scale_tree()
     leaves = jax.tree.leaves(st)
     assert sorted(set(leaves)) == [2.0, 3.0]
+
+
+def test_scale_change_after_first_optimize_recompiles():
+    """scaleW is baked into the compiled step as a static factor, so
+    changing it between optimize() calls must recompile — the freeze idiom
+    (set_scale_w(0) after a warmup phase) has to actually freeze."""
+    import bigdl_tpu.nn as nn
+    import numpy as np
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax()).build(
+        jax.random.key(0))
+    r = np.random.default_rng(0)
+    samples = [Sample(r.normal(size=(4,)).astype(np.float32),
+                      np.int32(r.integers(0, 3))) for _ in range(8)]
+    opt = Optimizer(model, samples, nn.ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(Trigger.max_epoch(1))
+    opt.optimize()                      # phase 1: trains normally
+    w1 = np.asarray(model.params[0]["weight"]).copy()
+
+    model.set_scale_w(0.0).set_scale_b(0.0)   # freeze everything
+    opt.set_end_when(Trigger.max_epoch(2))
+    opt.optimize()                      # phase 2: must be a no-op
+    w2 = np.asarray(model.params[0]["weight"])
+    np.testing.assert_array_equal(w1, w2)
+
+
+def test_graph_scale_propagates_and_regularizer_is_scaled():
+    """set_scale_w on a Graph reaches its nodes (reference: setScaleW on
+    any module scales its parameters), and scaleW=0 freezes the
+    regularizer contribution too (accRegularization takes scaleW)."""
+    import bigdl_tpu.nn as nn
+    import numpy as np
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+
+    inp = nn.Input()
+    lin = nn.Linear(4, 3, w_regularizer=L2Regularizer(10.0))
+    out = nn.LogSoftMax()(lin(inp))
+    g = nn.Graph(inp, out).build(jax.random.key(0))
+    g.set_scale_w(0.0).set_scale_b(0.0)
+    st = g._grad_scale_tree()
+    assert st is not None and set(jax.tree.leaves(st)) == {0.0}
+
+    r = np.random.default_rng(0)
+    samples = [Sample(r.normal(size=(4,)).astype(np.float32),
+                      np.int32(r.integers(0, 3))) for _ in range(8)]
+    opt = Optimizer(g, samples, nn.ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(Trigger.max_epoch(2))
+    before = [np.asarray(x).copy() for x in jax.tree.leaves(g.params)]
+    opt.optimize()
+    after = [np.asarray(x) for x in jax.tree.leaves(g.params)]
+    for a, b in zip(before, after):   # fully frozen incl. weight decay
+        np.testing.assert_array_equal(a, b)
