@@ -368,3 +368,98 @@ def test_gru_sequence_grads_match_torch_autograd():
                          (gp[0]["cand_kernel"], ck_t), (gp[0]["cand_bias"], cb_t)):
         np.testing.assert_allclose(np.asarray(ours), theirs.grad.numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------- transformer family
+# the long-context flagship's building blocks vs the torch oracle
+
+
+def test_layernorm_grads_match_torch():
+    m = nn.LayerNorm(6).build(rng())
+    x = _np((4, 5, 6), 40)
+    cot = _np((4, 5, 6), 41)
+    gp, gx = _our_grads(m, x, jnp.asarray(cot), training=False)
+
+    ln = torch.nn.LayerNorm(6, eps=1e-5)
+    with torch.no_grad():
+        ln.weight.copy_(_t(np.asarray(m.params["weight"])))
+        ln.bias.copy_(_t(np.asarray(m.params["bias"])))
+    xt = _t(x, requires_grad=True)
+    (ln(xt) * _t(cot)).sum().backward()
+
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gp["weight"], ln.weight.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gp["bias"], ln.bias.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gelu_matches_torch_tanh_approximation():
+    """jax.nn.gelu defaults to the tanh approximation — torch's
+    GELU(approximate='tanh'), not the exact erf form."""
+    m = nn.GELU().build(rng())
+    x = _np((7, 9), 42, scale=2.0)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    ref = torch.nn.GELU(approximate="tanh")(_t(x)).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def loss(z):
+        out, _ = m.apply(m.params, m.state, z, training=False, rng=None)
+        return jnp.sum(out ** 2)
+
+    gx = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+    xt = _t(x, requires_grad=True)
+    (torch.nn.GELU(approximate="tanh")(xt) ** 2).sum().backward()
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multihead_attention_matches_torch(causal):
+    """Ours: y = proj(x) with (in, out) weights; torch packs QKV row-major
+    (3E, E) applied as x @ W^T — map W_q = wq.T etc.  Forward AND input
+    grads must agree (softmax/scale/mask conventions)."""
+    E, H, B, T = 8, 2, 2, 5
+    m = nn.MultiHeadAttention(E, H, causal=causal).build(rng())
+    x = _np((B, T, E), 43)
+    cot = _np((B, T, E), 44)
+
+    gp, gx = _our_grads(m, x, jnp.asarray(cot), training=False)
+    y = np.asarray(m.apply(m.params, m.state, jnp.asarray(x),
+                           training=False, rng=None)[0])
+
+    mha = torch.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+    p = {k: np.asarray(v) for k, v in m.params.items()}
+    with torch.no_grad():
+        mha.in_proj_weight.copy_(_t(np.concatenate(
+            [p["wq"].T, p["wk"].T, p["wv"].T], axis=0)))
+        mha.in_proj_bias.copy_(_t(np.concatenate(
+            [p["bq"], p["bk"], p["bv"]])))
+        mha.out_proj.weight.copy_(_t(p["wo"].T))
+        mha.out_proj.bias.copy_(_t(p["bo"]))
+    xt = _t(x, requires_grad=True)
+    mask = (torch.triu(torch.ones(T, T), diagonal=1).bool()
+            if causal else None)
+    ref, _ = mha(xt, xt, xt, attn_mask=mask, need_weights=False)
+    np.testing.assert_allclose(y, ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    (ref * _t(cot)).sum().backward()
+    np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp["wo"]).T,
+                               mha.out_proj.weight.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp["bo"]),
+                               mha.out_proj.bias.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # QKV parameter grads: torch packs them (3E, E) row-major as x @ W^T
+    ipw = mha.in_proj_weight.grad.numpy()
+    ipb = mha.in_proj_bias.grad.numpy()
+    E = 8
+    for i, (wk_, bk_) in enumerate((("wq", "bq"), ("wk", "bk"),
+                                    ("wv", "bv"))):
+        np.testing.assert_allclose(np.asarray(gp[wk_]).T,
+                                   ipw[i * E:(i + 1) * E],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gp[bk_]),
+                                   ipb[i * E:(i + 1) * E],
+                                   rtol=1e-4, atol=1e-4)
