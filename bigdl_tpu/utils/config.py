@@ -17,6 +17,7 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_DISABLE_LOGGER_FILTER | bigdl.utils.LoggerFilter.disable | 0 |
 | BIGDL_TPU_CHECK_SINGLETON | bigdl.check.singleton            | 0       |
 | BIGDL_TPU_PREEMPTION_CHECKPOINT | (net-new: SIGTERM -> final snapshot) | 1 |
+| BIGDL_TPU_DEVICE_TIMEOUT  | (net-new: Engine.init device-discovery watchdog, seconds) | 0 (off) |
 | BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS | (net-new: ConvLSTM hoist cap) | 2^28 |
 | BIGDL_TPU_XLA_CACHE / _DIR | (net-new: persistent compile cache) | 1 / ~/.cache/bigdl_tpu/xla |
 """

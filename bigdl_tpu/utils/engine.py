@@ -115,7 +115,8 @@ class Engine:
             distributed = bool(config.get_str("COORDINATOR", ""))
         if distributed:
             cls.init_distributed()
-        devs = list(devices) if devices is not None else list(jax.devices())
+        devs = (list(devices) if devices is not None
+                else cls._discover_devices())
         if mesh_shape is None:
             mesh_shape = {cls.DATA_AXIS: len(devs)}
         sizes = list(mesh_shape.values())
@@ -134,6 +135,43 @@ class Engine:
                     dict(zip(cls._mesh.axis_names, cls._mesh.devices.shape)),
                     len(devs), devs[0].platform)
         return cls._mesh
+
+    @classmethod
+    def _discover_devices(cls):
+        """jax.devices() with an OPT-IN watchdog: on a tunneled/remote TPU
+        backend, backend init blocks forever when the accelerator service
+        is unreachable (observed on this image's axon tunnel).  Set
+        BIGDL_TPU_DEVICE_TIMEOUT=<seconds> to turn the silent hang into an
+        actionable error.  Off by default: multi-host runs legitimately
+        block in init until every process joins, and a default timeout
+        would break that wait."""
+        from . import config
+        timeout = config.get_float("DEVICE_TIMEOUT", 0.0)
+        if timeout <= 0:
+            return list(jax.devices())
+        import threading
+        box = {}
+
+        def probe():
+            try:
+                box["devices"] = list(jax.devices())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                box["error"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout)
+        if "devices" in box:
+            return box["devices"]
+        if "error" in box:
+            raise box["error"]
+        raise TimeoutError(
+            f"jax.devices() did not return within {timeout:.0f}s "
+            "(BIGDL_TPU_DEVICE_TIMEOUT) — the accelerator backend is "
+            "unreachable (tunneled TPU service down?). Restart the "
+            "process with JAX_PLATFORMS=cpu (the backend is already "
+            "mid-init here, so an in-process jax.config update cannot "
+            "take effect) or restore the accelerator service.")
 
     @classmethod
     def mesh(cls) -> Mesh:

@@ -1,0 +1,60 @@
+"""Engine.init device-discovery watchdog (BIGDL_TPU_DEVICE_TIMEOUT).
+
+On a tunneled/remote TPU backend, jax.devices() blocks forever when the
+accelerator service is unreachable (verified live against this image's
+dead axon tunnel, 2026-07-31); the opt-in watchdog turns the silent hang
+into an actionable TimeoutError.  Engine state is reset around every test
+by conftest's autouse fixture.
+"""
+
+import time
+
+import pytest
+
+from bigdl_tpu.utils import engine as engine_mod
+from bigdl_tpu.utils.engine import Engine
+
+
+def test_transparent_on_healthy_backend(monkeypatch):
+    import jax
+    monkeypatch.setenv("BIGDL_TPU_DEVICE_TIMEOUT", "60")
+    mesh = Engine.init()
+    assert mesh.devices.size == jax.device_count()
+
+
+def test_timeout_fires_on_hanging_backend(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_DEVICE_TIMEOUT", "0.2")
+
+    class _HangingJax:
+        @staticmethod
+        def devices():
+            time.sleep(30)
+            return []
+
+    monkeypatch.setattr(engine_mod, "jax", _HangingJax)
+    t0 = time.time()
+    with pytest.raises(TimeoutError, match="BIGDL_TPU_DEVICE_TIMEOUT"):
+        Engine._discover_devices()
+    assert time.time() - t0 < 5
+
+
+def test_probe_exception_propagates(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_DEVICE_TIMEOUT", "5")
+
+    class _FailingJax:
+        @staticmethod
+        def devices():
+            raise RuntimeError("backend exploded")
+
+    monkeypatch.setattr(engine_mod, "jax", _FailingJax)
+    with pytest.raises(RuntimeError, match="backend exploded"):
+        Engine._discover_devices()
+
+
+def test_disabled_by_default(monkeypatch):
+    """timeout <= 0 (the default) must not spawn a watchdog thread at all:
+    multi-host init legitimately blocks until every process joins."""
+    import jax
+    monkeypatch.delenv("BIGDL_TPU_DEVICE_TIMEOUT", raising=False)
+    devs = Engine._discover_devices()
+    assert len(devs) == jax.device_count()
