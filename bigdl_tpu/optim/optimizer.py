@@ -1215,14 +1215,32 @@ class Evaluator:
             dataset = dataset.transform(
                 SampleToMiniBatch(batch_size, pad_last=True))
         totals = [None] * len(methods)
-        for batch in dataset.data(train=False):
-            out, n = self._engine(batch.get_input())
+
+        def consume(out, n, batch):
             valid = min(batch.valid, n)
-            out_np = _trim(out, valid)
+            out_np = _trim(out, valid)          # host fetch (sync point)
             tgt_np = _trim(batch.get_target(), valid)
             for i, m in enumerate(methods):
                 r = m(out_np, tgt_np)
                 totals[i] = r if totals[i] is None else totals[i] + r
+
+        # 1-deep pipeline: dispatch batch i+1 (async) BEFORE fetching batch
+        # i's bytes, so device compute overlaps the host metric work — the
+        # device-side analog of the reference's executor fan-out.  Inert in
+        # multi-host runs (_local_rows inside the engine already fetched to
+        # host), so skip the extra liveness there
+        pipeline = jax.process_count() == 1
+        pending = None
+        for batch in dataset.data(train=False):
+            out, n = self._engine(batch.get_input())
+            if not pipeline:
+                consume(out, n, batch)
+                continue
+            if pending is not None:
+                consume(*pending)
+            pending = (out, n, batch)
+        if pending is not None:
+            consume(*pending)
         return list(zip(methods, totals))
 
 
@@ -1246,9 +1264,20 @@ class Predictor:
             dataset = dataset.transform(
                 SampleToMiniBatch(self.batch_size, pad_last=True))
             outs = []
+            pipeline = jax.process_count() == 1
+            pending = None  # 1-deep pipeline (see Evaluator.test)
             for batch in dataset.data(train=False):
                 out, n = self._engine(batch.get_input())
-                outs.append(np.asarray(out)[:min(batch.valid, n)])
+                if not pipeline:
+                    outs.append(np.asarray(out)[:min(batch.valid, n)])
+                    continue
+                if pending is not None:
+                    pout, pn, pvalid = pending
+                    outs.append(np.asarray(pout)[:min(pvalid, pn)])
+                pending = (out, n, batch.valid)
+            if pending is not None:
+                pout, pn, pvalid = pending
+                outs.append(np.asarray(pout)[:min(pvalid, pn)])
             return np.concatenate(outs, axis=0)
         return np.asarray(self._forward(dataset))
 

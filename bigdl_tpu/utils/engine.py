@@ -145,8 +145,19 @@ class Engine:
         actionable error.  Off by default: multi-host runs legitimately
         block in init until every process joins, and a default timeout
         would break that wait."""
-        from . import config
-        timeout = config.get_float("DEVICE_TIMEOUT", 0.0)
+        import os
+        raw = os.environ.get("BIGDL_TPU_DEVICE_TIMEOUT")
+        if raw is None or not raw.strip():
+            return list(jax.devices())
+        try:
+            timeout = float(raw)
+        except ValueError:
+            # this knob exists to prevent a silent hang — silently
+            # disabling it on a typo ('60s', '1m') would reproduce exactly
+            # the failure it guards against
+            raise ValueError(
+                f"BIGDL_TPU_DEVICE_TIMEOUT={raw!r} is not a number of "
+                "seconds (e.g. '60')") from None
         if timeout <= 0:
             return list(jax.devices())
         import threading

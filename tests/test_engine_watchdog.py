@@ -58,3 +58,27 @@ def test_disabled_by_default(monkeypatch):
     monkeypatch.delenv("BIGDL_TPU_DEVICE_TIMEOUT", raising=False)
     devs = Engine._discover_devices()
     assert len(devs) == jax.device_count()
+
+
+def test_invalid_timeout_value_raises(monkeypatch):
+    """A typo'd value ('60s') must raise, not silently disable the guard —
+    silent disablement reproduces exactly the hang the knob prevents."""
+    monkeypatch.setenv("BIGDL_TPU_DEVICE_TIMEOUT", "60s")
+    with pytest.raises(ValueError, match="not a number of seconds"):
+        Engine._discover_devices()
+
+
+def test_disabled_default_spawns_no_thread(monkeypatch):
+    """timeout unset must take the direct path (multi-host init blocks in
+    jax.devices() legitimately until all processes join — a probe thread
+    there would be wrong), pinned by making Thread creation explode."""
+    import threading
+    import jax
+
+    def boom(*a, **k):
+        raise AssertionError("watchdog thread spawned with timeout unset")
+
+    monkeypatch.delenv("BIGDL_TPU_DEVICE_TIMEOUT", raising=False)
+    monkeypatch.setattr(threading, "Thread", boom)
+    devs = Engine._discover_devices()
+    assert len(devs) == jax.device_count()
