@@ -381,7 +381,7 @@ class TestStreamingRecordDataSet:
         from bigdl_tpu.optim import Adam, Optimizer, Trigger
         from bigdl_tpu.utils.engine import Engine
         from bigdl_tpu.utils.recordio import write_records
-        from tests.test_e2e_lenet import synthetic_mnist
+        from test_e2e_lenet import synthetic_mnist
 
         Engine.reset()
         Engine.init()

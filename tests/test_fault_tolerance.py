@@ -211,7 +211,7 @@ def test_async_checkpoint_roundtrip(tmp_path):
 
     from bigdl_tpu.utils import file_io
     from bigdl_tpu.utils.engine import Engine
-    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from test_e2e_lenet import make_optimizer, synthetic_mnist
 
     Engine.reset()
     Engine.init()
@@ -251,7 +251,7 @@ def test_checkpoint_restores_rng_stream(tmp_path):
     from bigdl_tpu.common import get_default_rng, next_rng_key, set_seed
     from bigdl_tpu.utils import file_io
     from bigdl_tpu.utils.engine import Engine
-    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from test_e2e_lenet import make_optimizer, synthetic_mnist
 
     Engine.reset()
     Engine.init()

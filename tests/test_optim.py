@@ -297,7 +297,7 @@ def test_ema_through_optimizer_training():
     model via EMA.apply_to."""
     from bigdl_tpu.optim import Adam, EMA, Evaluator, Top1Accuracy
     from bigdl_tpu.utils.engine import Engine
-    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from test_e2e_lenet import make_optimizer, synthetic_mnist
     from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.models import LeNet5
 
@@ -331,7 +331,7 @@ def test_ema_apply_to_transfers_bn_state():
     from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
     from bigdl_tpu.optim import Adam, EMA, Optimizer, Trigger
     from bigdl_tpu.utils.engine import Engine
-    from tests.test_e2e_lenet import synthetic_mnist
+    from test_e2e_lenet import synthetic_mnist
 
     Engine.reset()
     Engine.init()

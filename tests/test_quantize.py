@@ -54,7 +54,7 @@ def test_trained_lenet_accuracy_preserved():
     """Train LeNet on the separable synthetic task, quantize, and the
     held-out accuracy must survive int8 weights."""
     from bigdl_tpu.optim import Evaluator, Top1Accuracy
-    from tests.test_e2e_lenet import make_optimizer, synthetic_mnist
+    from test_e2e_lenet import make_optimizer, synthetic_mnist
     from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.utils.engine import Engine
 
