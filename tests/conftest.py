@@ -24,6 +24,16 @@ if not force_cpu(8):
         f"jax backend initialized before conftest: "
         f"{jax.default_backend()} x {jax.device_count()}")
 
+import os as _os
+import sys as _sys
+
+# repo root on sys.path ONCE for every test module: examples/ (and any
+# sibling repo content) stays importable when the suite runs against a
+# pip-installed bigdl_tpu from outside the repo
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 import pytest  # noqa: E402
 
 

@@ -1,18 +1,8 @@
 """Smoke tests for the runnable examples (reference: pyspark's
 simple_integration_test drives the shipped examples the same way)."""
 
-import os
-import sys
-
 import numpy as np
 import pytest
-
-# examples/ is repo content next to tests/, NOT part of the installed wheel
-# — resolve it explicitly so the suite also passes against a pip-installed
-# bigdl_tpu run from outside the repo
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO not in sys.path:
-    sys.path.insert(0, _REPO)
 
 from bigdl_tpu.utils.engine import Engine
 

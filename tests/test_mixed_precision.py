@@ -85,8 +85,6 @@ def test_bf16_forward_backward_matches_f32(name, build, shape, kind):
 def test_bf16_training_converges():
     """End-to-end: the bench's mixed-precision configuration (f32 params,
     bf16 compute, bf16 wire) trains to high accuracy."""
-    import sys, os
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from test_e2e_lenet import synthetic_mnist
     from bigdl_tpu.models.lenet import LeNet5
     from bigdl_tpu.optim import Adam, Evaluator, Optimizer, Top1Accuracy, \
