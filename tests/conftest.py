@@ -24,15 +24,14 @@ if not force_cpu(8):
         f"jax backend initialized before conftest: "
         f"{jax.default_backend()} x {jax.device_count()}")
 
-import os as _os
-import sys as _sys
+import sys
 
 # repo root on sys.path ONCE for every test module: examples/ (and any
 # sibling repo content) stays importable when the suite runs against a
 # pip-installed bigdl_tpu from outside the repo
-_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-if _REPO_ROOT not in _sys.path:
-    _sys.path.insert(0, _REPO_ROOT)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import pytest  # noqa: E402
 
@@ -63,8 +62,7 @@ def spawn_multihost_workers(worker_src: str, tmp_path, n: int = 2,
     port = s.getsockname()[1]
     s.close()
     env_base = {**os.environ,
-                "PYTHONPATH": os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__))),
+                "PYTHONPATH": _REPO_ROOT,
                 "BIGDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
                 "BIGDL_TPU_NUM_PROCESSES": str(n)}
     procs = [subprocess.Popen(
