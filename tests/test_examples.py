@@ -69,3 +69,12 @@ def test_quantized_serving_example():
     from examples import quantized_serving
     full, beam = quantized_serving.main(["--epochs", "5"])
     assert len(full) == 7 and len(beam) == 7
+
+
+def test_fine_tuning_example(tmp_path):
+    from examples import fine_tuning
+    acc, frozen = fine_tuning.main(
+        ["--pretrain-epochs", "3", "--tune-epochs", "3",
+         "--weights", str(tmp_path / "w.bin")])
+    assert frozen               # scale_w=0 froze the feature extractor
+    assert acc > 0.9            # head alone adapts to the permuted labels
