@@ -23,8 +23,38 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..common import conv_accum_dtype, get_policy
+from ..utils import config as _config
 from .initialization import default_bias_init, default_weight_init
 from .module import Module
+
+
+def _pad_tiny_cin(x, w, n_group):
+    """Zero-pad the input-channel axis of (x, w) up to a minimum width.
+
+    XLA's TPU backend pathologically compiles the *backward* of convs whose
+    input-channel count is far below the sublane granularity — grad(conv) at
+    (512,28,28,1)x(5,5,1,6) has been observed to compile for 8+ minutes
+    (docs/benchmarking.md, "small-channel conv backward").  The reference hits
+    the same small-shape inefficiency in its im2col+gemm lowering and solves it
+    by switching lowerings (nn/SpatialConvolution.scala:470-530); here the fix
+    is shape-level: pad C_in with zero channels up to
+    BIGDL_TPU_CONV_PAD_MIN_CIN (default 8, 0 disables).  Forward values are
+    bit-identical (zero channels contribute nothing to the contraction), the
+    input gradient is the slice-adjoint of the pad, and the padded weight
+    gradients are discarded by the same slice — only the compiled program's
+    shapes change.  Shape-generic (pads w's axis -2 and x's last axis), so it
+    covers WIO/HWIO/DHWIO weights alike; every conv layer in this module calls
+    it, including SpatialFullConvolution whose lhs-dilated *forward* is itself
+    a gradient-conv-shaped program.
+    """
+    min_cin = _config.get_int("CONV_PAD_MIN_CIN", 8)
+    cin = w.shape[-2]
+    if n_group != 1 or min_cin <= 0 or cin >= min_cin:
+        return x, w
+    extra = min_cin - cin
+    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+    w = jnp.pad(w, [(0, 0)] * (w.ndim - 2) + [(0, extra), (0, 0)])
+    return x, w
 
 __all__ = ["SpatialConvolution", "SpatialDilatedConvolution",
            "SpatialFullConvolution", "TemporalConvolution",
@@ -83,6 +113,7 @@ class SpatialConvolution(Module):
             # output has the same size as input")
             padding = ("SAME" if pad_h == -1 or pad_w == -1
                        else [(pad_h, pad_h), (pad_w, pad_w)])
+        x, w = _pad_tiny_cin(x, w, self.n_group)
         y = lax.conv_general_dilated(
             x.astype(c), w.astype(c),
             window_strides=self.stride,
@@ -238,6 +269,7 @@ class SpatialFullConvolution(Module):
         w = params["weight"].astype(c)
         # flip spatial dims: transposed conv correlates with the flipped kernel
         w = w[::-1, ::-1, :, :]
+        x, w = _pad_tiny_cin(x, w, self.n_group)
         y = lax.conv_general_dilated(
             x.astype(c), w,
             window_strides=(1, 1),
@@ -281,8 +313,9 @@ class TemporalConvolution(Module):
 
     def _apply(self, params, x):
         c = get_policy().compute_dtype
+        x, w = _pad_tiny_cin(x, params["weight"], 1)
         y = lax.conv_general_dilated(
-            x.astype(c), params["weight"].astype(c),
+            x.astype(c), w.astype(c),
             window_strides=(self.stride_w,),
             padding=[(0, 0)],
             dimension_numbers=("NWC", "WIO", "NWC"),
@@ -320,8 +353,9 @@ class VolumetricConvolution(Module):
     def _apply(self, params, x):
         c = get_policy().compute_dtype
         pt, ph, pw = self.pad
+        x, w = _pad_tiny_cin(x, params["weight"], 1)
         y = lax.conv_general_dilated(
-            x.astype(c), params["weight"].astype(c),
+            x.astype(c), w.astype(c),
             window_strides=self.stride,
             padding=[(pt, pt), (ph, ph), (pw, pw)],
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),

@@ -1,0 +1,120 @@
+"""Tiny-input-channel conv padding (the LeNet compile-pathology fix).
+
+XLA's TPU backend compiles grad-of-conv at C_in=1 pathologically slowly
+(docs/benchmarking.md); `_pad_tiny_cin` pads C_in up to 8 with zero channels.
+These tests pin the numerics: forward values and every gradient must be
+identical with the pad on (default) and off (BIGDL_TPU_CONV_PAD_MIN_CIN=0).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import SpatialConvolution, SpatialDilatedConvolution
+
+
+def _fwd(conv, params, x):
+    return conv.apply(params, {}, x)[0]
+
+
+def _loss_and_grads(monkeypatch, min_cin, seed=0):
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", str(min_cin))
+    conv = SpatialConvolution(1, 6, 5, 5, pad_w=2, pad_h=2)
+    params, _ = conv.init(jax.random.PRNGKey(seed))
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (4, 28, 28, 1))
+
+    def loss(p, xx):
+        return jnp.sum(_fwd(conv, p, xx) ** 2)
+
+    val, grads = jax.value_and_grad(loss)(params, x)
+    gx = jax.grad(loss, argnums=1)(params, x)
+    return val, grads, gx
+
+
+def test_pad_preserves_forward_and_grads(monkeypatch):
+    v1, g1, gx1 = _loss_and_grads(monkeypatch, 8)
+    v0, g0, gx0 = _loss_and_grads(monkeypatch, 0)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_pad_changes_compiled_shapes(monkeypatch):
+    """The whole point: with the pad on, the conv the compiler sees has C_in=8."""
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "8")
+    conv = SpatialConvolution(1, 6, 5, 5)
+    params, _ = conv.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 28, 28, 1))
+    hlo = jax.jit(lambda p, xx: _fwd(conv, p, xx)).lower(params, x).as_text()
+    assert "2x28x28x8" in hlo, hlo[:2000]
+
+
+def test_pad_skips_wide_and_grouped(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "8")
+    # wide input: no pad inserted
+    conv = SpatialConvolution(16, 8, 3, 3)
+    p, _ = conv.init(jax.random.PRNGKey(0))
+    hlo = jax.jit(lambda pp, xx: _fwd(conv, pp, xx)).lower(
+        p, jnp.zeros((2, 8, 8, 16))).as_text()
+    assert "stablehlo.pad" not in hlo
+    # grouped conv: padding C_in would break the group split -> must skip
+    g = SpatialConvolution(4, 8, 3, 3, n_group=4)
+    pg, _ = g.init(jax.random.PRNGKey(0))
+    y = _fwd(g, pg, jnp.ones((2, 8, 8, 4)))
+    assert y.shape == (2, 6, 6, 8)
+
+
+def test_dilated_conv_inherits_pad(monkeypatch):
+    conv = SpatialDilatedConvolution(1, 4, 3, 3, dilation_w=2, dilation_h=2)
+    p, _ = conv.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 12, 12, 1))
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "8")
+    y_on = _fwd(conv, p, x)
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "0")
+    y_off = _fwd(conv, p, x)
+    np.testing.assert_allclose(np.asarray(y_on), np.asarray(y_off), rtol=1e-6)
+
+
+def test_other_conv_families_inherit_pad(monkeypatch):
+    """Temporal (WIO), Volumetric (DHWIO) and Full (lhs-dilated) convs get the
+    same treatment — the Full conv's forward IS a gradient-conv-shaped program."""
+    from bigdl_tpu.nn import (SpatialFullConvolution, TemporalConvolution,
+                              VolumetricConvolution)
+    cases = [
+        (TemporalConvolution(1, 4, 3), jax.random.normal(
+            jax.random.PRNGKey(1), (2, 16, 1))),
+        (VolumetricConvolution(1, 4, 3, 3, 3), jax.random.normal(
+            jax.random.PRNGKey(2), (2, 8, 8, 8, 1))),
+        (SpatialFullConvolution(1, 4, 3, 3, stride_w=2, stride_h=2),
+         jax.random.normal(jax.random.PRNGKey(3), (2, 8, 8, 1))),
+    ]
+    for conv, x in cases:
+        p, _ = conv.init(jax.random.PRNGKey(0))
+        monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "8")
+        y_on = _fwd(conv, p, x)
+        hlo = jax.jit(lambda pp, xx, c=conv: _fwd(c, pp, xx)).lower(
+            p, x).as_text()
+        assert "stablehlo.pad" in hlo, type(conv).__name__
+        monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "0")
+        y_off = _fwd(conv, p, x)
+        np.testing.assert_allclose(np.asarray(y_on), np.asarray(y_off),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=type(conv).__name__)
+
+
+def test_lenet_stack_trains_with_pad(monkeypatch):
+    """End-to-end: the LeNet front conv forwards identically with the pad."""
+    from bigdl_tpu.models.lenet import LeNet5
+    model = LeNet5(class_num=10)
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "8")
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (8, 10) and bool(jnp.isfinite(y).all())
+    monkeypatch.setenv("BIGDL_TPU_CONV_PAD_MIN_CIN", "0")
+    y0, _ = model.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y0), rtol=1e-5,
+                               atol=1e-6)
