@@ -163,7 +163,16 @@ def _step_flops(jitted, compiled, example_args):
 
     analytic = xla = None
     try:
-        analytic = jaxpr_flops(jax.make_jaxpr(jitted)(*example_args))
+        # trace with the tiny-channel conv pad disabled: MFU must count the
+        # NOMINAL model FLOPs, not the zero channels _pad_tiny_cin adds for
+        # compile speed (LeNet's conv FLOPs would otherwise inflate ~3x);
+        # xla cost_analysis below still sees the padded compiled program,
+        # which can legitimately trip the disagreement log for tiny models
+        os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"] = "0"
+        try:
+            analytic = jaxpr_flops(jax.make_jaxpr(jitted)(*example_args))
+        finally:
+            del os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"]
     except Exception as e:  # noqa: BLE001
         _log(f"analytic flops failed: {type(e).__name__}: {e}")
     try:

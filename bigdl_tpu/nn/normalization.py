@@ -97,10 +97,21 @@ class BatchNormalization(Module):
     Reference: nn/BatchNormalization.scala (eps/momentum/affine semantics,
     runningMean/runningVar EMA: new = (1-momentum)*old + momentum*batch).
 
-    Set env BIGDL_TPU_BN_FUSED_VJP=1 (config tier, SURVEY §5.6) to route
-    training-mode normalization through `_fused_bn_train`'s hand-written
-    backward instead of autodiff; numerics are identical (tests assert grad
-    parity), only the compiled pass structure differs.
+    Training-mode stat machinery is the measured MFU bottleneck on TPU
+    (docs/benchmarking.md), so the implementation is selectable via the
+    config tier (SURVEY §5.6) for `bigdl_tpu.tools.bn_experiment` to race:
+
+    - BIGDL_TPU_BN_FUSED_VJP=1 — `_fused_bn_train`'s hand-written backward
+      instead of autodiff; identical numerics, different pass structure.
+    - BIGDL_TPU_BN_IMPL=pallas — the fully fused Pallas kernel
+      (`ops/batchnorm.bn_train`: 2 reads + 1 write per direction, stats
+      resident in VMEM); `pallas_interpret` runs the same kernel in
+      interpret mode (CPU tests).
+    - BIGDL_TPU_BN_STAT_ROWS=k — ghost-batch statistics: mean/var from the
+      first k rows of the batch only (shuffled batches make this a random
+      subsample), cutting the stat pass's HBM reads by N/k.  Normalization
+      and gradients still cover every row; stats are a biased-to-the-subset
+      estimate, the same trade ghost batch norm makes deliberately.
     """
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
@@ -129,31 +140,34 @@ class BatchNormalization(Module):
     def apply(self, params, state, x, *, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if training:
-            xf = x.astype(jnp.float32)
+            impl = config.get_str("BN_IMPL", "")
+            # pallas is single-device only: GSPMD cannot partition the opaque
+            # pallas_call, so under a multi-device jit it would all-gather
+            # every BN input — the opposite of the HBM optimization.  Tests
+            # (pallas_interpret) call apply outside jit and keep the route.
+            if (impl.startswith("pallas") and self.affine
+                    and self.sync_axis is None
+                    and (impl == "pallas_interpret"
+                         or jax.device_count() == 1)):
+                return self._apply_pallas(params, state, x, axes,
+                                          impl == "pallas_interpret")
+            stat_rows = config.get_int("BN_STAT_ROWS", 0)
+            xs = x[:stat_rows] if 0 < stat_rows < x.shape[0] else x
+            xf = xs.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
             var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
             if (self.affine and self.sync_axis is None
-                    and config.get_bool("BN_FUSED_VJP")):
+                    and config.get_bool("BN_FUSED_VJP") and xs is x):
                 return self._apply_fused(params, state, x, mean, var, axes)
             if self.sync_axis is not None:
                 mean = lax.pmean(mean, self.sync_axis)
                 var = lax.pmean(var, self.sync_axis)
-            m = self.momentum
-            # Torch-lineage convention (reference BatchNormalization.scala,
-            # torch BN): normalize with the BIASED batch var, but accumulate
-            # the UNBIASED one into the running EMA
             n = 1
             for ax in axes:
-                n *= x.shape[ax]
+                n *= xs.shape[ax]
             if self.sync_axis is not None:
                 n = n * lax.psum(1, self.sync_axis)  # global element count
-                unbiased = var * (n / jnp.maximum(n - 1, 1))
-            else:
-                unbiased = var * (n / max(n - 1, 1))
-            new_state = {
-                "running_mean": (1 - m) * state["running_mean"] + m * mean,
-                "running_var": (1 - m) * state["running_var"] + m * unbiased,
-            }
+            new_state = self._ema_update(state, mean, var, n)
         else:
             mean = state["running_mean"]
             var = state["running_var"]
@@ -168,20 +182,36 @@ class BatchNormalization(Module):
         y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         return y, new_state
 
-    def _apply_fused(self, params, state, x, mean, var, axes):
+    def _ema_update(self, state, mean, var, n):
+        """Torch-lineage convention (reference BatchNormalization.scala,
+        torch BN): normalize with the BIASED batch var, but accumulate the
+        UNBIASED one into the running EMA.  `n` is the element count the
+        stats were computed over (per-shard or global)."""
         m = self.momentum
+        unbiased = var * (n / jnp.maximum(n - 1, 1))
+        dt = state["running_mean"].dtype
+        return {
+            "running_mean": (1 - m) * state["running_mean"]
+            + m * lax.stop_gradient(mean).astype(dt),
+            "running_var": (1 - m) * state["running_var"]
+            + m * lax.stop_gradient(unbiased).astype(dt),
+        }
+
+    def _apply_pallas(self, params, state, x, axes, interpret):
+        from ..ops.batchnorm import bn_train
+        y, mean, var = bn_train(x, params["weight"], params["bias"],
+                                self.eps, 1024, interpret)
         n = 1
         for ax in axes:
             n *= x.shape[ax]
-        unbiased = var * (n / max(n - 1, 1))
-        new_state = {
-            "running_mean": (1 - m) * state["running_mean"]
-            + m * lax.stop_gradient(mean),
-            "running_var": (1 - m) * state["running_var"]
-            + m * lax.stop_gradient(unbiased),
-        }
+        return y, self._ema_update(state, mean, var, n)
+
+    def _apply_fused(self, params, state, x, mean, var, axes):
+        n = 1
+        for ax in axes:
+            n *= x.shape[ax]
         y = _fused_bn_train(self.eps, x, params["weight"], params["bias"])
-        return y, new_state
+        return y, self._ema_update(state, mean, var, n)
 
 
 class SpatialBatchNormalization(BatchNormalization):

@@ -1,0 +1,282 @@
+"""Fused training-mode batch normalization as a Pallas TPU kernel.
+
+Why this exists: the round-3 MFU decomposition (docs/benchmarking.md,
+`bigdl_tpu.tools.bn_experiment`) isolated ResNet-50's train-MFU ceiling to the
+BN batch-statistics machinery — eval-mode-stats grad reaches 0.45 MFU while
+train mode sits at 0.34, i.e. ~27 ms/step of HBM-bound stat traffic.  The
+reference hits the same wall and answers with 747 lines of hand-optimized
+loops (`nn/BatchNormalization.scala`); the TPU answer is a kernel that makes
+the minimum number of HBM passes explicit:
+
+  forward:  phase 0 reads x once accumulating per-channel (sum, sum of
+            squares) in VMEM; phase 1 re-reads x and writes y — 2 reads +
+            1 write of x-sized traffic, stats never round-trip HBM.
+  backward: phase 0 reads (x, dy) accumulating (sum dy, sum dy*xhat);
+            phase 1 re-reads and writes dx — the canonical closed form
+            dx = w*inv * (dy - mean(dy) - xhat * mean(dy*xhat)).
+
+Both directions are one `pallas_call` with a (phase, row-block) grid — the
+second phase revisits the same row blocks, so the pipeline keeps streaming
+and the per-channel vectors stay resident in VMEM scratch between phases.
+
+The channel axis is padded to the 128-lane boundary and row remainders are
+masked inside the kernel, so any (N, ..., C) shape works.  On CPU the same
+kernel runs under `interpret=True` (tests), and `bn_train_reference` is the
+plain-jnp oracle.
+
+Wired into `nn.BatchNormalization` via BIGDL_TPU_BN_IMPL=pallas (see
+normalization.py); benchmarked against the other stat variants by
+`bigdl_tpu.tools.bn_experiment`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["bn_train", "bn_train_reference"]
+
+_LANE = 128
+# Per-buffer byte budget for one (block_r, Cp) tile.  The backward streams
+# three such buffers (x, dy in; dx out), each double-buffered by the Pallas
+# pipeline, so 1 MiB/tile keeps the worst case ~6 MiB of a ~16 MiB VMEM
+# budget with headroom for the f32 per-channel scratch.
+_TILE_BYTES = 1 << 20
+
+
+def _pick_block_r(requested, n_rows, cp, itemsize):
+    """Scale the row-block size to the VMEM tile budget (wide-channel layers
+    would blow VMEM at a fixed 1024: 1024 x 2048 x bf16 = 4 MiB/tile)."""
+    budget = max(8, _TILE_BYTES // max(1, cp * itemsize))
+    block = min(requested, budget, max(8, n_rows))
+    return max(8, (block // 8) * 8)
+
+
+def bn_train_reference(x, weight, bias, eps):
+    """Plain-jnp oracle: returns (y, mean, var) with f32 stats, biased var."""
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    scale = weight.astype(jnp.float32) * inv
+    shift = bias.astype(jnp.float32) - mean * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return y, mean, var
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, var_ref,
+                sum_scr, sumsq_scr, scale_scr, shift_scr, *,
+                eps: float, n_rows: int, block_r: int):
+    import jax.experimental.pallas as pl
+
+    phase = pl.program_id(0)
+    r = pl.program_id(1)
+    nr = pl.num_programs(1)
+
+    @pl.when((phase == 0) & (r == 0))
+    def _init():
+        sum_scr[:] = jnp.zeros_like(sum_scr)
+        sumsq_scr[:] = jnp.zeros_like(sumsq_scr)
+
+    @pl.when(phase == 0)
+    def _accumulate():
+        xb = x_ref[...].astype(jnp.float32)            # [block_r, C]
+        if n_rows % block_r:                           # mask the padded tail
+            row = r * block_r + lax.broadcasted_iota(
+                jnp.int32, xb.shape, 0)
+            xb = jnp.where(row < n_rows, xb, 0.0)
+        sum_scr[:] += jnp.sum(xb, axis=0, keepdims=True)
+        sumsq_scr[:] += jnp.sum(jnp.square(xb), axis=0, keepdims=True)
+
+    @pl.when((phase == 0) & (r == nr - 1))
+    def _finalize_stats():
+        mean = sum_scr[:] / n_rows
+        var = sumsq_scr[:] / n_rows - jnp.square(mean)
+        inv = lax.rsqrt(var + eps)
+        scale = w_ref[...].astype(jnp.float32) * inv
+        scale_scr[:] = scale
+        shift_scr[:] = b_ref[...].astype(jnp.float32) - mean * scale
+        mean_ref[...] = mean
+        var_ref[...] = var
+
+    @pl.when(phase == 1)
+    def _normalize():
+        xb = x_ref[...].astype(jnp.float32)
+        y_ref[...] = (xb * scale_scr[:] + shift_scr[:]).astype(y_ref.dtype)
+
+
+def _pad_cols(a, c_pad):
+    return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, c_pad)]) if c_pad else a
+
+
+def _bn_fwd_pallas(x2, w, b, *, eps, block_r, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = x2.shape
+    c_pad = (-C) % _LANE
+    Cp = C + c_pad
+    x2 = _pad_cols(x2, c_pad)
+    w = _pad_cols(w.astype(jnp.float32), c_pad)
+    b = _pad_cols(b.astype(jnp.float32), c_pad)
+    block_r = _pick_block_r(block_r, R, Cp, x2.dtype.itemsize)
+    r_pad = (-R) % block_r
+    if r_pad:  # padded rows are masked in phase 0, sliced off after phase 1
+        x2 = jnp.pad(x2, ((0, r_pad), (0, 0)))
+    grid = (2, (R + r_pad) // block_r)
+    kernel = functools.partial(_fwd_kernel, eps=eps, n_rows=R,
+                               block_r=block_r)
+    y, mean, var = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_r, Cp), lambda p, r: (r, 0)),
+            pl.BlockSpec((1, Cp), lambda p, r: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda p, r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, Cp), lambda p, r: (r, 0)),
+            pl.BlockSpec((1, Cp), lambda p, r: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda p, r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R + r_pad, Cp), x2.dtype),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, Cp), jnp.float32) for _ in range(4)],
+        interpret=interpret,
+    )(x2, w[None], b[None])
+    return y[:R, :C], mean[0, :C], var[0, :C]
+
+
+# ---------------------------------------------------------------------------
+# backward kernel
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(x_ref, dy_ref, mean_ref, inv_ref, w_ref, dx_ref,
+                sdy_ref, sdyx_ref, sdy_scr, sdyx_scr, *,
+                n_rows: int, block_r: int):
+    import jax.experimental.pallas as pl
+
+    phase = pl.program_id(0)
+    r = pl.program_id(1)
+    nr = pl.num_programs(1)
+
+    @pl.when((phase == 0) & (r == 0))
+    def _init():
+        sdy_scr[:] = jnp.zeros_like(sdy_scr)
+        sdyx_scr[:] = jnp.zeros_like(sdyx_scr)
+
+    @pl.when(phase == 0)
+    def _accumulate():
+        xb = x_ref[...].astype(jnp.float32)
+        dyb = dy_ref[...].astype(jnp.float32)
+        if n_rows % block_r:
+            row = r * block_r + lax.broadcasted_iota(jnp.int32, xb.shape, 0)
+            dyb = jnp.where(row < n_rows, dyb, 0.0)
+        xhat = (xb - mean_ref[...]) * inv_ref[...]
+        sdy_scr[:] += jnp.sum(dyb, axis=0, keepdims=True)
+        sdyx_scr[:] += jnp.sum(dyb * xhat, axis=0, keepdims=True)
+
+    @pl.when((phase == 0) & (r == nr - 1))
+    def _emit_sums():
+        sdy_ref[...] = sdy_scr[:]
+        sdyx_ref[...] = sdyx_scr[:]
+
+    @pl.when(phase == 1)
+    def _dx():
+        xb = x_ref[...].astype(jnp.float32)
+        dyb = dy_ref[...].astype(jnp.float32)
+        xhat = (xb - mean_ref[...]) * inv_ref[...]
+        scale = w_ref[...].astype(jnp.float32) * inv_ref[...]
+        dx = scale * (dyb - sdy_scr[:] / n_rows - xhat * sdyx_scr[:] / n_rows)
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _bn_bwd_pallas(x2, dy2, mean, inv, w, *, block_r, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = x2.shape
+    c_pad = (-C) % _LANE
+    Cp = C + c_pad
+    x2 = _pad_cols(x2, c_pad)
+    dy2 = _pad_cols(dy2, c_pad)
+    mean = _pad_cols(mean, c_pad)
+    # padded channels get inv=0 (zero-padded), so their dx/dw/db are zero
+    # and sliced off below either way
+    inv = _pad_cols(inv, c_pad)
+    w = _pad_cols(w.astype(jnp.float32), c_pad)
+    block_r = _pick_block_r(block_r, R, Cp, x2.dtype.itemsize)
+    r_pad = (-R) % block_r
+    if r_pad:
+        x2 = jnp.pad(x2, ((0, r_pad), (0, 0)))
+        dy2 = jnp.pad(dy2, ((0, r_pad), (0, 0)))
+    grid = (2, (R + r_pad) // block_r)
+    kernel = functools.partial(_bwd_kernel, n_rows=R, block_r=block_r)
+    vec = pl.BlockSpec((1, Cp), lambda p, r: (0, 0))
+    blk = pl.BlockSpec((block_r, Cp), lambda p, r: (r, 0))
+    dx, sdy, sdyx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[blk, blk, vec, vec, vec],
+        out_specs=[blk, vec, vec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R + r_pad, Cp), x2.dtype),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, Cp), jnp.float32) for _ in range(2)],
+        interpret=interpret,
+    )(x2, dy2, mean[None], inv[None], w[None])
+    return dx[:R, :C], sdy[0, :C], sdyx[0, :C]
+
+
+# ---------------------------------------------------------------------------
+# differentiable entry point
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def bn_train(x, weight, bias, eps, block_r=1024, interpret=False):
+    """Training-mode BN: (x[..., C], w[C], b[C]) -> (y, mean, var).
+
+    mean/var are the biased f32 batch statistics (for the caller's running
+    EMA) and are treated as non-differentiable outputs — their cotangents
+    are ignored in the VJP, matching how every call site consumes them
+    (`lax.stop_gradient` before the EMA update).
+    """
+    shape = x.shape
+    y, mean, var = _bn_fwd_pallas(
+        x.reshape(-1, shape[-1]), weight, bias,
+        eps=eps, block_r=block_r, interpret=interpret)
+    return y.reshape(shape), mean, var
+
+
+def _bn_train_fwd(x, weight, bias, eps, block_r, interpret):
+    out = bn_train(x, weight, bias, eps, block_r, interpret)
+    _, mean, var = out
+    inv = lax.rsqrt(var + eps)
+    return out, (x, mean, inv, weight)
+
+
+def _bn_train_bwd(eps, block_r, interpret, res, cotangents):
+    x, mean, inv, weight = res
+    dy, _, _ = cotangents  # stat cotangents ignored (see bn_train docstring)
+    shape = x.shape
+    dx, sdy, sdyx = _bn_bwd_pallas(
+        x.reshape(-1, shape[-1]), dy.reshape(-1, shape[-1]),
+        mean, inv, weight, block_r=block_r, interpret=interpret)
+    return (dx.reshape(shape), sdyx.astype(weight.dtype),
+            sdy.astype(weight.dtype))
+
+
+bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)

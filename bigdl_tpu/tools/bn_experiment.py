@@ -22,6 +22,11 @@ Variants:
                (BN normalize, ReLU, adds) in the backward pass — trades
                cheap recompute FLOPs for HBM writes of BN/ReLU activations
   vjp_remat  — custom_vjp and remat_conv combined
+  pallas     — the fully fused Pallas kernel (ops/batchnorm.bn_train):
+               2 reads + 1 write per direction, stats resident in VMEM
+  stat<k>    — ghost-batch statistics from the first k rows only
+               (BIGDL_TPU_BN_STAT_ROWS=k), e.g. stat64
+  <any>_remat — the above combined with the conv_out remat policy
 """
 
 from __future__ import annotations
@@ -42,12 +47,26 @@ _PRISTINE_APPLY = None  # BatchNormalization.apply before any variant patch
 def _variant_apply(kind):
     import os
 
+    for var in ("BIGDL_TPU_BN_FUSED_VJP", "BIGDL_TPU_BN_IMPL",
+                "BIGDL_TPU_BN_STAT_ROWS"):
+        os.environ.pop(var, None)
     if kind == "custom_vjp":
         # the library implementation behind BIGDL_TPU_BN_FUSED_VJP
         # (nn/normalization._fused_bn_train) — benchmark THAT, not a copy
         os.environ["BIGDL_TPU_BN_FUSED_VJP"] = "1"
         return _PRISTINE_APPLY
-    os.environ.pop("BIGDL_TPU_BN_FUSED_VJP", None)
+    if kind == "pallas":
+        # the fully fused Pallas kernel (ops/batchnorm.bn_train)
+        os.environ["BIGDL_TPU_BN_IMPL"] = "pallas"
+        return _PRISTINE_APPLY
+    if kind.startswith("stat") and kind[len("stat"):].isdigit():
+        # ghost-batch statistics from the first k rows (BN_STAT_ROWS)
+        os.environ["BIGDL_TPU_BN_STAT_ROWS"] = kind[len("stat"):]
+        return _PRISTINE_APPLY
+    if kind not in ("baseline", "dtype_arg"):
+        # unknown names must not silently benchmark the baseline under a
+        # wrong label — mislabeled numbers would enter the bench provenance
+        raise ValueError(f"unknown BN variant: {kind!r}")
 
     def apply(self, params, state, x, *, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))
@@ -96,9 +115,11 @@ def bench_variant(kind: str) -> None:
         _PRISTINE_APPLY = BatchNormalization.apply
     # conv outputs are checkpoint_name-tagged by nn/conv itself, so the
     # remat variants only need the jax.checkpoint policy below
-    remat = kind in ("remat_conv", "vjp_remat")
-    BatchNormalization.apply = _variant_apply(
-        {"remat_conv": "baseline", "vjp_remat": "custom_vjp"}.get(kind, kind))
+    remat = kind.endswith("_remat") or kind in ("remat_conv", "vjp_remat")
+    base = {"remat_conv": "baseline", "vjp_remat": "custom_vjp"}.get(kind)
+    if base is None:
+        base = kind[:-len("_remat")] if kind.endswith("_remat") else kind
+    BatchNormalization.apply = _variant_apply(base)
     set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
     from ..models.resnet import ResNet
     model = ResNet(50, class_num=1000,
@@ -133,7 +154,9 @@ def bench_variant(kind: str) -> None:
 def main(argv=None):
     for kind in (argv or sys.argv[1:]) or ["baseline", "dtype_arg",
                                            "custom_vjp", "remat_conv",
-                                           "vjp_remat"]:
+                                           "vjp_remat", "pallas",
+                                           "pallas_remat", "stat64",
+                                           "stat64_remat"]:
         try:
             bench_variant(kind)
         except Exception as e:  # noqa: BLE001 — report and continue

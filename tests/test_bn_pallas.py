@@ -1,0 +1,85 @@
+"""Pallas fused-BN kernel parity vs the jnp oracle (interpret mode on CPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.ops.batchnorm import bn_train, bn_train_reference
+
+EPS = 1e-5
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape).astype(dtype)
+
+
+@pytest.mark.parametrize("shape,block_r", [
+    ((4, 6, 6, 3), 16),      # C below a lane, rows not a block multiple
+    ((32, 128), 8),          # 2-D input, many row blocks
+    ((2, 7, 5, 130), 32),    # C just past one lane, ragged rows
+])
+def test_forward_parity(shape, block_r):
+    x = _rand(shape, 0)
+    w = 1.0 + 0.1 * _rand(shape[-1:], 1)
+    b = 0.1 * _rand(shape[-1:], 2)
+    y, mean, var = bn_train(x, w, b, EPS, block_r, True)
+    yr, mr, vr = bn_train_reference(x, w, b, EPS)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(vr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grad_parity():
+    shape = (8, 5, 5, 67)
+    x = _rand(shape, 3)
+    w = 1.0 + 0.1 * _rand(shape[-1:], 4)
+    b = 0.1 * _rand(shape[-1:], 5)
+    t = _rand(shape, 6)
+
+    def loss_pallas(x, w, b):
+        y, _, _ = bn_train(x, w, b, EPS, 16, True)
+        return jnp.sum((y - t) ** 2)
+
+    def loss_ref(x, w, b):
+        y, mean, var = bn_train_reference(x, w, b, EPS)
+        return jnp.sum((y - t) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, b_, name in zip(gp, gr, ("dx", "dw", "db")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-4, err_msg=name)
+
+
+def test_bf16_activations():
+    shape = (16, 4, 4, 32)
+    x = _rand(shape, 7, jnp.bfloat16)
+    w = jnp.ones((32,), jnp.float32)
+    b = jnp.zeros((32,), jnp.float32)
+    y, mean, var = bn_train(x, w, b, EPS, 32, True)
+    assert y.dtype == jnp.bfloat16
+    yr, mr, vr = bn_train_reference(x, w, b, EPS)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mr), atol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0.05, atol=0.05)
+
+
+def test_module_pallas_impl(monkeypatch):
+    """BatchNormalization routes through the kernel under BIGDL_TPU_BN_IMPL."""
+    from bigdl_tpu.nn import SpatialBatchNormalization
+    bn = SpatialBatchNormalization(12)
+    params, state = bn.init(jax.random.PRNGKey(0))
+    x = _rand((6, 5, 5, 12), 8)
+
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    y1, s1 = bn.apply(params, state, x, training=True)
+    monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+    y0, s0 = bn.apply(params, state, x, training=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-5)
+    for k in s0:
+        np.testing.assert_allclose(np.asarray(s1[k]), np.asarray(s0[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
