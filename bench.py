@@ -103,9 +103,15 @@ def _log(msg):
 
 def _fail(err, stage):
     if not _claim_emit():
-        # another thread claimed the final line; claiming precedes writing,
-        # so wait for the write to land before killing the process
-        _EMIT_DONE.wait(timeout=60)
+        # another thread claimed the final line (possibly the watchdog
+        # emitting a VALID partial-results record with exit 0) — give it a
+        # long grace instead of os._exit(1)-ing immediately: racing the
+        # claimant's exit could stamp a failed status onto a usable
+        # artifact.  The grace is bounded (not park-forever) so a claimant
+        # that died between claiming and exiting cannot leave a zombie
+        # bench process holding the TPU.
+        _EMIT_DONE.wait(timeout=120)
+        time.sleep(600)
         os._exit(1)
     print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "error",
                       "vs_baseline": None, "stage": stage, "error": str(err)}))
@@ -167,12 +173,23 @@ def _step_flops(jitted, compiled, example_args):
         # NOMINAL model FLOPs, not the zero channels _pad_tiny_cin adds for
         # compile speed (LeNet's conv FLOPs would otherwise inflate ~3x);
         # xla cost_analysis below still sees the padded compiled program,
-        # which can legitimately trip the disagreement log for tiny models
+        # which can legitimately trip the disagreement log for tiny models.
+        # Trace the UNJITTED function (`.raw`, set by _build_step): tracing
+        # the jitted wrapper would hit pjit's cached (padded) trace and
+        # ignore the env toggle entirely.
+        fn = getattr(jitted, "raw", jitted)
+        prior = os.environ.get("BIGDL_TPU_CONV_PAD_MIN_CIN")
         os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"] = "0"
         try:
-            analytic = jaxpr_flops(jax.make_jaxpr(jitted)(*example_args))
+            # fresh lambda: make_jaxpr caches by function identity, and a
+            # prior trace of fn under different env settings must not leak
+            analytic = jaxpr_flops(
+                jax.make_jaxpr(lambda *a: fn(*a))(*example_args))
         finally:
-            del os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"]
+            if prior is None:
+                del os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"]
+            else:
+                os.environ["BIGDL_TPU_CONV_PAD_MIN_CIN"] = prior
     except Exception as e:  # noqa: BLE001
         _log(f"analytic flops failed: {type(e).__name__}: {e}")
     try:
@@ -624,43 +641,56 @@ def _start_watchdog(stall_seconds, compile_stall_seconds):
             if idle > limit:
                 if not _claim_emit():
                     return  # main thread already claimed the final line
-                _log(f"WATCHDOG: no progress for {idle:.0f}s in stage "
-                     f"'{stage}' (limit {limit:.0f}s) — lost-RPC hang; "
-                     "emitting partial results")
-                st = _STALL_STATE
-                if st["meta"] is None or not st["results"]:
-                    prior = "; ".join(f"{k}: {v}"
-                                      for k, v in st["errors"].items())
-                    _fail(TimeoutError(
-                        f"no progress for {idle:.0f}s in {stage}" +
-                        (f" (earlier config errors: {prior})" if prior
-                         else "")), f"stall:{stage}")
-                # snapshot the live dicts (atomic C-level copies under the
-                # GIL): the main thread's hung RPC can resolve late and
-                # keep inserting while json.dumps iterates
-                results = dict(st["results"])
-                errors = dict(st["errors"])
-                skipped = list(st["skipped"])
-                stall = {"stage": stage, "idle_seconds": round(idle, 1)}
+                # from here on this thread OWNS the process exit: any
+                # uncaught raise (e.g. stderr pipe gone mid-_log) must
+                # still _exit, or the parked loser threads would leave a
+                # zombie bench process holding the TPU
                 try:
-                    attempted = set(results) | set(errors) | set(skipped)
-                    cur = stage.split(":", 1)[-1]
-                    stall["configs_not_attempted"] = [
-                        c for c in st["meta"]["args"].configs
-                        if c not in attempted and c != cur]
-                    _assemble_and_print(results=results, errors=errors,
-                                        skipped=skipped, stall=stall,
-                                        **st["meta"])
-                except Exception as e:  # noqa: BLE001 — line must land
-                    _fail(f"stall in {stage}; emit of partial results "
-                          f"failed: {type(e).__name__}: {e}",
-                          f"stall:{stage}")
-                # partial results are a valid, self-describing JSON line
-                # (the "stall" field names the hung stage) — exit 0 like
-                # the budget-skip path so the driver records it
-                os._exit(0)
+                    _watchdog_emit(stage, idle, limit)
+                except Exception:  # noqa: BLE001
+                    pass
+                os._exit(1)
 
     threading.Thread(target=watch, daemon=True, name="bench-watchdog").start()
+
+
+def _watchdog_emit(stage, idle, limit):
+    """Emit partial results (or a bench_error) after a declared stall; the
+    caller owns the final os._exit on any raise that escapes this."""
+    _log(f"WATCHDOG: no progress for {idle:.0f}s in stage "
+         f"'{stage}' (limit {limit:.0f}s) — lost-RPC hang; "
+         "emitting partial results")
+    st = _STALL_STATE
+    if st["meta"] is None or not st["results"]:
+        prior = "; ".join(f"{k}: {v}" for k, v in st["errors"].items())
+        _fail(TimeoutError(
+            f"no progress for {idle:.0f}s in {stage}" +
+            (f" (earlier config errors: {prior})" if prior
+             else "")), f"stall:{stage}")
+    # snapshot the live dicts (atomic C-level copies under the
+    # GIL): the main thread's hung RPC can resolve late and
+    # keep inserting while json.dumps iterates
+    results = dict(st["results"])
+    errors = dict(st["errors"])
+    skipped = list(st["skipped"])
+    stall = {"stage": stage, "idle_seconds": round(idle, 1)}
+    try:
+        attempted = set(results) | set(errors) | set(skipped)
+        cur = stage.split(":", 1)[-1]
+        stall["configs_not_attempted"] = [
+            c for c in st["meta"]["args"].configs
+            if c not in attempted and c != cur]
+        _assemble_and_print(results=results, errors=errors,
+                            skipped=skipped, stall=stall,
+                            **st["meta"])
+    except Exception as e:  # noqa: BLE001 — line must land
+        _fail(f"stall in {stage}; emit of partial results "
+              f"failed: {type(e).__name__}: {e}",
+              f"stall:{stage}")
+    # partial results are a valid, self-describing JSON line
+    # (the "stall" field names the hung stage) — exit 0 like
+    # the budget-skip path so the driver records it
+    os._exit(0)
 
 
 def _scaling_table():

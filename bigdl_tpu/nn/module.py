@@ -76,12 +76,36 @@ class Module:
         self.output = None
         self.grad_input = None
         self._last_rng = None
-        # per-module gradient scaling (AbstractModule.scala:73 scaleW/scaleB)
-        self.scale_w: float = 1.0
-        self.scale_b: float = 1.0
+        # per-module gradient scaling (AbstractModule.scala:73 scaleW/scaleB);
+        # property-backed so even direct assignment bumps the scale epoch
+        self._scale_w: float = 1.0
+        self._scale_b: float = 1.0
         # initializer overrides (nn/abstractnn/Initializable.scala:23)
         self.weight_initializer = None
         self.bias_initializer = None
+
+    # scale_w/scale_b are properties so that DIRECT attribute assignment
+    # (m.scale_w = 2.0) also bumps the scale epoch — otherwise a cached
+    # grad-scale tree or an already-compiled step would keep applying the
+    # stale scale with no error.  set_scale_w/set_scale_b remain the
+    # container-propagating API.
+    @property
+    def scale_w(self) -> float:
+        return self._scale_w
+
+    @scale_w.setter
+    def scale_w(self, s: float):
+        self._scale_w = s
+        _SCALE_EPOCH[0] += 1
+
+    @property
+    def scale_b(self) -> float:
+        return self._scale_b
+
+    @scale_b.setter
+    def scale_b(self, s: float):
+        self._scale_b = s
+        _SCALE_EPOCH[0] += 1
 
     # ------------------------------------------------------------------
     # pure functional core — override _init / _apply (stateless layers) or

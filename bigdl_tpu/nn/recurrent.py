@@ -78,6 +78,17 @@ def _uniform(rng, shape, stdv):
     return jax.random.uniform(rng, shape, get_policy().param_dtype, -stdv, stdv)
 
 
+def _dense_hoist_ok(xs, gate_width):
+    """HBM guard for the dense cells' input-projection hoisting: the hoisted
+    (T, B, gate_width) f32 projection lives for the whole scan and can OOM
+    where the un-hoisted per-step scan fit (long sequence x large hidden).
+    Same cap and t == 1 exemption as ConvLSTM's project_inputs — one step's
+    projection is the gates tensor the per-step path materializes anyway."""
+    t, b = xs.shape[0], xs.shape[1]
+    return t == 1 or t * b * gate_width <= _config.get_int(
+        "RNN_HOIST_MAX_ELEMENTS", 1 << 28)
+
+
 def _project(xs, w):
     """(T, B, I) @ (I, G) as one flat MXU gemm, f32 accumulation."""
     cd = get_policy().compute_dtype
@@ -107,6 +118,8 @@ class RnnCell(Cell):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
     def project_inputs(self, params, xs):
+        if not _dense_hoist_ok(xs, self.hidden_size):
+            return None
         return _project(xs, params["w_ih"])
 
     def step_projected(self, params, xp_t, h):
@@ -157,6 +170,8 @@ class LSTM(Cell):
         return h_new, (h_new, c_new.astype(h.dtype))
 
     def project_inputs(self, params, xs):
+        if not _dense_hoist_ok(xs, 4 * self.hidden_size):
+            return None
         return _project(xs, params["kernel"][: self.input_size])
 
 
@@ -203,6 +218,8 @@ class LSTMPeephole(Cell):
         return h_new, (h_new, c_new.astype(h.dtype))
 
     def project_inputs(self, params, xs):
+        if not _dense_hoist_ok(xs, 4 * self.hidden_size):
+            return None
         return _project(xs, params["kernel"][: self.input_size])
 
 
@@ -248,6 +265,8 @@ class GRU(Cell):
         return h_new, h_new
 
     def project_inputs(self, params, xs):
+        if not _dense_hoist_ok(xs, 3 * self.hidden_size):  # both trees
+            return None
         I = self.input_size
         return (_project(xs, params["gate_kernel"][:I]),
                 _project(xs, params["cand_kernel"][:I]))

@@ -32,6 +32,7 @@ driver semantics (DistriOptimizer.scala:141-381).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import math
 import time
@@ -528,6 +529,11 @@ class Optimizer:
                 return jitted.lower(*args, **kw)
 
         step_in_mesh.lower = lower_in_mesh  # bench/dryrun introspection
+        # the UNJITTED step for analytic-FLOPs tracing: make_jaxpr on the
+        # jitted wrapper would reuse pjit's cached trace, freezing whatever
+        # env-dependent lowering (e.g. the tiny-channel conv pad) was active
+        # at compile time
+        step_in_mesh.raw = step
         return step_in_mesh, param_sh, data_sh
 
     def _build_forward(self, mesh):
@@ -564,8 +570,17 @@ class Optimizer:
         self._initial_blob = None
         self._preempted = False
         old_handlers = {}
-        if self.checkpoint_path is not None and \
-                config.get_bool("PREEMPTION_CHECKPOINT", True):
+        # armed from rank-consistent inputs ONLY (checkpoint_path and the
+        # env knob must agree across ranks) — NOT from whether the signal
+        # install below succeeded: if optimize() runs on a non-main thread
+        # on some ranks only, signal.signal raises there and a handler-based
+        # flag would desync _global_preempted's process_allgather, deadlocking
+        # the first iteration boundary.  A rank without a handler simply
+        # never raises the flag itself but still joins every collective.
+        self._preemption_armed = (
+            self.checkpoint_path is not None
+            and config.get_bool("PREEMPTION_CHECKPOINT", True))
+        if self._preemption_armed:
             import signal as _signal
 
             def _on_preempt(signum, frame):
@@ -579,10 +594,7 @@ class Optimizer:
                 old_handlers[_signal.SIGTERM] = _signal.signal(
                     _signal.SIGTERM, _on_preempt)
             except ValueError:
-                pass  # not the main thread: no signal-based preemption
-        # rank-consistent (checkpoint_path and the env knob must agree
-        # across ranks): gates the per-step preemption collectives
-        self._preemption_armed = bool(old_handlers)
+                pass  # not the main thread: best-effort handler install
         try:
             return self._optimize_with_retry(retries, max_retries, window,
                                              last_failure)
@@ -1190,6 +1202,31 @@ class _ShardedForward:
         return out, n
 
 
+class _PeekedDataSet:
+    """Replays a peeked-into iterator on the first data() call, then
+    delegates to the wrapped dataset (fresh iterators as usual).  Keeps
+    Evaluator's batch-size autodetect peek loss-free for one-shot
+    generator-backed datasets."""
+
+    def __init__(self, inner, first, rest):
+        self._inner = inner
+        self._replay = (first, rest)
+
+    def size(self):
+        return self._inner.size()
+
+    def data(self, train=False):
+        if self._replay is not None:
+            first, rest = self._replay
+            self._replay = None
+            return itertools.chain([first], rest)
+        return self._inner.data(train=train)
+
+    def transform(self, transformer):
+        from ..dataset import TransformedDataSet
+        return TransformedDataSet(self, transformer)
+
+
 class Evaluator:
     """Bulk inference + metrics (reference: optim/Evaluator.scala:37; the
     ModelBroadcast weight-detach dance (models/utils/ModelBroadcast.scala:66)
@@ -1207,8 +1244,14 @@ class Evaluator:
         if batch_size is None:
             # un-batched Sample datasets need batching (the reference's
             # batchSize parameter has a cluster-derived default); peek one
-            # element — dataset.data() returns a fresh iterator each call
-            first = next(iter(dataset.data(train=False)), None)
+            # element, then CHAIN the peeked iterator back through a replay
+            # wrapper — for a one-shot generator-backed dataset a discarded
+            # peek iterator would silently drop the first sample from every
+            # evaluation entry point
+            it = iter(dataset.data(train=False))
+            first = next(it, None)
+            if first is not None:
+                dataset = _PeekedDataSet(dataset, first, it)
             if first is not None and not hasattr(first, "get_input"):
                 batch_size = 128
         if batch_size is not None:

@@ -56,7 +56,17 @@ def _variant_apply(kind):
         os.environ["BIGDL_TPU_BN_FUSED_VJP"] = "1"
         return _PRISTINE_APPLY
     if kind == "pallas":
-        # the fully fused Pallas kernel (ops/batchnorm.bn_train)
+        # the fully fused Pallas kernel (ops/batchnorm.bn_train).
+        # BatchNormalization only routes to it on a single device
+        # (normalization.py: GSPMD cannot partition the opaque call) — fail
+        # loud rather than silently benchmark the baseline under this label
+        import jax
+
+        if jax.device_count() != 1:
+            raise RuntimeError(
+                f"pallas BN variant needs exactly 1 device (have "
+                f"{jax.device_count()}): the library would fall back to "
+                "the baseline path and mislabel the measurement")
         os.environ["BIGDL_TPU_BN_IMPL"] = "pallas"
         return _PRISTINE_APPLY
     if kind.startswith("stat") and kind[len("stat"):].isdigit():
