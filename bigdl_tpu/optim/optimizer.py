@@ -36,7 +36,7 @@ import itertools
 import logging
 import math
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional, Sequence
 
 import jax
@@ -143,6 +143,34 @@ def _accumulated_grads(model, criterion, collect_aux_losses, apply_remat,
         (micro_inp, micro_tgt, rngs))
     grads = jax.tree.map(lambda g: g / accum, gsum)
     return lsum / accum, new_ns, grads
+
+
+def _gather_non_batch(tree):
+    """Replicate every non-batch output axis before per-rank row extraction.
+
+    A tensor-parallel head leaves the CLASS axis 'model'-sharded; $_local_rows
+    would (correctly) refuse such outputs.  A jitted identity with
+    out_shardings that keep the batch spec but drop the rest lowers to one
+    small allgather over the model axes — every rank calls it symmetrically
+    (validation steps are already collective), so multi-host TP validation
+    works end-to-end instead of raising NotImplementedError."""
+    def fix(garr):
+        sh = getattr(garr, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            return garr
+        spec = tuple(sh.spec)
+        if len(spec) <= 1 or all(s is None for s in spec[1:]):
+            return garr
+        tgt = NamedSharding(sh.mesh, P(spec[0]))
+        return _gather_identity(tgt)(garr)
+    return jax.tree.map(fix, tree)
+
+
+@lru_cache(maxsize=64)
+def _gather_identity(tgt):
+    """One jitted identity per target sharding: a fresh jit wrapper per
+    batch would re-trace/re-compile the allgather every validation step."""
+    return jax.jit(lambda a: a, out_shardings=tgt)
 
 
 def _local_rows(tree):
@@ -875,6 +903,19 @@ class Optimizer:
                 pending_loss = None
 
             wall = time.perf_counter() - epoch_start
+            if epoch_records == 0:
+                # silently spinning epochs train nothing (observed: an
+                # 8-process run whose per-process shard was smaller than the
+                # local batch size with drop_last=True — every rank yielded
+                # zero minibatches and "trained" to a NaN loss)
+                raise ConfigurationError(
+                    "epoch produced no minibatches: the per-process dataset "
+                    "shard is smaller than the local batch size with "
+                    "drop_last=True (global dataset "
+                    f"{getattr(self.dataset, 'size', lambda: '?')()} "
+                    f"samples over {jax.process_count()} process(es)). "
+                    "Lower the batch size, add samples, or use "
+                    "pad_last=True")
             logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s)",
                         state["epoch"], epoch_records, wall,
                         epoch_records / max(wall, 1e-9))
@@ -982,7 +1023,8 @@ class Optimizer:
             out = self._forward_fn(params, net_state, inp)
             # multi-host: score THIS process's rows against its local
             # targets, then sum result structs across processes below
-            out_local = _local_rows(out) if multi else out
+            # (TP heads: gather the class axis first)
+            out_local = _local_rows(_gather_non_batch(out)) if multi else out
             out_np = _trim(out_local, batch.valid)
             tgt_np = _trim(batch.get_target(), batch.valid)
             for i, m in enumerate(self.validation_methods):
@@ -1198,7 +1240,7 @@ class _ShardedForward:
             # global outputs are not host-addressable from one process;
             # each process fed the full rows, so its local shard IS the
             # complete (redundantly computed) answer
-            out = _local_rows(out)
+            out = _local_rows(_gather_non_batch(out))
         return out, n
 
 

@@ -4,6 +4,9 @@ simple_integration_test drives the shipped examples the same way)."""
 import numpy as np
 import pytest
 
+# subprocess integration: the slow lane (pyproject addopts)
+pytestmark = pytest.mark.slow
+
 from bigdl_tpu.utils.engine import Engine
 
 

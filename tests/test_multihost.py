@@ -15,6 +15,9 @@ import textwrap
 
 import pytest
 
+# subprocess integration: the slow lane (pyproject addopts)
+pytestmark = pytest.mark.slow
+
 from conftest import spawn_multihost_workers
 
 _WORKER = textwrap.dedent("""

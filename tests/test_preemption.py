@@ -8,6 +8,11 @@ subprocess so the signal handling is exercised for real.
 """
 
 import json
+
+import pytest
+
+# subprocess + 20s sleeps: slow lane (pyproject addopts)
+pytestmark = pytest.mark.slow
 import os
 import signal
 import subprocess
