@@ -1,0 +1,336 @@
+"""Load/save models in the reference's native format (Java serialization).
+
+Reference: `Module.save`/`Module.load` serialize the module object graph with
+`ObjectOutputStream` (`nn/Module.scala:41-43`, `utils/File.scala:25`); the
+reference's own `example/loadmodel/ModelValidator.scala` treats "bigdl" as a
+first-class format alongside caffe/torch.  This module closes that interop
+axis: `load` parses any object stream via `interop/javaser.py` (the stream is
+self-describing), walks the module tree by class NAME, and rebuilds the
+equivalent `bigdl_tpu` modules with layout-converted weights; `save` emits the
+same wire format for the supported layer subset (and generates the checked-in
+fixtures — no JVM exists in this image to run actual BigDL).
+
+Layouts (same conversions as the Caffe/Torch importers):
+  Linear weight   (out, in)                        -> (in, out)
+  SpatialConvolution weight (g, out/g, in/g, kh, kw) -> HWIO (kh, kw, in/g, out)
+  BatchNormalization runningMean/runningVar          -> state pytree
+
+Unknown layer classes fail loudly with the class name (fail-loud default,
+like interop/tensorflow.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .javaser import (SC_SERIALIZABLE, SC_WRITE_METHOD, JavaArray,
+                      JavaClassDesc, JavaObject, JavaWriter, load_stream)
+
+__all__ = ["load", "save"]
+
+_PKG = "com.intel.analytics.bigdl.nn."
+_TENSOR = "com.intel.analytics.bigdl.tensor.DenseTensor"
+_STORAGE = "com.intel.analytics.bigdl.tensor.ArrayStorage"
+# SerialVersionUIDs from the reference source (@SerialVersionUID annotations)
+_SUID = {
+    _TENSOR: 5876322619614900645,
+    _PKG + "Sequential": 5375403296928513267,
+    _PKG + "Linear": 359656776803598943,
+    _PKG + "ReLU": 1208478077576570643,
+}
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+def _to_numpy(t: Optional[JavaObject]) -> Optional[np.ndarray]:
+    """DenseTensor -> numpy via (_storage, _storageOffset, _size, _stride)."""
+    if t is None:
+        return None
+    if t.classname != _TENSOR:
+        raise ValueError(f"expected DenseTensor, got {t.classname}")
+    storage = t.fields["_storage"]
+    values = np.asarray(storage.fields["values"].values
+                        if isinstance(storage.fields["values"], JavaArray)
+                        else storage.fields["values"])
+    ndim = int(t.fields["nDimension"])
+    if ndim == 0:
+        return np.zeros((0,), values.dtype)
+    size = np.asarray(t.fields["_size"].values)[:ndim]
+    stride = np.asarray(t.fields["_stride"].values)[:ndim]
+    off = int(t.fields["_storageOffset"])
+    out = np.lib.stride_tricks.as_strided(
+        values[off:], shape=tuple(int(s) for s in size),
+        strides=tuple(int(st) * values.itemsize for st in stride))
+    return np.array(out)  # copy: detach from the storage buffer
+
+
+def _children(obj: JavaObject) -> List[JavaObject]:
+    """Container.modules: scala ArrayBuffer (fields `array` + `size0`)."""
+    buf = obj.fields.get("modules")
+    if buf is None:
+        return []
+    arr = buf.fields.get("array")
+    n = int(buf.fields.get("size0", 0))
+    items = arr.values[:n] if isinstance(arr, JavaArray) else []
+    return [m for m in items if m is not None]
+
+
+def _build(obj: JavaObject):
+    """Map one reference module object -> (bigdl_tpu module, params, state)."""
+    from .. import nn
+
+    cls = obj.classname
+    short = cls[len(_PKG):] if cls.startswith(_PKG) else cls
+    f = obj.fields
+    if short == "Sequential":
+        seq = nn.Sequential()
+        params, states = [], []
+        for child in _children(obj):
+            m, p, s = _build(child)
+            seq.add(m)
+            params.append(p)
+            states.append(s)
+        return seq, params, states
+    if short == "Linear":
+        m = nn.Linear(int(f["inputSize"]), int(f["outputSize"]),
+                      with_bias=f.get("withBias", True))
+        # both sides store (out, in) — nn.Linear keeps the reference layout
+        p = {"weight": _to_numpy(f["weight"])}
+        if f.get("withBias", True) and f.get("bias") is not None:
+            p["bias"] = _to_numpy(f["bias"])
+        return m, p, {}
+    if short == "SpatialConvolution":
+        g = int(f.get("nGroup", 1))
+        m = nn.SpatialConvolution(
+            int(f["nInputPlane"]), int(f["nOutputPlane"]),
+            int(f["kernelW"]), int(f["kernelH"]),
+            int(f.get("strideW", 1)), int(f.get("strideH", 1)),
+            int(f.get("padW", 0)), int(f.get("padH", 0)), g,
+            with_bias=bool(f.get("withBias", True))
+            and f.get("bias") is not None)
+        w = _to_numpy(f["weight"])  # (g, out/g, in/g, kh, kw)
+        # -> HWIO (kh, kw, in/g, out):  merge the group dim into out
+        w = w.transpose(3, 4, 2, 0, 1).reshape(
+            w.shape[3], w.shape[4], w.shape[2], -1)
+        p = {"weight": w}
+        if f.get("bias") is not None:
+            p["bias"] = _to_numpy(f["bias"])
+        return m, p, {}
+    if short in ("SpatialBatchNormalization", "BatchNormalization"):
+        ctor = (nn.SpatialBatchNormalization
+                if short == "SpatialBatchNormalization"
+                else nn.BatchNormalization)
+        m = ctor(int(f["nOutput"]), eps=float(f.get("eps", 1e-5)),
+                 momentum=float(f.get("momentum", 0.1)),
+                 affine=bool(f.get("affine", True)))
+        p = {}
+        if f.get("weight") is not None:
+            p = {"weight": _to_numpy(f["weight"]),
+                 "bias": _to_numpy(f["bias"])}
+        s = {"running_mean": _to_numpy(f["runningMean"]),
+             "running_var": _to_numpy(f["runningVar"])}
+        return m, p, s
+    if short == "SpatialMaxPooling":
+        return nn.SpatialMaxPooling(int(f["kW"]), int(f["kH"]),
+                                    int(f["dW"]), int(f["dH"]),
+                                    int(f.get("padW", 0)),
+                                    int(f.get("padH", 0))), {}, {}
+    if short == "SpatialAveragePooling":
+        return nn.SpatialAveragePooling(int(f["kW"]), int(f["kH"]),
+                                        int(f.get("dW", 1)),
+                                        int(f.get("dH", 1)),
+                                        int(f.get("padW", 0)),
+                                        int(f.get("padH", 0))), {}, {}
+    if short == "Reshape":
+        size = [int(x) for x in np.asarray(f["size"].values)]
+        return nn.Reshape(size), {}, {}
+    if short == "ReLU":
+        return nn.ReLU(), {}, {}
+    if short == "Tanh":
+        return nn.Tanh(), {}, {}
+    if short == "Sigmoid":
+        return nn.Sigmoid(), {}, {}
+    if short == "LogSoftMax":
+        return nn.LogSoftMax(), {}, {}
+    if short == "Dropout":
+        return nn.Dropout(float(f.get("initP", 0.5))), {}, {}
+    if short == "Identity":
+        return nn.Identity(), {}, {}
+    raise ValueError(
+        f"bigdl format: unsupported layer class {cls} — extend "
+        "interop/bigdl._build (fail-loud, like the TensorFlow importer)")
+
+
+def load(path: str):
+    """Load a reference-format model file -> built bigdl_tpu Module
+    (params/state attached, ready for forward/predict)."""
+    with open(path, "rb") as fh:
+        return load_bytes(fh.read())
+
+
+def load_bytes(data: bytes):
+    """As `load`, from in-memory bytes (remote-path callers read via
+    file_io/fsspec and hand the payload here)."""
+    import io
+
+    import jax.numpy as jnp
+
+    contents = load_stream(io.BytesIO(data))
+    roots = [c for c in contents if isinstance(c, JavaObject)]
+    if not roots:
+        raise ValueError("bigdl stream: no serialized object found")
+    module, params, state = _build(roots[0])
+
+    def to_jax(tree):
+        if isinstance(tree, dict):
+            return {k: to_jax(v) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [to_jax(v) for v in tree]
+        return jnp.asarray(tree)
+
+    module.attach(to_jax(params), to_jax(state))
+    return module
+
+
+# ---------------------------------------------------------------------------
+# writing
+# ---------------------------------------------------------------------------
+
+class _DescCache:
+    """One JavaClassDesc per class per stream (so repeats become refs)."""
+
+    def __init__(self):
+        self.cache: Dict[str, JavaClassDesc] = {}
+
+    def get(self, name: str, fields, super_desc=None) -> JavaClassDesc:
+        if name not in self.cache:
+            self.cache[name] = JavaClassDesc(
+                name, _SUID.get(name, 1), SC_SERIALIZABLE, fields, super_desc)
+        return self.cache[name]
+
+    def array(self, signature: str) -> JavaClassDesc:
+        return self.get(signature, [])
+
+
+def _w_tensor(dc: _DescCache, a: np.ndarray) -> JavaObject:
+    a = np.ascontiguousarray(np.asarray(a, np.float32))
+    storage_cd = dc.get(_STORAGE, [("[", "values", "[F")])
+    storage = JavaObject(storage_cd, {
+        "values": JavaArray(dc.array("[F"), a.reshape(-1))})
+    stride = np.cumprod((1,) + a.shape[::-1][:-1])[::-1].astype(np.int32)
+    cd = dc.get(_TENSOR, [
+        ("I", "_storageOffset", None), ("I", "nDimension", None),
+        ("L", "_storage", "Lcom/intel/analytics/bigdl/tensor/Storage;"),
+        ("[", "_size", "[I"), ("[", "_stride", "[I")])
+    return JavaObject(cd, {
+        "_storageOffset": 0, "nDimension": a.ndim, "_storage": storage,
+        "_size": JavaArray(dc.array("[I"), np.asarray(a.shape, np.int32)),
+        "_stride": JavaArray(dc.array("[I"), stride)})
+
+
+def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
+    from .. import nn
+
+    def obj(short, prim_fields, obj_fields):
+        fields = ([(t, n, None) for t, n, _v in prim_fields] +
+                  [("L" if not s.startswith("[") else "[", n, s)
+                   for n, s, _v in obj_fields])
+        cd = dc.get(_PKG + short, fields)
+        vals = {n: v for _t, n, v in prim_fields}
+        vals.update({n: v for n, _s, v in obj_fields})
+        return JavaObject(cd, vals)
+
+    t = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
+    if isinstance(m, nn.Sequential):
+        kids = [_w_module(dc, c, p, s)
+                for c, p, s in zip(m.modules, params, state)]
+        buf_cd = dc.get("scala.collection.mutable.ArrayBuffer",
+                        [("I", "initialSize", None), ("I", "size0", None),
+                         ("[", "array", "[Ljava/lang/Object;")])
+        buf = JavaObject(buf_cd, {
+            "initialSize": 16, "size0": len(kids),
+            "array": JavaArray(dc.array("[Ljava.lang.Object;"), kids)})
+        cd = dc.get(_PKG + "Sequential",
+                    [("L", "modules", "Lscala/collection/mutable/ArrayBuffer;")])
+        return JavaObject(cd, {"modules": buf})
+    if isinstance(m, nn.Linear):
+        return obj("Linear",
+                   [("I", "inputSize", m.input_size),
+                    ("I", "outputSize", m.output_size),
+                    ("Z", "withBias", m.with_bias)],
+                   [("weight", t, _w_tensor(dc, params["weight"])),
+                    ("bias", t, _w_tensor(dc, params["bias"])
+                     if m.with_bias else None)])
+    if isinstance(m, nn.SpatialConvolution):
+        kh, kw = m.kernel
+        sh, sw = m.stride
+        ph, pw = m.pad
+        w = np.asarray(params["weight"])  # HWIO
+        g = m.n_group
+        w5 = w.reshape(kh, kw, w.shape[2], g, -1).transpose(3, 4, 2, 0, 1)
+        return obj("SpatialConvolution",
+                   [("I", "nInputPlane", m.n_input_plane),
+                    ("I", "nOutputPlane", m.n_output_plane),
+                    ("I", "kernelW", kw), ("I", "kernelH", kh),
+                    ("I", "strideW", sw), ("I", "strideH", sh),
+                    ("I", "padW", pw), ("I", "padH", ph),
+                    ("I", "nGroup", g)],
+                   [("weight", t, _w_tensor(dc, w5)),
+                    ("bias", t, _w_tensor(dc, params["bias"])
+                     if m.with_bias else None)])
+    if isinstance(m, (nn.SpatialBatchNormalization, nn.BatchNormalization)):
+        short = type(m).__name__
+        return obj(short,
+                   [("I", "nOutput", m.n_output), ("D", "eps", m.eps),
+                    ("D", "momentum", m.momentum),
+                    ("Z", "affine", m.affine)],
+                   [("weight", t, _w_tensor(dc, params["weight"])
+                     if m.affine else None),
+                    ("bias", t, _w_tensor(dc, params["bias"])
+                     if m.affine else None),
+                    ("runningMean", t, _w_tensor(dc, state["running_mean"])),
+                    ("runningVar", t, _w_tensor(dc, state["running_var"]))])
+    if isinstance(m, nn.SpatialMaxPooling):
+        kh, kw = m.kernel
+        sh, sw = m.stride
+        ph, pw = m.pad
+        return obj("SpatialMaxPooling",
+                   [("I", "kW", kw), ("I", "kH", kh), ("I", "dW", sw),
+                    ("I", "dH", sh), ("I", "padW", pw), ("I", "padH", ph)],
+                   [])
+    if isinstance(m, nn.Reshape):
+        return obj("Reshape", [],
+                   [("size", "[I", JavaArray(
+                       dc.array("[I"), np.asarray(m.size, np.int32)))])
+    simple = {nn.ReLU: "ReLU", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
+              nn.LogSoftMax: "LogSoftMax", nn.Identity: "Identity"}
+    for pycls, short in simple.items():
+        if isinstance(m, pycls):
+            return obj(short, [], [])
+    raise ValueError(f"bigdl format save: unsupported layer "
+                     f"{type(m).__name__}")
+
+
+def save(model, path: str):
+    """Write `model` (built, params attached) in the reference wire format."""
+    if model.params is None:
+        raise ValueError("model has no parameters attached — call build() "
+                         "or load weights first")
+
+    def host(tree):
+        if isinstance(tree, dict):
+            return {k: host(v) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [host(v) for v in tree]
+        return np.asarray(tree)
+
+    dc = _DescCache()
+    root = _w_module(dc, model, host(model.params), host(model.state))
+    w = JavaWriter()
+    w.write_object(root)
+    with open(path, "wb") as fh:
+        fh.write(w.getvalue())

@@ -20,9 +20,18 @@ import json
 
 def load_model(model_type: str, path: str):
     if model_type == "bigdl":
+        # "bigdl" covers BOTH native formats: a file written by actual BigDL
+        # is a Java object-serialization stream (magic 0xACED — the
+        # reference's Module.save, utils/File.scala:25); a file written by
+        # THIS framework's Module.save is a weight-detached pickle.  Sniff
+        # through file_io so gs://-style remote paths keep working.
+        from ..utils import file_io
+        data = file_io.get_filesystem(path).read_bytes(path)
+        if data[:2] == b"\xac\xed":
+            from ..interop import bigdl as bigdl_fmt
+            return bigdl_fmt.load_bytes(data)
         from ..nn.module import Module
-        m = Module.load(path)
-        return m
+        return Module.load(path)
     if model_type == "caffe":
         from ..interop import load_caffe
         return load_caffe(path)[0]

@@ -1,0 +1,2 @@
+|Fx/oe	QC+@
+#s'-i_Y4l"W6q

@@ -1,0 +1,147 @@
+"""Native BigDL model format (Java object serialization) interop.
+
+Reference: Module.save/load = ObjectOutputStream (nn/Module.scala:41-43,
+utils/File.scala:25).  No JVM exists in this image, so the fixture is
+hand-built to the Java Object Serialization Specification by
+interop/bigdl.save and frozen on disk — the reader is pinned against those
+exact bytes, not just an in-memory roundtrip.
+"""
+
+import io
+import os
+import struct
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop import bigdl as bigdl_fmt
+from bigdl_tpu.interop.javaser import (JavaObject, JavaWriter, loads,
+                                       load_stream)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "interop",
+                       "lenet_like.bigdl")
+
+
+def _model():
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(1, 4, 5, 5))
+    m.add(nn.SpatialBatchNormalization(4))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    m.add(nn.Reshape([12 * 12 * 4]))
+    m.add(nn.Linear(12 * 12 * 4, 10))
+    m.add(nn.LogSoftMax())
+    m.build(jax.random.PRNGKey(3))
+    return m
+
+
+def test_javaser_roundtrip_primitives():
+    """The generic codec: write(read(x)) == x for a mixed object graph."""
+    from bigdl_tpu.interop.javaser import JavaArray, JavaClassDesc
+
+    cd = JavaClassDesc("com.example.Foo", 42, 2,
+                       [("I", "n", None), ("D", "x", None),
+                        ("[", "arr", "[F"),
+                        ("L", "name", "Ljava/lang/String;")], None)
+    arr = JavaArray(JavaClassDesc("[F", 1, 2, [], None),
+                    np.arange(5, dtype=np.float32))
+    obj = JavaObject(cd, {"n": 7, "x": 2.5, "arr": arr, "name": "hello"})
+    w = JavaWriter()
+    w.write_object(obj)
+    [back] = loads(w.getvalue())
+    assert back.classname == "com.example.Foo"
+    assert back.fields["n"] == 7 and back.fields["x"] == 2.5
+    assert back.fields["name"] == "hello"
+    np.testing.assert_array_equal(back.fields["arr"].values, arr.values)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = _model()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 28, 28, 1))
+    y_ref, _ = m.apply(m.params, m.state, x)
+
+    p = str(tmp_path / "model.bigdl")
+    bigdl_fmt.save(m, p)
+    loaded = bigdl_fmt.load(p)
+    y, _ = loaded.apply(loaded.params, loaded.state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_frozen_fixture_loads_and_predicts():
+    """The checked-in fixture's BYTES are the contract: stream magic, class
+    names with the reference's SerialVersionUIDs, and a prediction that
+    matches the recorded golden output."""
+    with open(FIXTURE, "rb") as fh:
+        raw = fh.read()
+    assert struct.unpack(">HH", raw[:4]) == (0xACED, 5)
+    assert b"com.intel.analytics.bigdl.nn.Sequential" in raw
+    assert b"com.intel.analytics.bigdl.tensor.DenseTensor" in raw
+
+    model = bigdl_fmt.load(FIXTURE)
+    x = np.fromfile(FIXTURE + ".x", dtype=np.float32).reshape(2, 28, 28, 1)
+    golden = np.fromfile(FIXTURE + ".y", dtype=np.float32).reshape(2, 10)
+    y, _ = model.apply(model.params, model.state, x)
+    np.testing.assert_allclose(np.asarray(y), golden, rtol=1e-5, atol=1e-5)
+
+
+def test_wire_layout_matches_reference():
+    """The serialized Linear weight must be (out, in) ON THE WIRE — the
+    reference's nn/Linear.scala layout.  A matched pair of spurious
+    transposes in save+load would pass the roundtrip test; this pins the
+    actual bytes' tensor shape."""
+    with open(FIXTURE, "rb") as fh:
+        contents = load_stream(fh)
+    [root] = [c for c in contents if isinstance(c, JavaObject)]
+
+    def find(obj, cls):
+        if isinstance(obj, JavaObject):
+            if obj.classname.endswith(cls):
+                yield obj
+            for v in obj.fields.values():
+                yield from find(v, cls)
+        elif hasattr(obj, "values") and isinstance(obj.values, list):
+            for v in obj.values:
+                yield from find(v, cls)
+
+    [linear] = find(root, ".Linear")
+    size = np.asarray(linear.fields["weight"].fields["_size"].values)
+    np.testing.assert_array_equal(size[:2], [10, 12 * 12 * 4])  # (out, in)
+    [conv] = find(root, ".SpatialConvolution")
+    csize = np.asarray(conv.fields["weight"].fields["_size"].values)
+    np.testing.assert_array_equal(csize[:5], [1, 4, 1, 5, 5])  # g,o/g,i/g,kh,kw
+
+
+def test_unknown_layer_fails_loud(tmp_path):
+    from bigdl_tpu.interop.javaser import JavaClassDesc
+
+    cd = JavaClassDesc("com.intel.analytics.bigdl.nn.SpatialShareConvolution",
+                       1, 2, [], None)
+    w = JavaWriter()
+    w.write_object(JavaObject(cd, {}))
+    p = tmp_path / "weird.bigdl"
+    p.write_bytes(w.getvalue())
+    with pytest.raises(ValueError, match="SpatialShareConvolution"):
+        bigdl_fmt.load(str(p))
+
+
+def test_model_validator_bigdl_format(tmp_path):
+    """model_validator's bigdl type sniffs the JVM wire format
+    (VERDICT r3 #4) and still reads this framework's own pickle."""
+    from bigdl_tpu.tools.model_validator import load_model
+
+    m = _model()
+    jvm = str(tmp_path / "m_jvm.bigdl")
+    bigdl_fmt.save(m, jvm)
+    ours = str(tmp_path / "m_ours.bigdl")
+    m.save(ours)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
+    y_ref, _ = m.apply(m.params, m.state, x)
+    for path in (jvm, ours):
+        loaded = load_model("bigdl", path)
+        y, _ = loaded.apply(loaded.params, loaded.state, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-6, err_msg=path)
