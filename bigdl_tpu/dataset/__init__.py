@@ -339,6 +339,17 @@ class DataSet:
         return DataSet.array(list(read_records(path)), distributed=distributed)
 
     @staticmethod
+    def seq_file_folder(folder, class_num=None, distributed: bool = False,
+                        **kw):
+        """Hadoop SequenceFile shards written by the reference's
+        ImageNetSeqFileGenerator — drop-in dataset compatibility
+        (DataSet.SeqFileFolder.files, dataset/DataSet.scala:524-531).
+        Streams out-of-core; see dataset/seqfile.py."""
+        from .seqfile import seq_file_folder
+        return seq_file_folder(folder, class_num=class_num,
+                               distributed=distributed, **kw)
+
+    @staticmethod
     def record_files(pattern, distributed: bool = False, seed: int = 1,
                      num_threads: int = 0):
         """A glob (or list) of BDRecord shards -> one dataset — the sharded
