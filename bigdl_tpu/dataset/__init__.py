@@ -243,6 +243,13 @@ class StreamingRecordDataSet(AbstractDataSet):
         cap = min(per_rank)  # equal steps on every host (collective safety)
         return [self.paths[i] for i in order[rank::count]], cap
 
+    def _read_shard(self, path: str) -> Iterator:
+        """One shard's records, in file order — the codec hook subclasses
+        (e.g. dataset/seqfile.SeqFileDataSet) override; the shared
+        plan/cap/emit loop in data() stays in one place."""
+        from ..utils.recordio import read_records
+        return read_records(path)
+
     def data(self, train: bool) -> Iterator:
         import pickle
         order = self._order if train else np.arange(len(self.paths))
@@ -252,7 +259,9 @@ class StreamingRecordDataSet(AbstractDataSet):
         def within_cap():
             return cap is None or emitted < cap
 
-        if train and self.num_threads > 0:
+        if train and self.num_threads > 0 and \
+                type(self)._read_shard is StreamingRecordDataSet._read_shard:
+            # the native prefetcher speaks the BDRecord codec only
             from ..utils import native
             if native.is_native_loaded() and native.has_prefetch():
                 with native.NativePrefetchReader(
@@ -263,9 +272,8 @@ class StreamingRecordDataSet(AbstractDataSet):
                         emitted += 1
                         yield pickle.loads(payload)
                 return
-        from ..utils.recordio import read_records
         for p in paths:
-            for rec in read_records(p):
+            for rec in self._read_shard(p):
                 if not within_cap():
                     return
                 emitted += 1

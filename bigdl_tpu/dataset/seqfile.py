@@ -119,8 +119,7 @@ def _read_header(f) -> Tuple[str, str, bytes]:
     return key_cls, val_cls, sync
 
 
-def read_seq_file(path: str) -> Iterator[Tuple[bytes, bytes]]:
-    """Yield raw (key, value) payloads (Text vint headers stripped)."""
+def _iter_records(path: str, keys_only: bool):
     with open(path, "rb") as f:
         _key_cls, _val_cls, sync = _read_header(f)
         while True:
@@ -141,10 +140,25 @@ def read_seq_file(path: str) -> Iterator[Tuple[bytes, bytes]]:
                     f"{path}: corrupt record (keyLen {key_len} vs "
                     f"recordLen {rec_len})")
             key = f.read(key_len)
+            if keys_only:  # label walks skip the pixel payload entirely
+                f.seek(rec_len - key_len, os.SEEK_CUR)
+                yield _read_text(io.BytesIO(key)), None
+                continue
             value = f.read(rec_len - key_len)
             # both are Text: strip the vint length prefixes
             yield (_read_text(io.BytesIO(key)),
                    _read_text(io.BytesIO(value)))
+
+
+def read_seq_file(path: str) -> Iterator[Tuple[bytes, bytes]]:
+    """Yield raw (key, value) payloads (Text vint headers stripped)."""
+    return _iter_records(path, keys_only=False)
+
+
+def iter_seq_keys(path: str) -> Iterator[bytes]:
+    """Key-only walk: seeks past every value, so counting/label scans never
+    pull the pixel payload through Python."""
+    return (k for k, _ in _iter_records(path, keys_only=True))
 
 
 def _parse_label(key: bytes) -> float:
@@ -246,25 +260,18 @@ class SeqFileDataSet(StreamingRecordDataSet):
                 # the filter changes per-shard record counts, and the
                 # distributed equal-step cap (and size()) must see the
                 # FILTERED counts or ranks would take unequal step counts
-                # into the per-step collectives; a key walk decodes no
-                # pixels, only labels
+                # into the per-step collectives; the key-only walk seeks
+                # past every pixel payload
                 self._counts = [
-                    sum(1 for k, _v in read_seq_file(p)
+                    sum(1 for k in iter_seq_keys(p)
                         if _parse_label(k) <= self.class_num)
                     for p in self.paths]
         return self._counts
 
-    def data(self, train: bool):
-        order = self._order if train else np.arange(len(self.paths))
-        paths, cap = self._plan(order)
-        emitted = 0
-        for p in paths:
-            for rec in read_byte_records(p, self.class_num):
-                if cap is not None and emitted >= cap:
-                    return
-                emitted += 1
-                yield LabeledImage(rec["data"].astype(np.float32),
-                                   float(rec["label"]))
+    def _read_shard(self, path):
+        for rec in read_byte_records(path, self.class_num):
+            yield LabeledImage(rec["data"].astype(np.float32),
+                               float(rec["label"]))
 
 
 def seq_file_folder(folder: str, class_num: int = None,
