@@ -368,11 +368,17 @@ def _bench_flash(name, build, peak_flops):
     from bigdl_tpu.utils.timing import measure_step_seconds
 
     B, H, T, D = build()
+    # off-TPU (--platform cpu smoke) the kernel runs in interpret mode,
+    # which is Python-per-block slow — clamp the default long-sequence
+    # shape so a CPU run cannot grind for hours / trip the stall watchdog
+    interpret = jax.default_backend() != "tpu"
+    if interpret and B * H * T > 2 * 256:
+        B, H, T = 1, 2, min(T, 256)
+        _log(f"{name}: non-TPU backend, clamping interpret-mode shape to "
+             f"({B},{H},{T},{D})")
     q, k, v = (jax.random.normal(jax.random.key(i), (B, H, T, D),
                                  jnp.bfloat16) for i in range(3))
     flops = 3.5 * (4.0 * B * H * T * T * D) / 2.0  # causal fwd+bwd
-    # off-TPU (--platform cpu smoke) the kernel runs in interpret mode
-    interpret = jax.default_backend() != "tpu"
 
     def timed(use_pallas):
         def loss(q, k, v, tok):

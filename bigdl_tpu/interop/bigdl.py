@@ -86,15 +86,28 @@ def _build(obj: JavaObject):
     cls = obj.classname
     short = cls[len(_PKG):] if cls.startswith(_PKG) else cls
     f = obj.fields
-    if short == "Sequential":
-        seq = nn.Sequential()
+    if short in ("Sequential", "Concat", "ConcatTable"):
+        if short == "Sequential":
+            container = nn.Sequential()
+        elif short == "Concat":
+            # reference dimension is 1-based over NCHW: 2 = channels, which
+            # is the LAST axis in this framework's NHWC layout (the only
+            # concat axis the zoo models use — fail loud otherwise)
+            dim = int(f.get("dimension", 2))
+            if dim != 2:
+                raise ValueError(
+                    f"bigdl format: Concat over NCHW dim {dim} has no "
+                    "NHWC mapping here (only channel concat, dim=2)")
+            container = nn.Concat(-1)
+        else:
+            container = nn.ConcatTable()
         params, states = [], []
         for child in _children(obj):
             m, p, s = _build(child)
-            seq.add(m)
+            container.add(m)
             params.append(p)
             states.append(s)
-        return seq, params, states
+        return container, params, states
     if short == "Linear":
         m = nn.Linear(int(f["inputSize"]), int(f["outputSize"]),
                       with_bias=f.get("withBias", True))
@@ -148,6 +161,22 @@ def _build(obj: JavaObject):
     if short == "Reshape":
         size = [int(x) for x in np.asarray(f["size"].values)]
         return nn.Reshape(size), {}, {}
+    if short == "View":
+        sizes = [int(x) for x in np.asarray(f["sizes"].values)]
+        return nn.View(*sizes), {}, {}
+    if short == "CAddTable":
+        return nn.CAddTable(bool(f.get("inplace", False))), {}, {}
+    if short == "JoinTable":
+        dim = int(f.get("dimension", 2))
+        if dim != 2:
+            raise ValueError(f"bigdl format: JoinTable over NCHW dim {dim} "
+                             "has no NHWC mapping here (channel only)")
+        return nn.JoinTable(-1,
+                            int(f.get("nInputDims", 0))), {}, {}
+    if short == "SpatialZeroPadding":
+        return nn.SpatialZeroPadding(int(f["padLeft"]), int(f["padRight"]),
+                                     int(f["padTop"]),
+                                     int(f["padBottom"])), {}, {}
     if short == "ReLU":
         return nn.ReLU(), {}, {}
     if short == "Tanh":
@@ -245,7 +274,7 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         return JavaObject(cd, vals)
 
     t = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
-    if isinstance(m, nn.Sequential):
+    if isinstance(m, (nn.Sequential, nn.Concat, nn.ConcatTable)):
         kids = [_w_module(dc, c, p, s)
                 for c, p, s in zip(m.modules, params, state)]
         buf_cd = dc.get("scala.collection.mutable.ArrayBuffer",
@@ -254,9 +283,35 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         buf = JavaObject(buf_cd, {
             "initialSize": 16, "size0": len(kids),
             "array": JavaArray(dc.array("[Ljava.lang.Object;"), kids)})
-        cd = dc.get(_PKG + "Sequential",
-                    [("L", "modules", "Lscala/collection/mutable/ArrayBuffer;")])
+        buf_sig = "Lscala/collection/mutable/ArrayBuffer;"
+        if isinstance(m, nn.Concat):
+            if m.dimension not in (-1, 3):
+                raise ValueError("bigdl format save: only channel Concat "
+                                 "maps to the reference's NCHW dim 2")
+            cd = dc.get(_PKG + "Concat",
+                        [("I", "dimension", None), ("L", "modules", buf_sig)])
+            return JavaObject(cd, {"dimension": 2, "modules": buf})
+        short = type(m).__name__
+        cd = dc.get(_PKG + short, [("L", "modules", buf_sig)])
         return JavaObject(cd, {"modules": buf})
+    if isinstance(m, nn.CAddTable):
+        return obj("CAddTable", [("Z", "inplace", bool(m.inplace))], [])
+    if isinstance(m, nn.View):
+        return obj("View", [],
+                   [("sizes", "[I", JavaArray(
+                       dc.array("[I"), np.asarray(m.sizes, np.int32)))])
+    if isinstance(m, nn.JoinTable):
+        if m.dimension not in (-1, 3):
+            raise ValueError("bigdl format save: only channel JoinTable "
+                             "maps to the reference's NCHW dim 2")
+        return obj("JoinTable",
+                   [("I", "dimension", 2),
+                    ("I", "nInputDims", int(getattr(m, "n_input_dims", 0)))],
+                   [])
+    if isinstance(m, nn.SpatialZeroPadding):
+        return obj("SpatialZeroPadding",
+                   [("I", "padLeft", m.l), ("I", "padRight", m.r),
+                    ("I", "padTop", m.t), ("I", "padBottom", m.b)], [])
     if isinstance(m, nn.Linear):
         return obj("Linear",
                    [("I", "inputSize", m.input_size),

@@ -25,6 +25,9 @@ __all__ = ["CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
 class CAddTable(Module):
     def __init__(self, inplace: bool = False):
         super().__init__()
+        # functional arrays have no in-place add; kept for signature parity
+        # and wire-format fidelity (interop/bigdl.py echoes it back)
+        self.inplace = inplace
 
     def _apply(self, params, inputs):
         return functools.reduce(jnp.add, inputs)
@@ -62,6 +65,7 @@ class JoinTable(Module):
     def __init__(self, dimension: int, n_input_dims: int = 0):
         super().__init__()
         self.dimension = dimension
+        self.n_input_dims = n_input_dims
 
     def _apply(self, params, inputs):
         return jnp.concatenate(list(inputs), axis=self.dimension)

@@ -10,6 +10,10 @@ bench_error naming the stage (exit 1, carrying earlier per-config
 errors) when none did.
 """
 
+import pytest
+
+# sleep-driven watchdog integration: slow lane
+pytestmark = pytest.mark.slow
 import json
 import subprocess
 import sys

@@ -310,6 +310,7 @@ def test_caffe_lenet_roundtrip(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # full-model caffe roundtrip: slow lane
 def test_caffe_resnet_roundtrip(tmp_path):
     """ResNet-20/CIFAR: BatchNorm+Scale fold, ConcatTable->Eltwise residual
     branches, type-A shortcut (Concat + Power-as-MulConstant), pooling
@@ -332,6 +333,7 @@ def test_caffe_resnet_roundtrip(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # full-model caffe roundtrip: slow lane
 def test_caffe_inception_roundtrip(tmp_path):
     """Inception-v1 (no aux): LRN, ceil-mode pooling, Concat towers,
     Dropout, global 7x7 avgpool + classifier."""

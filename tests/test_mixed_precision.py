@@ -27,16 +27,21 @@ def _models():
     from bigdl_tpu.models.lenet import LeNet5
     from bigdl_tpu.models.resnet import ResNet
     from bigdl_tpu.models.transformer_lm import TransformerLM
+    # the two heavyweight sweeps carry the slow mark; lenet/lstm keep
+    # bf16-policy coverage in the default lane
     return [
         ("lenet", lambda: LeNet5(10), (4, 28, 28, 1), "img"),
-        ("resnet20", lambda: ResNet(20, class_num=10, dataset="cifar10"),
-         (2, 32, 32, 3), "img"),
+        pytest.param("resnet20",
+                     lambda: ResNet(20, class_num=10, dataset="cifar10"),
+                     (2, 32, 32, 3), "img", id="resnet20",
+                     marks=pytest.mark.slow),
         ("lstm", lambda: nn.Sequential(
             nn.Recurrent(nn.LSTM(8, 12)), nn.Select(1, -1),
             nn.Linear(12, 5), nn.LogSoftMax()), (4, 6, 8), "img"),
-        ("transformer", lambda: TransformerLM(
+        pytest.param("transformer", lambda: TransformerLM(
             vocab_size=50, max_len=8, d_model=16, num_heads=2,
-            num_layers=1), (2, 8), "tok"),
+            num_layers=1), (2, 8), "tok", id="transformer",
+            marks=pytest.mark.slow),
     ]
 
 

@@ -284,6 +284,7 @@ def test_plateau_trigger_latches_after_firing():
     assert t({"val_loss": 0.1, "val_obs": 3})   # latched even on improvement
 
 
+@pytest.mark.slow  # CLI smoke via subprocess-scale work: slow lane
 def test_cli_transformer_synthetic_smoke():
     """Train CLI drives the transformer LM workload (token-spec synthetic
     data, TimeDistributedCriterion, per-token Top1 validation)."""

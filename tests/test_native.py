@@ -155,6 +155,7 @@ def test_reduce_sum_f32():
     np.testing.assert_array_equal(one, bufs[0])
 
 
+@pytest.mark.slow  # writes+scans a multi-GB record: slow lane
 def test_truncated_large_length_record(tmp_path):
     # A header that claims an 8 GB payload but passes its own CRC must yield
     # a catchable IOError, not a bad_alloc abort through the FFI.
