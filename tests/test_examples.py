@@ -81,3 +81,9 @@ def test_fine_tuning_example(tmp_path):
          "--weights", str(tmp_path / "w.bin")])
     assert frozen               # scale_w=0 froze the feature extractor
     assert acc > 0.9            # head alone adapts to the permuted labels
+
+
+def test_migrate_from_bigdl_example():
+    from examples import migrate_from_bigdl
+    acc = migrate_from_bigdl.main(["--epochs", "4"])
+    assert acc > 0.9, acc
