@@ -33,12 +33,31 @@ __all__ = ["load", "save"]
 _PKG = "com.intel.analytics.bigdl.nn."
 _TENSOR = "com.intel.analytics.bigdl.tensor.DenseTensor"
 _STORAGE = "com.intel.analytics.bigdl.tensor.ArrayStorage"
-# SerialVersionUIDs from the reference source (@SerialVersionUID annotations)
+# SerialVersionUIDs from the reference source (@SerialVersionUID
+# annotations) — a JVM ObjectInputStream validates these on read, so every
+# class the writer emits carries its real value
 _SUID = {
     _TENSOR: 5876322619614900645,
     _PKG + "Sequential": 5375403296928513267,
     _PKG + "Linear": 359656776803598943,
     _PKG + "ReLU": 1208478077576570643,
+    _PKG + "SpatialConvolution": -8446523046224797382,
+    _PKG + "SpatialMaxPooling": 2277597677473874749,
+    _PKG + "SpatialAveragePooling": 4533142511857387857,
+    _PKG + "BatchNormalization": -3181824540272906068,
+    _PKG + "SpatialBatchNormalization": -9106336963903528047,
+    _PKG + "Reshape": -830146931795053244,
+    _PKG + "View": 1238814703013238333,
+    _PKG + "Dropout": -4636332259181125718,
+    _PKG + "Identity": -8429221694319933625,
+    _PKG + "Tanh": 9062199894710333035,
+    _PKG + "Sigmoid": 6855417348268610044,
+    _PKG + "LogSoftMax": -2954501946670913825,
+    _PKG + "Concat": -5218461876031660707,
+    _PKG + "ConcatTable": -704681653938468956,
+    _PKG + "JoinTable": -8435694717504118735,
+    _PKG + "CAddTable": 7959261460060075605,
+    _PKG + "SpatialZeroPadding": -5144173515559923276,
 }
 
 
@@ -349,14 +368,18 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
                      if m.affine else None),
                     ("runningMean", t, _w_tensor(dc, state["running_mean"])),
                     ("runningVar", t, _w_tensor(dc, state["running_var"]))])
-    if isinstance(m, nn.SpatialMaxPooling):
+    if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
         kh, kw = m.kernel
         sh, sw = m.stride
         ph, pw = m.pad
-        return obj("SpatialMaxPooling",
+        short = ("SpatialMaxPooling" if isinstance(m, nn.SpatialMaxPooling)
+                 else "SpatialAveragePooling")
+        return obj(short,
                    [("I", "kW", kw), ("I", "kH", kh), ("I", "dW", sw),
                     ("I", "dH", sh), ("I", "padW", pw), ("I", "padH", ph)],
                    [])
+    if isinstance(m, nn.Dropout):
+        return obj("Dropout", [("D", "initP", float(m.p))], [])
     if isinstance(m, nn.Reshape):
         return obj("Reshape", [],
                    [("size", "[I", JavaArray(
