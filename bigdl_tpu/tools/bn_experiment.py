@@ -37,8 +37,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.config import get_int
+
 PEAK = 197e12  # v5e table peak; see utils/timing.measure_roofline
-BATCH = 256
+# BIGDL_TPU_BN_BATCH overrides (the round-3 "MFU falls as batch grows"
+# anomaly — 256:0.333, 512:0.317, 1024:0.273 — needs per-variant batch
+# sweeps to localize; bench.py's step is identical, only stats vary)
+BATCH = get_int("BN_BATCH", 256)
 
 
 _PRISTINE_APPLY = None  # BatchNormalization.apply before any variant patch

@@ -20,6 +20,11 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_DEVICE_TIMEOUT  | (net-new: Engine.init device-discovery watchdog, seconds) | 0 (off) |
 | BIGDL_TPU_RNN_HOIST_MAX_ELEMENTS | (net-new: ConvLSTM hoist cap) | 2^28 |
 | BIGDL_TPU_XLA_CACHE / _DIR | (net-new: persistent compile cache) | 1 / ~/.cache/bigdl_tpu/xla |
+| BIGDL_TPU_CONV_PAD_MIN_CIN | (net-new: tiny-channel conv pad, nn/conv.py) | 8 |
+| BIGDL_TPU_BN_IMPL / _FUSED_VJP / _STAT_ROWS | (net-new: BN variants, nn/normalization.py) | off |
+| BIGDL_TPU_BN_BATCH | (net-new: bn_experiment batch) | 256 |
+| BIGDL_TPU_BENCH_REMAT / _FLASH_SHAPE | (net-new: bench knobs) | off |
+| BIGDL_TPU_TEST_INSTALLED | (net-new: suite resolves installed wheel) | off |
 """
 
 from __future__ import annotations

@@ -31,7 +31,15 @@ import sys
 # pip-installed bigdl_tpu from outside the repo
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+    if os.environ.get("BIGDL_TPU_TEST_INSTALLED"):
+        # packaging validation: append so the pip-installed wheel in
+        # site-packages wins for bigdl_tpu — an inserted repo root would
+        # silently shadow the wheel and test the source tree instead
+        sys.path.append(_REPO_ROOT)
+    else:
+        # dev default: the SOURCE tree must win even when some stale wheel
+        # happens to be installed, or edits would go silently untested
+        sys.path.insert(0, _REPO_ROOT)
 
 import pytest  # noqa: E402
 
