@@ -30,16 +30,25 @@ import sys
 # sibling repo content) stays importable when the suite runs against a
 # pip-installed bigdl_tpu from outside the repo
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-    if os.environ.get("BIGDL_TPU_TEST_INSTALLED"):
-        # packaging validation: append so the pip-installed wheel in
-        # site-packages wins for bigdl_tpu — an inserted repo root would
-        # silently shadow the wheel and test the source tree instead
-        sys.path.append(_REPO_ROOT)
-    else:
-        # dev default: the SOURCE tree must win even when some stale wheel
-        # happens to be installed, or edits would go silently untested
-        sys.path.insert(0, _REPO_ROOT)
+if os.environ.get("BIGDL_TPU_TEST_INSTALLED"):
+    # packaging validation: the pip-installed wheel in site-packages must
+    # win for bigdl_tpu — strip any repo-root entries (python -m pytest
+    # from the repo puts one at sys.path[0]) and append instead, then
+    # PROVE the import really came from outside the source tree; a silent
+    # source-tree pass would validate nothing
+    sys.path = [p for p in sys.path
+                if os.path.abspath(p or os.getcwd()) != _REPO_ROOT]
+    sys.path.append(_REPO_ROOT)
+    import bigdl_tpu  # noqa: E402
+
+    _origin = os.path.abspath(bigdl_tpu.__file__)
+    assert not _origin.startswith(_REPO_ROOT + os.sep), (
+        "BIGDL_TPU_TEST_INSTALLED=1 but bigdl_tpu resolved from the source "
+        f"tree ({_origin}); install the wheel and run from outside the repo")
+elif _REPO_ROOT not in sys.path:
+    # dev default: the SOURCE tree must win even when some stale wheel
+    # happens to be installed, or edits would go silently untested
+    sys.path.insert(0, _REPO_ROOT)
 
 import pytest  # noqa: E402
 

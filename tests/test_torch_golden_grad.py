@@ -95,10 +95,20 @@ def test_linear_grads_match_torch():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_batchnorm_train_mode_grads_match_torch():
+@pytest.mark.parametrize("variant", ["baseline", "fused_vjp",
+                                     "pallas_interpret"])
+def test_batchnorm_train_mode_grads_match_torch(variant, monkeypatch):
     """Backward through the BATCH statistics — the exact program the
     resnet bench's BN-bandwidth analysis times (docs/benchmarking.md);
-    torch differentiates through mean/var the same way."""
+    torch differentiates through mean/var the same way.  Every
+    implementation variant (autodiff baseline, hand-written fused VJP,
+    Pallas kernel) must produce the SAME grads and running-stat updates —
+    identical numerics is the contract that lets the bench swap them
+    freely (nn/normalization.py)."""
+    if variant == "fused_vjp":
+        monkeypatch.setenv("BIGDL_TPU_BN_FUSED_VJP", "1")
+    elif variant == "pallas_interpret":
+        monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
     m = nn.SpatialBatchNormalization(6, eps=1e-5, momentum=0.1).build(rng())
     bn = torch.nn.BatchNorm2d(6, eps=1e-5, momentum=0.1)
     with torch.no_grad():
@@ -118,6 +128,12 @@ def test_batchnorm_train_mode_grads_match_torch():
                                rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(gp["bias"], bn.bias.grad.numpy(),
                                rtol=1e-3, atol=1e-4)
+    # running-stat EMA (torch-lineage unbiased-var convention) must match
+    _, new_state = m.apply(m.params, m.state, jnp.asarray(x), training=True)
+    np.testing.assert_allclose(np.asarray(new_state["running_mean"]),
+                               bn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["running_var"]),
+                               bn.running_var.numpy(), rtol=1e-4, atol=1e-5)
 
 
 def test_maxpool_grad_matches_torch():
