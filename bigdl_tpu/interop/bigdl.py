@@ -58,6 +58,9 @@ _SUID = {
     _PKG + "JoinTable": -8435694717504118735,
     _PKG + "CAddTable": 7959261460060075605,
     _PKG + "SpatialZeroPadding": -5144173515559923276,
+    _PKG + "SpatialCrossMapLRN": 3641570491004969703,
+    _PKG + "Threshold": 3953292249027271493,
+    _PKG + "Power": -6637789603381436472,
 }
 
 
@@ -196,6 +199,17 @@ def _build(obj: JavaObject):
         return nn.SpatialZeroPadding(int(f["padLeft"]), int(f["padRight"]),
                                      int(f["padTop"]),
                                      int(f["padBottom"])), {}, {}
+    if short == "SpatialCrossMapLRN":
+        return nn.SpatialCrossMapLRN(int(f.get("size", 5)),
+                                     float(f.get("alpha", 1.0)),
+                                     float(f.get("beta", 0.75)),
+                                     float(f.get("k", 1.0))), {}, {}
+    if short == "Threshold":
+        return nn.Threshold(float(f.get("threshold", 1e-6)),
+                            float(f.get("value", 0.0))), {}, {}
+    if short == "Power":
+        return nn.Power(float(f["power"]), float(f.get("scale", 1.0)),
+                        float(f.get("shift", 0.0))), {}, {}
     if short == "ReLU":
         return nn.ReLU(), {}, {}
     if short == "Tanh":
@@ -380,6 +394,21 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
                    [])
     if isinstance(m, nn.Dropout):
         return obj("Dropout", [("D", "initP", float(m.p))], [])
+    if isinstance(m, nn.SpatialCrossMapLRN):
+        return obj("SpatialCrossMapLRN",
+                   [("I", "size", m.size), ("D", "alpha", float(m.alpha)),
+                    ("D", "beta", float(m.beta)), ("D", "k", float(m.k))],
+                   [])
+    if isinstance(m, nn.Threshold):
+        return obj("Threshold",
+                   [("D", "threshold", float(m.th)),
+                    ("D", "value", float(m.v)),
+                    ("Z", "inPlace", False)], [])
+    if isinstance(m, nn.Power):
+        return obj("Power",
+                   [("D", "power", float(m.power)),
+                    ("D", "scale", float(m.scale)),
+                    ("D", "shift", float(m.shift))], [])
     if isinstance(m, nn.Reshape):
         return obj("Reshape", [],
                    [("size", "[I", JavaArray(

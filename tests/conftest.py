@@ -42,7 +42,11 @@ if os.environ.get("BIGDL_TPU_TEST_INSTALLED"):
     import bigdl_tpu  # noqa: E402
 
     _origin = os.path.abspath(bigdl_tpu.__file__)
-    assert not _origin.startswith(_REPO_ROOT + os.sep), (
+    # compare against the package SOURCE dir, not the whole repo root: an
+    # in-repo virtualenv (repo/.venv/.../site-packages) is a legitimate
+    # install location
+    assert not _origin.startswith(
+        os.path.join(_REPO_ROOT, "bigdl_tpu") + os.sep), (
         "BIGDL_TPU_TEST_INSTALLED=1 but bigdl_tpu resolved from the source "
         f"tree ({_origin}); install the wheel and run from outside the repo")
 elif _REPO_ROOT not in sys.path:

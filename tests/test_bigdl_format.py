@@ -132,6 +132,9 @@ def test_table_layers_roundtrip(tmp_path):
     m.add(nn.CAddTable(True))
     m.add(nn.Dropout(0.3))            # identity in eval mode
     m.add(nn.SpatialAveragePooling(2, 2, 2, 2))
+    m.add(nn.SpatialCrossMapLRN(3, 0.5, 0.7, 1.5))
+    m.add(nn.Threshold(0.1, -0.2))
+    m.add(nn.Power(2.0, 1.5, 0.25))
     m.build(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, 3))
     y0, _ = m.apply(m.params, m.state, x)
