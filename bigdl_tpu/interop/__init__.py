@@ -1,10 +1,13 @@
-"""Model interop: Caffe, TensorFlow, Torch7, and the native format.
+"""Model interop: Caffe, TensorFlow, Torch7, and both native formats.
 
 Reference: BigDL's `Module.load/loadTorch/loadCaffe/loadTF` entry points
 (nn/Module.scala:41-73) over utils/caffe/, utils/tf/, utils/TorchFile.scala.
-The native format here is the pickle-based save/load in utils/file_io.py
-(the reference's was JVM serialization, utils/File.scala)."""
+Native formats: this framework's pickle save/load (utils/file_io.py) AND
+the reference's own JVM object-stream format (interop/bigdl.py over the
+generic Java-serialization codec interop/javaser.py) — files written by
+actual BigDL load here, and vice versa for the supported layer set."""
 
+from .bigdl import load as load_bigdl, save as save_bigdl
 from .caffe import CaffeLoader, CaffePersister, load_caffe, save_caffe
 from .tensorflow import TensorflowLoader, TensorflowSaver, load_tf, save_tf
 from .torchfile import (load_t7, save_t7, T7Reader, T7Writer,
@@ -13,4 +16,5 @@ from .torchfile import (load_t7, save_t7, T7Reader, T7Writer,
 __all__ = ["CaffeLoader", "CaffePersister", "load_caffe", "save_caffe",
            "TensorflowLoader", "TensorflowSaver", "load_tf", "save_tf",
            "load_t7", "save_t7", "T7Reader", "T7Writer",
-           "load_torch_module", "save_torch_module"]
+           "load_torch_module", "save_torch_module",
+           "load_bigdl", "save_bigdl"]
