@@ -206,7 +206,8 @@ def _build(obj: JavaObject):
                                      float(f.get("k", 1.0))), {}, {}
     if short == "Threshold":
         return nn.Threshold(float(f.get("threshold", 1e-6)),
-                            float(f.get("value", 0.0))), {}, {}
+                            float(f.get("value", 0.0)),
+                            bool(f.get("inPlace", False))), {}, {}
     if short == "Power":
         return nn.Power(float(f["power"]), float(f.get("scale", 1.0)),
                         float(f.get("shift", 0.0))), {}, {}
@@ -403,7 +404,7 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         return obj("Threshold",
                    [("D", "threshold", float(m.th)),
                     ("D", "value", float(m.v)),
-                    ("Z", "inPlace", False)], [])
+                    ("Z", "inPlace", m.ip)], [])
     if isinstance(m, nn.Power):
         return obj("Power",
                    [("D", "power", float(m.power)),

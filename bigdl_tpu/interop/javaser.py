@@ -351,9 +351,18 @@ class JavaWriter:
         elif isinstance(v, JavaArray):
             self.write_array(v)
         elif isinstance(v, (bytes, bytearray)):
-            self._u1(TC_BLOCKDATA)
-            self._u1(len(v))
-            self.buf.write(bytes(v))
+            # blockdata: short frame when it fits, TC_BLOCKDATALONG above
+            # 255 bytes (ObjectOutputStream's own split; the reader accepts
+            # both).  Previously >255 crashed in bytes([len]).
+            v = bytes(v)
+            if len(v) <= 0xFF:
+                self._u1(TC_BLOCKDATA)
+                self._u1(len(v))
+                self.buf.write(v)
+            else:
+                self._u1(TC_BLOCKDATALONG)
+                self.buf.write(struct.pack(">i", len(v)))
+                self.buf.write(v)
         else:
             raise TypeError(f"cannot serialize {type(v).__name__}")
 

@@ -173,7 +173,10 @@ class Threshold(Module):
 
     def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
         super().__init__()
-        self.th, self.v = th, v
+        # ip is semantically a no-op here (functional framework), but it is
+        # part of the reference wire format — keep it so save/load through
+        # interop.bigdl round-trips the flag for JVM consumers
+        self.th, self.v, self.ip = th, v, bool(ip)
 
     def _apply(self, params, x):
         return jnp.where(x > self.th, x, self.v)

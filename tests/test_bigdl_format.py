@@ -303,3 +303,38 @@ def test_javaser_fuzz_roundtrip():
         w2 = JavaWriter()
         w2.write_object(back)
         assert w2.getvalue() == data, f"trial {trial}: bytes drifted"
+
+
+def test_blockdata_long_payload_roundtrip():
+    """writeObject annotation payloads >255 bytes must take the
+    TC_BLOCKDATALONG frame instead of crashing (round-4 advisor, low)."""
+    from bigdl_tpu.interop.javaser import JavaClassDesc, SC_WRITE_METHOD
+
+    cd = JavaClassDesc("com.example.Blob", 9, 2 | SC_WRITE_METHOD,
+                       [("I", "n", None)], None)
+    payload = bytes(range(256)) * 5  # 1280 bytes: needs the long frame
+    o = JavaObject(cd, {"n": 1})
+    o.annotations[cd.name] = [payload]
+    w = JavaWriter()
+    w.write_object(o)
+    data = w.getvalue()
+    assert b"\x7a\x00\x00\x05\x00" in data  # TC_BLOCKDATALONG + int32 len
+    [back] = loads(data)
+    assert bytes(back.annotations[cd.name][0]) == payload
+
+
+def test_threshold_inplace_flag_roundtrips(tmp_path):
+    """Threshold(ip=True) keeps its inPlace wire flag through save/load
+    (round-4 advisor, low)."""
+    m = nn.Sequential()
+    m.add(nn.Threshold(0.5, -1.0, ip=True))
+    m.build(jax.random.PRNGKey(0))
+    p = str(tmp_path / "th.bigdl")
+    bigdl_fmt.save(m, p)
+    m2 = bigdl_fmt.load(p)
+    assert m2.modules[0].ip is True
+    with open(p, "rb") as fh:
+        contents = load_stream(fh)
+    [root] = [c for c in contents if isinstance(c, JavaObject)]
+    th = root.fields["modules"].fields["array"].values[0]
+    assert th.fields["inPlace"] is True

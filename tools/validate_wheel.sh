@@ -1,0 +1,29 @@
+#!/bin/bash
+# Packaging-validation lane: build the wheel FROM SOURCE AT HEAD, install it
+# into a throwaway prefix, and run the fast suite against the installed copy
+# from OUTSIDE the repo (BIGDL_TPU_TEST_INSTALLED=1 makes conftest.py prove
+# the import origin).  Replaces the previously git-tracked dist/*.whl, which
+# rotted silently against the source tree (round-4 advisor, medium).
+#
+# Reference role: make-dist.sh assembling dist/lib + the release-pipeline
+# smoke run of the assembled artifact (SURVEY.md §1 row 11).
+#
+# Usage: bash tools/validate_wheel.sh [extra pytest args...]
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d /tmp/bigdl_tpu_wheel.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+
+echo "[wheel] building from source at $(git -C "$REPO" rev-parse --short HEAD)"
+# --no-build-isolation: the image forbids network installs; setuptools is local
+python -m pip wheel "$REPO" --no-deps --no-build-isolation -w "$WORK/dist" -q
+WHL="$(ls "$WORK"/dist/*.whl)"
+echo "[wheel] built $WHL"
+
+python -m pip install --no-deps -q --target "$WORK/site" "$WHL"
+
+cd "$WORK"  # run from OUTSIDE the repo so the source tree cannot win
+env PYTHONPATH="$WORK/site" BIGDL_TPU_TEST_INSTALLED=1 \
+    python -m pytest "$REPO/tests" -q -p no:cacheprovider \
+    -m "not slow" "$@"
+echo "[wheel] installed-copy suite PASSED"
