@@ -103,10 +103,14 @@ class BatchNormalization(Module):
 
     - BIGDL_TPU_BN_FUSED_VJP=1 — `_fused_bn_train`'s hand-written backward
       instead of autodiff; identical numerics, different pass structure.
-    - BIGDL_TPU_BN_IMPL=pallas — the fully fused Pallas kernel
-      (`ops/batchnorm.bn_train`: 2 reads + 1 write per direction, stats
-      resident in VMEM); `pallas_interpret` runs the same kernel in
-      interpret mode (CPU tests).
+    - BIGDL_TPU_BN_IMPL=pallas — the hand-scheduled Pallas kernels
+      (ops/batchnorm: 2 reads + 1 write per direction, stats resident in
+      VMEM).  Single device uses the fused two-phase kernel (`bn_train`);
+      on a mesh the layer wraps the per-shard stat kernels in `shard_map`
+      over the Engine data axis with psum'd per-channel stats
+      (`bn_train_sync`) — identical sync-BN semantics to the GSPMD
+      default.  `pallas_interpret` runs the kernels in interpret mode
+      (CPU tests); any non-TPU backend interprets automatically.
     - BIGDL_TPU_BN_STAT_ROWS=k — ghost-batch statistics: mean/var from the
       first k rows of the batch only (shuffled batches make this a random
       subsample), cutting the stat pass's HBM reads by N/k.  Normalization
@@ -141,16 +145,15 @@ class BatchNormalization(Module):
         axes = tuple(range(x.ndim - 1))
         if training:
             impl = config.get_str("BN_IMPL", "")
-            # pallas is single-device only: GSPMD cannot partition the opaque
-            # pallas_call, so under a multi-device jit it would all-gather
-            # every BN input — the opposite of the HBM optimization.  Tests
-            # (pallas_interpret) call apply outside jit and keep the route.
-            if (impl.startswith("pallas") and self.affine
-                    and self.sync_axis is None
-                    and (impl == "pallas_interpret"
-                         or jax.device_count() == 1)):
-                return self._apply_pallas(params, state, x, axes,
-                                          impl == "pallas_interpret")
+            if impl.startswith("pallas") and self.affine:
+                # GSPMD cannot partition the opaque pallas_call, so the
+                # multi-device routes split the kernel at the cross-chip
+                # reduction: per-shard Pallas stat kernels + psum of the
+                # per-channel vectors (ops/batchnorm.bn_train_sync) —
+                # identical sync-BN semantics to the default GSPMD path.
+                out = self._route_pallas(params, state, x, axes, impl)
+                if out is not None:
+                    return out
             stat_rows = config.get_int("BN_STAT_ROWS", 0)
             xs = x[:stat_rows] if 0 < stat_rows < x.shape[0] else x
             xf = xs.astype(jnp.float32)
@@ -197,6 +200,47 @@ class BatchNormalization(Module):
             + m * lax.stop_gradient(unbiased).astype(dt),
         }
 
+    def _route_pallas(self, params, state, x, axes, impl):
+        """Pick the Pallas BN route; None = no route applies (caller falls
+        through to the jnp paths)."""
+        backend = jax.default_backend()
+        # interpret mode: explicit request (tests) or the CPU backend (the
+        # CPU-mesh dryrun/conftest runs the same kernels simulated).  Other
+        # non-TPU backends (GPU) get the jnp path instead — silently
+        # simulating the kernels there would pessimize training under a
+        # flag whose whole point is performance.
+        if backend not in ("tpu", "cpu") and impl != "pallas_interpret":
+            return None
+        interpret = impl == "pallas_interpret" or backend == "cpu"
+        if self.sync_axis is not None:
+            # already inside a shard_map body (bigdl_tpu.parallel): reduce
+            # over the caller's axis with psum directly
+            return self._apply_pallas_sync(params, state, x,
+                                           self.sync_axis, interpret)
+        if impl == "pallas_interpret" or jax.device_count() == 1:
+            return self._apply_pallas(params, state, x, axes, interpret)
+        from ..utils.engine import Engine
+        mesh = Engine._mesh
+        if self.shardmap_route_engages(mesh, x.shape[0]):
+            return self._apply_pallas_shardmap(params, state, x, mesh,
+                                               interpret)
+        return None
+
+    @staticmethod
+    def shardmap_route_engages(mesh, batch_rows: int) -> bool:
+        """True when the kernel-in-shard_map route applies: a DATA-ONLY
+        mesh whose data axis divides the batch.  On a multi-axis (TP) mesh
+        the route's in_specs P('data', None, ...) would force the
+        activation replicated over every other axis — channel-sharded
+        activations would be all-gathered over 'model', worse than the jnp
+        path where GSPMD keeps stats channel-sharded with zero activation
+        traffic.  Shared with tools/bn_experiment's fail-loud guard so the
+        two cannot drift."""
+        from ..utils.engine import Engine
+        return (mesh is not None and Engine.DATA_AXIS in mesh.axis_names
+                and mesh.shape[Engine.DATA_AXIS] == mesh.size
+                and batch_rows % mesh.shape[Engine.DATA_AXIS] == 0)
+
     def _apply_pallas(self, params, state, x, axes, interpret):
         from ..ops.batchnorm import bn_train
         y, mean, var = bn_train(x, params["weight"], params["bias"],
@@ -204,6 +248,41 @@ class BatchNormalization(Module):
         n = 1
         for ax in axes:
             n *= x.shape[ax]
+        return y, self._ema_update(state, mean, var, n)
+
+    def _apply_pallas_sync(self, params, state, x, axis_name, interpret):
+        from ..ops.batchnorm import bn_train_sync
+        y, mean, var = bn_train_sync(x, params["weight"], params["bias"],
+                                     self.eps, axis_name, 1024, interpret)
+        n = 1
+        for d in x.shape[:-1]:
+            n *= d
+        n = n * lax.psum(1, axis_name)
+        return y, self._ema_update(state, mean, var, n)
+
+    def _apply_pallas_shardmap(self, params, state, x, mesh, interpret):
+        """Kernel-inside-shard_map sync-BN over the mesh data axis: the
+        per-shard stat kernels run on each chip's local rows; the only
+        cross-chip traffic is the psum of per-channel (sum, sumsq) /
+        (sum dy, sum dy*xhat) vectors — the same collective the GSPMD
+        lowering of the jnp path inserts."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.batchnorm import bn_train_sync
+        from ..utils.compat import shard_map_unchecked
+        from ..utils.engine import Engine
+
+        axis = Engine.DATA_AXIS
+        xspec = P(axis, *([None] * (x.ndim - 1)))
+        def body(xl, w, b):  # custom_vjp: nondiff args must be positional
+            return bn_train_sync(xl, w, b, self.eps, axis, 1024, interpret)
+        y, mean, var = shard_map_unchecked(
+            body, mesh=mesh, in_specs=(xspec, P(None), P(None)),
+            out_specs=(xspec, P(None), P(None)))(
+            x, params["weight"], params["bias"])
+        n = 1
+        for d in x.shape[:-1]:  # x is the global array here
+            n *= d
         return y, self._ema_update(state, mean, var, n)
 
     def _apply_fused(self, params, state, x, mean, var, axes):

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["bn_train", "bn_train_reference"]
+__all__ = ["bn_train", "bn_train_sync", "bn_train_reference"]
 
 _LANE = 128
 # Per-buffer byte budget for one (block_r, Cp) tile.  The backward streams
@@ -280,3 +280,203 @@ def _bn_train_bwd(eps, block_r, interpret, res, cotangents):
 
 
 bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD-composable sync-BN: per-shard stat kernels + psum
+# ---------------------------------------------------------------------------
+# The fused two-phase kernel above is single-device by construction: GSPMD
+# cannot see inside the opaque pallas_call, so under a multi-device jit it
+# would gather the whole batch onto every chip.  The mesh answer (round-4
+# verdict item 3) splits the kernel at exactly the point where the cross-chip
+# reduction lives: a per-shard STAT kernel (one HBM read of the shard,
+# per-channel (sum, sumsq) resident in VMEM) + `lax.psum` of the tiny
+# per-channel vectors over the data axis + an elementwise normalize that XLA
+# fuses into one read + one write.  Same HBM traffic per direction as the
+# fused kernel (2 reads + 1 write), identical sync-BN semantics to the
+# default GSPMD path, usable inside `shard_map` (nn.BatchNormalization wires
+# it; reference per-replica stats: DistriOptimizer.scala:165-183).
+
+def _stat_kernel(x_ref, sum_ref, sumsq_ref, sum_scr, sumsq_scr, *,
+                 n_rows: int, block_r: int):
+    import jax.experimental.pallas as pl
+
+    r = pl.program_id(0)
+    nr = pl.num_programs(0)
+
+    @pl.when(r == 0)
+    def _init():
+        sum_scr[:] = jnp.zeros_like(sum_scr)
+        sumsq_scr[:] = jnp.zeros_like(sumsq_scr)
+
+    xb = x_ref[...].astype(jnp.float32)
+    if n_rows % block_r:
+        row = r * block_r + lax.broadcasted_iota(jnp.int32, xb.shape, 0)
+        xb = jnp.where(row < n_rows, xb, 0.0)
+    sum_scr[:] += jnp.sum(xb, axis=0, keepdims=True)
+    sumsq_scr[:] += jnp.sum(jnp.square(xb), axis=0, keepdims=True)
+
+    @pl.when(r == nr - 1)
+    def _emit():
+        sum_ref[...] = sum_scr[:]
+        sumsq_ref[...] = sumsq_scr[:]
+
+
+def _bn_stats_pallas(x2, *, block_r, interpret):
+    """One HBM pass over the shard: (sum[C], sumsq[C]) in f32."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = x2.shape
+    c_pad = (-C) % _LANE
+    Cp = C + c_pad
+    x2 = _pad_cols(x2, c_pad)
+    block_r = _pick_block_r(block_r, R, Cp, x2.dtype.itemsize)
+    r_pad = (-R) % block_r
+    if r_pad:
+        x2 = jnp.pad(x2, ((0, r_pad), (0, 0)))
+    kernel = functools.partial(_stat_kernel, n_rows=R, block_r=block_r)
+    vec = pl.BlockSpec((1, Cp), lambda r: (0, 0))
+    s, ss = pl.pallas_call(
+        kernel,
+        grid=((R + r_pad) // block_r,),
+        in_specs=[pl.BlockSpec((block_r, Cp), lambda r: (r, 0))],
+        out_specs=[vec, vec],
+        out_shape=[jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+                   jax.ShapeDtypeStruct((1, Cp), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, Cp), jnp.float32) for _ in range(2)],
+        interpret=interpret,
+    )(x2)
+    return s[0, :C], ss[0, :C]
+
+
+def _grad_stat_kernel(x_ref, dy_ref, mean_ref, inv_ref, sdy_ref, sdyx_ref,
+                      sdy_scr, sdyx_scr, *, n_rows: int, block_r: int):
+    import jax.experimental.pallas as pl
+
+    r = pl.program_id(0)
+    nr = pl.num_programs(0)
+
+    @pl.when(r == 0)
+    def _init():
+        sdy_scr[:] = jnp.zeros_like(sdy_scr)
+        sdyx_scr[:] = jnp.zeros_like(sdyx_scr)
+
+    xb = x_ref[...].astype(jnp.float32)
+    dyb = dy_ref[...].astype(jnp.float32)
+    if n_rows % block_r:
+        row = r * block_r + lax.broadcasted_iota(jnp.int32, xb.shape, 0)
+        dyb = jnp.where(row < n_rows, dyb, 0.0)
+    xhat = (xb - mean_ref[...]) * inv_ref[...]
+    sdy_scr[:] += jnp.sum(dyb, axis=0, keepdims=True)
+    sdyx_scr[:] += jnp.sum(dyb * xhat, axis=0, keepdims=True)
+
+    @pl.when(r == nr - 1)
+    def _emit():
+        sdy_ref[...] = sdy_scr[:]
+        sdyx_ref[...] = sdyx_scr[:]
+
+
+def _bn_grad_stats_pallas(x2, dy2, mean, inv, *, block_r, interpret):
+    """One fused HBM pass over (x, dy): (sum dy, sum dy*xhat) in f32."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = x2.shape
+    c_pad = (-C) % _LANE
+    Cp = C + c_pad
+    x2 = _pad_cols(x2, c_pad)
+    dy2 = _pad_cols(dy2, c_pad)
+    mean = _pad_cols(mean, c_pad)
+    inv = _pad_cols(inv, c_pad)
+    block_r = _pick_block_r(block_r, R, Cp, x2.dtype.itemsize)
+    r_pad = (-R) % block_r
+    if r_pad:
+        x2 = jnp.pad(x2, ((0, r_pad), (0, 0)))
+        dy2 = jnp.pad(dy2, ((0, r_pad), (0, 0)))
+    kernel = functools.partial(_grad_stat_kernel, n_rows=R, block_r=block_r)
+    vec = pl.BlockSpec((1, Cp), lambda r: (0, 0))
+    blk = pl.BlockSpec((block_r, Cp), lambda r: (r, 0))
+    sdy, sdyx = pl.pallas_call(
+        kernel,
+        grid=((R + r_pad) // block_r,),
+        in_specs=[blk, blk, vec, vec],
+        out_specs=[vec, vec],
+        out_shape=[jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+                   jax.ShapeDtypeStruct((1, Cp), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, Cp), jnp.float32) for _ in range(2)],
+        interpret=interpret,
+    )(x2, dy2, mean[None], inv[None])
+    return sdy[0, :C], sdyx[0, :C]
+
+
+def _global_n(n_local, axis_name):
+    return n_local if axis_name is None else n_local * lax.psum(1, axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def bn_train_sync(x, weight, bias, eps, axis_name=None, block_r=1024,
+                  interpret=False):
+    """Training-mode sync-BN for `shard_map` bodies: (y, mean, var).
+
+    Statistics are reduced over the local shard by the Pallas stat kernel,
+    then over `axis_name` by `lax.psum` — identical global-batch semantics
+    to the default GSPMD lowering, with the stat passes hand-scheduled.
+    With axis_name=None this is a single-device alternative to `bn_train`
+    whose normalize/dx passes are left to XLA fusion.
+    """
+    out, _ = _bn_sync_fwd_impl(x, weight, bias, eps, axis_name, block_r,
+                               interpret)
+    return out
+
+
+def _bn_sync_fwd_impl(x, weight, bias, eps, axis_name, block_r, interpret):
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    s, ss = _bn_stats_pallas(x2, block_r=block_r, interpret=interpret)
+    if axis_name is not None:
+        s = lax.psum(s, axis_name)
+        ss = lax.psum(ss, axis_name)
+    n = _global_n(x2.shape[0], axis_name)
+    mean = s / n
+    var = ss / n - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    scale = weight.astype(jnp.float32) * inv
+    shift = bias.astype(jnp.float32) - mean * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return (y, mean, var), (x, mean, inv, weight)
+
+
+def _bn_sync_fwd(x, weight, bias, eps, axis_name, block_r, interpret):
+    return _bn_sync_fwd_impl(x, weight, bias, eps, axis_name, block_r,
+                             interpret)
+
+
+def _bn_sync_bwd(eps, axis_name, block_r, interpret, res, cotangents):
+    x, mean, inv, weight = res
+    dy, _, _ = cotangents  # stat cotangents ignored (see bn_train docstring)
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    dy2 = dy.reshape(-1, shape[-1])
+    sdy_local, sdyx_local = _bn_grad_stats_pallas(
+        x2, dy2, mean, inv, block_r=block_r, interpret=interpret)
+    if axis_name is not None:
+        sdy = lax.psum(sdy_local, axis_name)
+        sdyx = lax.psum(sdyx_local, axis_name)
+    else:
+        sdy, sdyx = sdy_local, sdyx_local
+    n = _global_n(x2.shape[0], axis_name)
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    scale = (weight.astype(jnp.float32) * inv).astype(x.dtype)
+    dx = scale * (dy
+                  - (sdy / n).astype(x.dtype)
+                  - xhat.astype(x.dtype) * (sdyx / n).astype(x.dtype))
+    # dw/db are the LOCAL shard sums: w and b enter the shard_map body
+    # replicated, and transposing a replicated input is itself a psum over
+    # shards — returning the global sums here would double-count by the
+    # shard count.  (With axis_name=None local == global.)
+    return (dx, sdyx_local.astype(weight.dtype),
+            sdy_local.astype(weight.dtype))
+
+
+bn_train_sync.defvjp(_bn_sync_fwd, _bn_sync_bwd)

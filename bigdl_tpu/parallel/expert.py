@@ -35,10 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from ..utils.compat import shard_map
 
 from ..common import get_policy
 from ..nn.module import Module

@@ -28,10 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from ..utils.compat import shard_map
 
 __all__ = ["ring_attention", "ulysses_attention"]
 

@@ -61,17 +61,27 @@ def _variant_apply(kind):
         os.environ["BIGDL_TPU_BN_FUSED_VJP"] = "1"
         return _PRISTINE_APPLY
     if kind == "pallas":
-        # the fully fused Pallas kernel (ops/batchnorm.bn_train).
-        # BatchNormalization only routes to it on a single device
-        # (normalization.py: GSPMD cannot partition the opaque call) — fail
-        # loud rather than silently benchmark the baseline under this label
+        # the Pallas BN kernels (ops/batchnorm).  Single device routes to
+        # the fused two-phase kernel; multi-device routes through the
+        # shard_map+psum sync path IF a data-only Engine mesh exists and
+        # the batch divides over it — otherwise the library would silently
+        # benchmark the baseline under this label, so fail loud.
         import jax
 
-        if jax.device_count() != 1:
-            raise RuntimeError(
-                f"pallas BN variant needs exactly 1 device (have "
-                f"{jax.device_count()}): the library would fall back to "
-                "the baseline path and mislabel the measurement")
+        if jax.device_count() > 1:
+            from ..utils.engine import Engine
+
+            if Engine._mesh is None:
+                Engine.init()  # data-only mesh over all visible devices
+            mesh = Engine.mesh()
+            from ..nn.normalization import BatchNormalization as _BN
+
+            if not _BN.shardmap_route_engages(mesh, BATCH):
+                raise RuntimeError(
+                    f"pallas BN variant needs a data-only mesh dividing "
+                    f"batch {BATCH} (mesh: {dict(mesh.shape)}): the "
+                    "library would fall back to the baseline path and "
+                    "mislabel the measurement")
         os.environ["BIGDL_TPU_BN_IMPL"] = "pallas"
         return _PRISTINE_APPLY
     if kind.startswith("stat") and kind[len("stat"):].isdigit():

@@ -1,0 +1,28 @@
+"""Version-compat shims shared across the package.
+
+`shard_map` moved from jax.experimental to the jax namespace, and its
+replication-check kwarg was renamed check_rep -> check_vma along the way;
+this is the one place that knows both spellings (previously copy-pasted
+per module).
+"""
+
+import inspect
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+_CHECK_KW = ("check_vma" if "check_vma" in
+             inspect.signature(shard_map).parameters else "check_rep")
+
+
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """shard_map with the replication/VMA check disabled, under whichever
+    keyword this jax version spells it (custom_vjp + psum bodies trip the
+    checker on some versions)."""
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{_CHECK_KW: False})
+
+
+__all__ = ["shard_map", "shard_map_unchecked"]
