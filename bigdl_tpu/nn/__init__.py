@@ -54,3 +54,4 @@ from .criterion import (
     SmoothL1CriterionWithWeights, SoftMarginCriterion, SoftmaxWithCriterion,
     TimeDistributedCriterion)
 from .attention import MultiHeadAttention
+from .fused import ConvBN, fuse_conv_bn

@@ -1,0 +1,105 @@
+"""Cross-layer fusion rewrites (opt-in).
+
+`ConvBN` fuses an adjacent (1x1 stride-1 SpatialConvolution,
+SpatialBatchNormalization) pair so the BN batch statistics are accumulated
+in the producing matmul's epilogue (ops/convbn.py), deleting the separate
+stat read of the conv output — the round-4 verdict's untried HBM lever for
+the BN-bound ResNet-50 train MFU.
+
+The reference performs analogous whole-graph rewrites for its quantized
+path (bigdl/nn/Module.scala `quantize()`, replacing Conv/Linear with
+quantized twins in place); here the rewrite is `fuse_conv_bn(container)`,
+walking containers and substituting `ConvBN(conv, bn)` for eligible pairs.
+Run it BEFORE `build()`/loading: the fusion nests the pair's two param
+entries one level deeper, so param trees built before the rewrite do not
+line up.
+
+ConvBN subclasses Sequential, so its params/state are exactly the pair's
+[conv, bn] list entries and every container facility (get_parameters,
+checkpoint traversal, repr) works unchanged.  When the fused path cannot
+engage (eval mode, multi-device GSPMD, GPU backend, non-affine BN) it
+falls back to the children's own apply — numerics are identical either
+way (parity-tested in tests/test_convbn.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import config
+from .containers import Sequential
+from .conv import SpatialConvolution
+from .module import Container
+from .normalization import SpatialBatchNormalization
+
+__all__ = ["ConvBN", "fuse_conv_bn"]
+
+
+def _fusable(conv, bn) -> bool:
+    return (isinstance(conv, SpatialConvolution)
+            and type(conv) is SpatialConvolution  # not Map/Share subclasses
+            and isinstance(bn, SpatialBatchNormalization)
+            and conv.kernel == (1, 1) and conv.stride == (1, 1)
+            and conv.pad == (0, 0) and conv.n_group == 1
+            and bn.affine and bn.sync_axis is None
+            and conv.n_output_plane == bn.n_output)
+
+
+class ConvBN(Sequential):
+    """Fused 1x1-conv + training-mode BN (see module docstring)."""
+
+    def __init__(self, conv: SpatialConvolution,
+                 bn: SpatialBatchNormalization):
+        assert _fusable(conv, bn), (conv, bn)
+        super().__init__(conv, bn)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        conv, bn = self.modules
+        backend = jax.default_backend()
+        # engagement mirrors BatchNormalization._route_pallas: the fused
+        # pallas_call is opaque to GSPMD, so multi-device jits fall back to
+        # the children (where the BN layer applies its own mesh routing);
+        # BN_IMPL=pallas_interpret is the tests' escape hatch on the
+        # multi-device CPU conftest backend
+        interpret_req = config.get_str("BN_IMPL", "") == "pallas_interpret"
+        if not training or not (
+                interpret_req
+                or (backend in ("tpu", "cpu") and jax.device_count() == 1)):
+            return super().apply(params, state, x, training=training,
+                                 rng=rng)
+        from ..ops.convbn import fused_conv_bn_train
+
+        conv_p, bn_p = params
+        n, h, w_, k = x.shape
+        x2 = x.reshape(n * h * w_, k)
+        w2 = conv_p["weight"].reshape(k, conv.n_output_plane)
+        z2, mean, var = fused_conv_bn_train(
+            x2, w2, conv_p.get("bias"), bn_p["weight"], bn_p["bias"],
+            bn.eps, interpret_req or backend == "cpu")
+        z = z2.reshape(n, h, w_, conv.n_output_plane)
+        new_bn_state = bn._ema_update(state[1], mean, var, x2.shape[0])
+        return z, [state[0], new_bn_state]
+
+
+def fuse_conv_bn(module):
+    """Recursively replace eligible adjacent (conv, bn) pairs inside every
+    container with ConvBN.  Mutates and returns `module`; run before
+    build()/load (the rewrite re-nests the pair's param entries)."""
+    if isinstance(module, ConvBN):
+        return module
+    if isinstance(module, Container):
+        kids = module.modules
+        if isinstance(module, Sequential):
+            fused, i = [], 0
+            while i < len(kids):
+                if i + 1 < len(kids) and _fusable(kids[i], kids[i + 1]):
+                    fused.append(ConvBN(kids[i], kids[i + 1]))
+                    i += 2
+                else:
+                    fused.append(fuse_conv_bn(kids[i]))
+                    i += 1
+            module.modules = fused
+        else:
+            module.modules = [fuse_conv_bn(m) for m in kids]
+    return module

@@ -1,0 +1,217 @@
+"""Conv→BN stat fusion: BN batch statistics accumulated in the producing
+matmul's epilogue (round-4 verdict item 2's untried lever).
+
+The BN HBM-traffic decomposition (docs/benchmarking.md) charges training-mode
+BN four x-sized HBM passes; the first — re-reading the conv output just to
+reduce (sum, sumsq) — is deletable without changing semantics IF the stats
+are accumulated while the producing op still holds each output tile in VMEM.
+XLA cannot fuse a cross-tile reduction into its convolution library call, but
+a 1x1 stride-1 convolution over NHWC is exactly a matmul over the flattened
+(N*H*W, C_in) rows — and ResNet-50's bottleneck blocks are dominated by 1x1
+convs (reference models/resnet/ResNet.scala:208-230) — so this kernel is a
+blocked MXU matmul whose epilogue, at the last K step of each tile, adds the
+tile's per-channel (sum, sum of squares) into VMEM scratch:
+
+    y = x @ w (+ bias);  sum_c = Σ_r y;  sumsq_c = Σ_r y²   — one y-write
+    and ZERO extra passes for stats (x streams once per C block, the same
+    operand re-read every blocked matmul pays; see tile-size note below).
+
+`fused_conv_bn_train` wraps it into the full BN-after-conv forward with a
+hand-written VJP (grad-stat pass via ops.batchnorm._bn_grad_stats_pallas,
+then two XLA matmuls for dx/dw).  The conv-bias gradient is identically zero
+through a following BN (a pre-BN bias shifts the mean only), so it is
+returned as zeros — the same reason torch disables conv bias before BN.
+
+Wired in by `nn.fused.ConvBN` / `nn.fuse_conv_bn` (opt-in rewrite);
+raced against the other BN variants by `bigdl_tpu.tools.bn_experiment
+conv_epilogue`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .batchnorm import _bn_grad_stats_pallas, _pad_cols, _LANE
+
+__all__ = ["matmul_stats", "matmul_stats_reference", "fused_conv_bn_train"]
+
+# MXU-friendly tile sizes.  The C block is wide (1024) because every
+# x-row tile must be re-streamed once per OUTPUT-channel block (each (r,k)
+# tile feeds every c) — matmul blocking re-reads one operand no matter the
+# grid order, exactly as XLA's own conv tiling does.  At 1024, all of
+# ResNet-50's 1x1 convs with C_out <= 1024 stream x once and the C=2048
+# pair twice; the *saving* of this kernel vs unfused conv+BN is the deleted
+# y-sized stat pass, net of whatever the tiling loses to XLA's (the chip
+# race decides).  VMEM at the defaults: f32 acc 256x1024 = 1 MiB, w tile
+# 512x1024 bf16 = 1 MiB, x tile 256x512 bf16 = 256 KiB, y out 512 KiB —
+# double-buffered ≈ 5.5 MiB of the ~16 MiB budget.
+_BLOCK_R, _BLOCK_K, _BLOCK_C = 256, 512, 1024
+
+
+def matmul_stats_reference(x2, w2, bias=None):
+    """jnp oracle: y = x2 @ w2 (+bias); per-channel f32 (sum, sumsq) of y."""
+    yf = jnp.dot(x2.astype(jnp.float32), w2.astype(jnp.float32))
+    if bias is not None:
+        yf = yf + bias.astype(jnp.float32)
+    return (yf.astype(x2.dtype), jnp.sum(yf, axis=0),
+            jnp.sum(jnp.square(yf), axis=0))
+
+
+def _mm_stats_kernel(x_ref, w_ref, b_ref, y_ref, sum_ref, sumsq_ref,
+                     acc_scr, sum_scr, sumsq_scr, *,
+                     n_rows: int, block_r: int):
+    import jax.experimental.pallas as pl
+
+    c = pl.program_id(0)
+    r = pl.program_id(1)
+    k = pl.program_id(2)
+    nr = pl.num_programs(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _zero_acc():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when((r == 0) & (k == 0))
+    def _zero_stats():
+        sum_scr[:] = jnp.zeros_like(sum_scr)
+        sumsq_scr[:] = jnp.zeros_like(sumsq_scr)
+
+    acc_scr[:] += jnp.dot(x_ref[...], w_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        yf = acc_scr[:] + b_ref[...]           # f32 [block_r, block_c]
+        y_ref[...] = yf.astype(y_ref.dtype)
+        if n_rows % block_r:                   # mask the padded row tail:
+            row = r * block_r + lax.broadcasted_iota(  # pad rows emit bias
+                jnp.int32, yf.shape, 0)               # which must not enter
+            yf = jnp.where(row < n_rows, yf, 0.0)     # the statistics
+        sum_scr[:] += jnp.sum(yf, axis=0, keepdims=True)
+        sumsq_scr[:] += jnp.sum(jnp.square(yf), axis=0, keepdims=True)
+
+    @pl.when((r == nr - 1) & (k == nk - 1))
+    def _emit():
+        sum_ref[...] = sum_scr[:]
+        sumsq_ref[...] = sumsq_scr[:]
+
+
+def matmul_stats(x2, w2, bias=None, *, interpret=False):
+    """y = x2[R,K] @ w2[K,C] (+bias[C]) with per-channel (sum, sumsq) of y
+    accumulated in the matmul epilogue — one write of y, no separate stat
+    pass (x streams ceil(C/_BLOCK_C) times, as blocked matmuls do)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, K = x2.shape
+    K2, C = w2.shape
+    assert K == K2, (x2.shape, w2.shape)
+    b = (jnp.zeros((C,), jnp.float32) if bias is None
+         else bias.astype(jnp.float32))
+
+    block_r = min(_BLOCK_R, max(8, R))
+    block_r = max(8, (block_r // 8) * 8)
+    block_k = min(_BLOCK_K, K + (-K) % _LANE)
+    block_c = min(_BLOCK_C, C + (-C) % _LANE)
+    r_pad, k_pad = (-R) % block_r, (-K) % block_k
+    c_pad = (-C) % block_c
+    if r_pad or k_pad:
+        x2 = jnp.pad(x2, ((0, r_pad), (0, k_pad)))
+    if k_pad or c_pad:
+        w2 = jnp.pad(w2, ((0, k_pad), (0, c_pad)))
+    b = _pad_cols(b, c_pad)
+    Rp, Kp, Cp = R + r_pad, K + k_pad, C + c_pad
+
+    grid = (Cp // block_c, Rp // block_r, Kp // block_k)
+    kernel = functools.partial(_mm_stats_kernel, n_rows=R, block_r=block_r)
+    y, s, ss = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_r, block_k), lambda c, r, k: (r, k)),
+            pl.BlockSpec((block_k, block_c), lambda c, r, k: (k, c)),
+            pl.BlockSpec((1, block_c), lambda c, r, k: (0, c)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, block_c), lambda c, r, k: (r, c)),
+            pl.BlockSpec((1, block_c), lambda c, r, k: (0, c)),
+            pl.BlockSpec((1, block_c), lambda c, r, k: (0, c)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Rp, Cp), x2.dtype),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_r, block_c), jnp.float32),
+            pltpu.VMEM((1, block_c), jnp.float32),
+            pltpu.VMEM((1, block_c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w2, b[None])
+    return y[:R, :C], s[0, :C], ss[0, :C]
+
+
+# ---------------------------------------------------------------------------
+# fused conv(1x1) + training-mode BN with hand-written VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_conv_bn_train(x2, w2, bias, gamma, beta, eps, interpret=False):
+    """z = BN_train(x2 @ w2 (+bias)) over rows; returns (z, mean, var).
+
+    Stats come from the matmul epilogue (no separate stat pass).  mean/var
+    are the biased f32 batch statistics for the caller's running EMA and
+    are non-differentiable outputs (cotangents ignored), like
+    ops.batchnorm.bn_train.
+    """
+    out, _ = _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret)
+    return out
+
+
+def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret):
+    y, s, ss = matmul_stats(x2, w2, bias, interpret=interpret)
+    n = x2.shape[0]
+    mean = s / n
+    var = ss / n - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    z = y * scale.astype(y.dtype) + shift.astype(y.dtype)
+    return (z, mean, var), (x2, w2, y, mean, inv, gamma,
+                            bias is not None)
+
+
+def _fused_fwd(x2, w2, bias, gamma, beta, eps, interpret):
+    return _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret)
+
+
+def _fused_bwd(eps, interpret, res, cotangents):
+    x2, w2, y, mean, inv, gamma, has_bias = res
+    dz, _, _ = cotangents  # stat cotangents ignored
+    n = y.shape[0]
+    # grad-stat pass over (y, dz) — the same fused Pallas reduction the
+    # standalone BN backward uses
+    sdy, sdyx = _bn_grad_stats_pallas(y, dz, mean, inv,
+                                      block_r=1024, interpret=interpret)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    scale = (gamma.astype(jnp.float32) * inv).astype(y.dtype)
+    dy = scale * (dz
+                  - (sdy / n).astype(y.dtype)
+                  - xhat.astype(y.dtype) * (sdyx / n).astype(y.dtype))
+    # conv backward: two MXU matmuls (XLA)
+    dx = jnp.dot(dy, w2.T)
+    dw = jnp.dot(x2.T.astype(dy.dtype), dy).astype(w2.dtype)
+    # d(bias) through a following BN is identically zero: a pre-BN bias
+    # shift moves the mean by the same amount and cancels in (y - mean)
+    dbias = jnp.zeros_like(mean).astype(w2.dtype) if has_bias else None
+    return (dx.astype(x2.dtype), dw, dbias,
+            sdyx.astype(gamma.dtype), sdy.astype(gamma.dtype))
+
+
+fused_conv_bn_train.defvjp(_fused_fwd, _fused_bwd)

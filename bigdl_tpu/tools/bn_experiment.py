@@ -26,6 +26,10 @@ Variants:
                2 reads + 1 write per direction, stats resident in VMEM
   stat<k>    — ghost-batch statistics from the first k rows only
                (BIGDL_TPU_BN_STAT_ROWS=k), e.g. stat64
+  conv_epilogue — nn.fuse_conv_bn model rewrite: BN stats accumulated in
+               the producing 1x1 conv's matmul epilogue (ops/convbn.py),
+               deleting the separate stat read; non-1x1 convs' BNs run
+               the baseline path
   <any>_remat — the above combined with the conv_out remat policy
 """
 
@@ -83,6 +87,19 @@ def _variant_apply(kind):
                     "library would fall back to the baseline path and "
                     "mislabel the measurement")
         os.environ["BIGDL_TPU_BN_IMPL"] = "pallas"
+        return _PRISTINE_APPLY
+    if kind == "conv_epilogue":
+        # model-level rewrite (bench_variant applies nn.fuse_conv_bn before
+        # build); the BN class itself stays pristine.  ConvBN only engages
+        # its fused kernel single-device — fail loud rather than silently
+        # benchmark the unfused fallback under this label.
+        import jax
+
+        if jax.device_count() != 1:
+            raise RuntimeError(
+                f"conv_epilogue needs exactly 1 device (have "
+                f"{jax.device_count()}): ConvBN would fall back to the "
+                "unfused path and mislabel the measurement")
         return _PRISTINE_APPLY
     if kind.startswith("stat") and kind[len("stat"):].isdigit():
         # ghost-batch statistics from the first k rows (BN_STAT_ROWS)
@@ -147,8 +164,11 @@ def bench_variant(kind: str) -> None:
     BatchNormalization.apply = _variant_apply(base)
     set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
     from ..models.resnet import ResNet
-    model = ResNet(50, class_num=1000,
-                   dataset="imagenet").build(jax.random.key(0))
+    model = ResNet(50, class_num=1000, dataset="imagenet")
+    if base == "conv_epilogue":
+        from ..nn import fuse_conv_bn
+        fuse_conv_bn(model)  # before build: the rewrite re-nests params
+    model.build(jax.random.key(0))
     crit = CrossEntropyCriterion()
     x = jnp.zeros((BATCH, 224, 224, 3), jnp.float32)
     y = jnp.ones((BATCH,), jnp.int32)
@@ -181,7 +201,8 @@ def main(argv=None):
                                            "custom_vjp", "remat_conv",
                                            "vjp_remat", "pallas",
                                            "pallas_remat", "stat64",
-                                           "stat64_remat"]:
+                                           "stat64_remat", "conv_epilogue",
+                                           "conv_epilogue_remat"]:
         try:
             bench_variant(kind)
         except Exception as e:  # noqa: BLE001 — report and continue

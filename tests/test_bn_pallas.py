@@ -93,8 +93,8 @@ def test_sync_kernel_shardmap_parity():
     """bn_train_sync inside shard_map over 8 shards == global-batch oracle,
     forward and grads (dw/db must NOT double-count the shard psum)."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
     from bigdl_tpu.ops.batchnorm import bn_train_sync
+    from bigdl_tpu.utils.compat import shard_map_unchecked
 
     x = _rand((32, 6, 5), 0) * 2 + 1
     w = 1.0 + 0.1 * _rand((5,), 1)
@@ -105,8 +105,9 @@ def test_sync_kernel_shardmap_parity():
     def body(xl, w, b):
         return bn_train_sync(xl, w, b, EPS, "data", 1024, True)
 
-    f = shard_map(body, mesh=mesh, in_specs=(xs, P(None), P(None)),
-                  out_specs=(xs, P(None), P(None)), check_vma=False)
+    f = shard_map_unchecked(body, mesh=mesh,
+                            in_specs=(xs, P(None), P(None)),
+                            out_specs=(xs, P(None), P(None)))
     y, mean, var = jax.jit(f)(x, w, b)
     yr, mr, vr = bn_train_reference(x, w, b, EPS)
     np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
@@ -172,8 +173,8 @@ def test_module_sync_axis_pallas(monkeypatch):
     """sync_axis= + BN_IMPL=pallas: the kernel runs per shard inside the
     caller's shard_map and psums stats over the named axis."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
     from bigdl_tpu.nn import BatchNormalization
+    from bigdl_tpu.utils.compat import shard_map_unchecked
 
     bn = BatchNormalization(10, sync_axis="data")
     params, state = bn.init(jax.random.PRNGKey(0))
@@ -186,10 +187,10 @@ def test_module_sync_axis_pallas(monkeypatch):
         return y, ns
 
     monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas")
-    y1, s1 = jax.jit(shard_map(
+    y1, s1 = jax.jit(shard_map_unchecked(
         body, mesh=mesh,
         in_specs=(xs, P(None), P(None)),
-        out_specs=(xs, P(None)), check_vma=False))(x, params, state)
+        out_specs=(xs, P(None))))(x, params, state)
     monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
     # oracle: plain global-batch BN (sync semantics == global batch)
     bn0 = BatchNormalization(10)

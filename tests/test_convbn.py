@@ -1,0 +1,176 @@
+"""Conv-epilogue BN stat fusion (ops/convbn.py + nn.fused) parity tests.
+
+The fused path must be numerically identical to the unfused conv→BN
+composition — it deletes an HBM pass, not semantics (round-4 verdict #2's
+untried lever; reference nn/SpatialBatchNormalization.scala semantics).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.fused import ConvBN, fuse_conv_bn
+from bigdl_tpu.ops.convbn import (fused_conv_bn_train, matmul_stats,
+                                  matmul_stats_reference)
+from bigdl_tpu.ops.batchnorm import bn_train_reference
+
+EPS = 1e-5
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape).astype(dtype)
+
+
+@pytest.mark.parametrize("R,K,C,bias", [
+    (64, 16, 24, False),     # everything unaligned to the 128 lane
+    (100, 128, 128, True),   # ragged rows (pad rows must not enter stats)
+    (256, 96, 130, True),    # C just past one lane
+])
+def test_matmul_stats_parity(R, K, C, bias):
+    x = _rand((R, K), 0)
+    w = _rand((K, C), 1) * 0.1
+    b = _rand((C,), 2) if bias else None
+    y, s, ss = matmul_stats(x, w, b, interpret=True)
+    yr, sr, ssr = matmul_stats_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_fused_train_forward_and_grad_parity():
+    R, K, C = 96, 32, 48
+    x = _rand((R, K), 3)
+    w = _rand((K, C), 4) * 0.2
+    gamma = 1.0 + 0.1 * _rand((C,), 5)
+    beta = 0.1 * _rand((C,), 6)
+
+    z, mean, var = fused_conv_bn_train(x, w, None, gamma, beta, EPS, True)
+    y_ref = jnp.dot(x, w)
+    z_ref, m_ref, v_ref = bn_train_reference(y_ref, gamma, beta, EPS)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(m_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(v_ref),
+                               rtol=1e-5, atol=1e-5)
+
+    t = _rand((R, C), 7)
+
+    def loss_fused(x, w, gamma, beta):
+        z, _, _ = fused_conv_bn_train(x, w, None, gamma, beta, EPS, True)
+        return jnp.sum((z - t) ** 2)
+
+    def loss_ref(x, w, gamma, beta):
+        z, _, _ = bn_train_reference(jnp.dot(x, w), gamma, beta, EPS)
+        return jnp.sum((z - t) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    for a, b_, name in zip(gf, gr, ("dx", "dw", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+def test_conv_bias_grad_is_zero_through_bn():
+    """A pre-BN bias shifts the mean only, so its gradient is exactly 0 —
+    the fused backward returns zeros rather than burning a reduction."""
+    R, K, C = 40, 8, 16
+    x, w = _rand((R, K), 8), _rand((K, C), 9)
+    b = _rand((C,), 10)
+    gamma, beta = jnp.ones((C,)), jnp.zeros((C,))
+
+    def loss(b):
+        z, _, _ = fused_conv_bn_train(x, w, b, gamma, beta, EPS, True)
+        return jnp.sum(jnp.sin(z))
+
+    db = jax.grad(loss)(b)
+    np.testing.assert_allclose(np.asarray(db), 0.0, atol=1e-12)
+    # and the autodiff oracle agrees it is (numerically) zero
+    def loss_ref(b):
+        z, _, _ = bn_train_reference(jnp.dot(x, w) + b, gamma, beta, EPS)
+        return jnp.sum(jnp.sin(z))
+    db_ref = jax.grad(loss_ref)(b)
+    np.testing.assert_allclose(np.asarray(db_ref), 0.0, atol=1e-3)
+
+
+def _regroup(params, model):
+    """Regroup an unfused Sequential's param/state list to the fused
+    model's structure (pairs nested one level deeper)."""
+    out, i = [], 0
+    for m in model.modules:
+        if isinstance(m, ConvBN):
+            out.append([params[i], params[i + 1]])
+            i += 2
+        else:
+            out.append(params[i])
+            i += 1
+    return out
+
+
+def test_module_fusion_parity(monkeypatch):
+    """fuse_conv_bn rewrite: identical training forward + EMA state to the
+    unfused model, on the same parameter values."""
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(8, 16, 1, 1, with_bias=False))
+    m.add(nn.SpatialBatchNormalization(16))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialConvolution(16, 16, 3, 3, pad_w=1, pad_h=1))  # not 1x1
+    m.add(nn.SpatialBatchNormalization(16))
+    m.build(jax.random.PRNGKey(0))
+    x = _rand((4, 6, 6, 8), 11)
+    y0, s0 = m.apply(m.params, m.state, x, training=True)
+
+    params, state = m.params, m.state
+    fuse_conv_bn(m)
+    assert isinstance(m.modules[0], ConvBN)          # the 1x1 pair fused
+    assert isinstance(m.modules[2], nn.SpatialConvolution)  # 3x3 untouched
+    fp, fs = _regroup(params, m), _regroup(state, m)
+
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    y1, s1 = m.apply(fp, fs, x, training=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-4)
+    flat0 = jax.tree.leaves(s0)
+    flat1 = jax.tree.leaves(s1)
+    assert len(flat0) == len(flat1)
+    for a, b in zip(flat1, flat0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+    # grads through the fused module match the unfused model
+    t = _rand(y0.shape, 12)
+
+    def loss_fused(fp):
+        y, _ = m.apply(fp, fs, x, training=True)
+        return jnp.mean((y - t) ** 2)
+
+    g1 = jax.grad(loss_fused)(fp)
+    monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+    g1_fallback = jax.grad(loss_fused)(fp)  # unfused fallback, same tree
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g1_fallback)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_resnet50_rewrite_fuses_bottleneck_convs():
+    """ResNet-50's bottleneck 1x1 convs (2 per block x 16 blocks) fuse; the
+    3x3/7x7/strided-shortcut convs stay unfused."""
+    from bigdl_tpu.models.resnet import ResNet
+
+    model = ResNet(50, class_num=10, dataset="imagenet")
+    fuse_conv_bn(model)
+
+    def count(m):
+        if isinstance(m, ConvBN):
+            return 1
+        if isinstance(m, nn.Sequential) or hasattr(m, "modules"):
+            return sum(count(c) for c in getattr(m, "modules", []))
+        return 0
+
+    n = count(model)
+    assert n >= 32, f"expected >=32 fused pairs in ResNet-50, got {n}"
