@@ -371,7 +371,8 @@ def _bench_flash(name, build, peak_flops):
     # off-TPU (--platform cpu smoke) the kernel runs in interpret mode,
     # which is Python-per-block slow — clamp the default long-sequence
     # shape so a CPU run cannot grind for hours / trip the stall watchdog
-    interpret = jax.default_backend() != "tpu"
+    from bigdl_tpu.utils.platform import backend_kind
+    interpret = backend_kind() != "tpu"  # plugin may register as 'axon'
     if interpret and B * H * T > 2 * 256:
         B, H, T = 1, 2, min(T, 256)
         _log(f"{name}: non-TPU backend, clamping interpret-mode shape to "

@@ -61,6 +61,26 @@ _SUID = {
     _PKG + "SpatialCrossMapLRN": 3641570491004969703,
     _PKG + "Threshold": 3953292249027271493,
     _PKG + "Power": -6637789603381436472,
+    # sequence/embedding zoo (round-4 verdict #4)
+    _PKG + "Graph": -2896121321564992779,
+    _PKG + "Input": -8525406230282608924,
+    "com.intel.analytics.bigdl.utils.Node": -6021651923538325999,
+    _PKG + "LookupTable": -4832171200145114633,
+    _PKG + "LSTM": -8176191554025511686,
+    _PKG + "GRU": 6717988395573528459,
+    _PKG + "ParallelTable": -1197848941394786045,
+    _PKG + "NarrowTable": 8046335768231475724,
+    _PKG + "SelectTable": 8787233248773612598,
+    _PKG + "FlattenTable": 7620301574431959449,
+    _PKG + "CMulTable": 8888147326550637025,
+    _PKG + "Narrow": 988790441682879293,
+    _PKG + "MulConstant": -8747642888169310696,
+    _PKG + "AddConstant": -1572711921601326233,
+    # Recurrent / RnnCell / TimeDistributed / TemporalConvolution carry no
+    # @SerialVersionUID annotation in the reference source; the JVM
+    # computes a structural default (a SHA-1 over the compiled class's
+    # members) that cannot be derived without a JVM — they fall back to
+    # _DescCache's default of 1.
 }
 
 
@@ -223,6 +243,10 @@ def _build(obj: JavaObject):
         return nn.Dropout(float(f.get("initP", 0.5))), {}, {}
     if short == "Identity":
         return nn.Identity(), {}, {}
+    from . import bigdl_seq
+    built = bigdl_seq.build_seq(short, obj, _build)
+    if built is not None:
+        return built
     raise ValueError(
         f"bigdl format: unsupported layer class {cls} — extend "
         "interop/bigdl._build (fail-loud, like the TensorFlow importer)")
@@ -325,7 +349,10 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
             cd = dc.get(_PKG + "Concat",
                         [("I", "dimension", None), ("L", "modules", buf_sig)])
             return JavaObject(cd, {"dimension": 2, "modules": buf})
-        short = type(m).__name__
+        # fused subclasses (nn.ConvBN) are a TPU-local optimization, not a
+        # reference class: serialize as the plain Sequential they subclass
+        short = ("Sequential" if isinstance(m, nn.Sequential)
+                 else type(m).__name__)
         cd = dc.get(_PKG + short, [("L", "modules", buf_sig)])
         return JavaObject(cd, {"modules": buf})
     if isinstance(m, nn.CAddTable):
@@ -419,6 +446,10 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
     for pycls, short in simple.items():
         if isinstance(m, pycls):
             return obj(short, [], [])
+    from . import bigdl_seq
+    written = bigdl_seq.write_seq(dc, m, params, state, _w_module)
+    if written is not None:
+        return written
     raise ValueError(f"bigdl format save: unsupported layer "
                      f"{type(m).__name__}")
 

@@ -56,27 +56,31 @@ class ConvBN(Sequential):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         conv, bn = self.modules
-        backend = jax.default_backend()
+        from ..utils.platform import backend_kind
+        backend = backend_kind()  # resolves TPU plugin names like 'axon'
         # engagement mirrors BatchNormalization._route_pallas: the fused
         # pallas_call is opaque to GSPMD, so multi-device jits fall back to
-        # the children (where the BN layer applies its own mesh routing);
-        # BN_IMPL=pallas_interpret is the tests' escape hatch on the
-        # multi-device CPU conftest backend
+        # the children (where the BN layer applies its own mesh routing).
+        # Off-TPU the kernels would run in interpret mode — orders of
+        # magnitude slower — so that needs the explicit
+        # BN_IMPL=pallas_interpret opt-in (tests/CPU smoke), never silence.
         interpret_req = config.get_str("BN_IMPL", "") == "pallas_interpret"
         if not training or not (
                 interpret_req
-                or (backend in ("tpu", "cpu") and jax.device_count() == 1)):
+                or (backend == "tpu" and jax.device_count() == 1)):
             return super().apply(params, state, x, training=training,
                                  rng=rng)
+        from ..common import get_policy
         from ..ops.convbn import fused_conv_bn_train
 
         conv_p, bn_p = params
         n, h, w_, k = x.shape
-        x2 = x.reshape(n * h * w_, k)
-        w2 = conv_p["weight"].reshape(k, conv.n_output_plane)
+        c = get_policy().compute_dtype  # same cast the unfused conv makes
+        x2 = x.reshape(n * h * w_, k).astype(c)
+        w2 = conv_p["weight"].reshape(k, conv.n_output_plane).astype(c)
         z2, mean, var = fused_conv_bn_train(
             x2, w2, conv_p.get("bias"), bn_p["weight"], bn_p["bias"],
-            bn.eps, interpret_req or backend == "cpu")
+            bn.eps, interpret_req or backend != "tpu")
         z = z2.reshape(n, h, w_, conv.n_output_plane)
         new_bn_state = bn._ema_update(state[1], mean, var, x2.shape[0])
         return z, [state[0], new_bn_state]
@@ -86,6 +90,15 @@ def fuse_conv_bn(module):
     """Recursively replace eligible adjacent (conv, bn) pairs inside every
     container with ConvBN.  Mutates and returns `module`; run before
     build()/load (the rewrite re-nests the pair's param entries)."""
+    if getattr(module, "params", None) is not None:
+        raise ValueError(
+            "fuse_conv_bn must run BEFORE build()/load: the rewrite "
+            "re-nests the fused pairs' param entries, so an already-built "
+            "param tree would no longer line up with the modules")
+    return _fuse(module)
+
+
+def _fuse(module):
     if isinstance(module, ConvBN):
         return module
     if isinstance(module, Container):
@@ -97,9 +110,9 @@ def fuse_conv_bn(module):
                     fused.append(ConvBN(kids[i], kids[i + 1]))
                     i += 2
                 else:
-                    fused.append(fuse_conv_bn(kids[i]))
+                    fused.append(_fuse(kids[i]))
                     i += 1
             module.modules = fused
         else:
-            module.modules = [fuse_conv_bn(m) for m in kids]
+            module.modules = [_fuse(m) for m in kids]
     return module

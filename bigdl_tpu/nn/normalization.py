@@ -203,7 +203,8 @@ class BatchNormalization(Module):
     def _route_pallas(self, params, state, x, axes, impl):
         """Pick the Pallas BN route; None = no route applies (caller falls
         through to the jnp paths)."""
-        backend = jax.default_backend()
+        from ..utils.platform import backend_kind
+        backend = backend_kind()  # resolves TPU plugin names like 'axon'
         # interpret mode: explicit request (tests) or the CPU backend (the
         # CPU-mesh dryrun/conftest runs the same kernels simulated).  Other
         # non-TPU backends (GPU) get the jnp path instead — silently

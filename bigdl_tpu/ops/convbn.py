@@ -175,7 +175,13 @@ def fused_conv_bn_train(x2, w2, bias, gamma, beta, eps, interpret=False):
 
 
 def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     y, s, ss = matmul_stats(x2, w2, bias, interpret=interpret)
+    # same remat tag the unfused conv applies (nn/conv.py), so the
+    # save_only_these_names("conv_out") policy keeps the matmul output and
+    # the backward's grad-stat pass doesn't re-run the whole MXU matmul
+    y = checkpoint_name(y, "conv_out")
     n = x2.shape[0]
     mean = s / n
     var = ss / n - jnp.square(mean)

@@ -95,11 +95,14 @@ def _variant_apply(kind):
         # benchmark the unfused fallback under this label.
         import jax
 
-        if jax.device_count() != 1:
+        from ..utils.platform import backend_kind
+
+        if jax.device_count() != 1 or backend_kind() != "tpu":
             raise RuntimeError(
-                f"conv_epilogue needs exactly 1 device (have "
-                f"{jax.device_count()}): ConvBN would fall back to the "
-                "unfused path and mislabel the measurement")
+                f"conv_epilogue needs exactly 1 TPU device (have "
+                f"{jax.device_count()} x {backend_kind()}): ConvBN would "
+                "fall back to the unfused path and mislabel the "
+                "measurement")
         return _PRISTINE_APPLY
     if kind.startswith("stat") and kind[len("stat"):].isdigit():
         # ghost-batch statistics from the first k rows (BN_STAT_ROWS)

@@ -76,3 +76,24 @@ def force_cpu(n_devices: Optional[int] = None) -> bool:
         return True
     except RuntimeError:
         return False  # backend already initialized — use as-is
+
+
+def backend_kind() -> str:
+    """The active backend, with TPU plugin names resolved: 'tpu', 'cpu',
+    or the raw platform name for anything else.
+
+    The tunneled plugin on this image registers as 'tpu', but other
+    builds expose the plugin name (e.g. 'axon') while device_kind stays
+    'TPU ...' — gate TPU-only code paths (Pallas kernels) on this, never
+    on `jax.default_backend() == "tpu"` alone (see timing.is_tpu_like).
+    """
+    import jax
+
+    from .timing import is_tpu_like
+
+    b = jax.default_backend()
+    if b == "cpu":
+        return "cpu"
+    if b == "tpu" or any(is_tpu_like(d) for d in jax.local_devices()):
+        return "tpu"
+    return b

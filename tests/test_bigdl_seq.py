@@ -1,0 +1,259 @@
+"""BigDL native-format interop for the sequence/embedding zoo.
+
+Two layers of evidence:
+- READER fidelity: streams are hand-assembled in reference STRUCTURE
+  (nn/RNN.scala:46-80, nn/LSTM.scala:74-184, nn/GRU.scala:79-180) from raw
+  reference-layout weights, and the loaded model's forward is compared
+  against the reference cell EQUATIONS computed independently in numpy —
+  the reader cannot be validated by the writer here (circularity).
+- ROUNDTRIP: save(load(x)) / load(save(m)) parity for every new class,
+  including the SimpleRNN shape (models/rnn/SimpleRNN.scala:29-31) and a
+  Graph DAG, plus a fine-tune step on the migrated model.
+"""
+
+import io
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop import bigdl as bigdl_fmt
+from bigdl_tpu.interop.bigdl import _DescCache, _w_tensor, load_bytes
+from bigdl_tpu.interop.bigdl_seq import _obj, _buffer, _container, _seq, \
+    _time_distributed, _linear, _simple, _hiddens_shape
+from bigdl_tpu.interop.javaser import JavaWriter
+
+_PKG = "com.intel.analytics.bigdl.nn."
+
+
+def _rand(shape, seed):
+    return np.asarray(
+        jax.random.normal(jax.random.PRNGKey(seed), shape), np.float32)
+
+
+def _stream_bytes(root):
+    w = JavaWriter()
+    w.write_object(root)
+    return w.getvalue()
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------------------
+# reader vs the reference equations (hand-built streams)
+# ---------------------------------------------------------------------------
+
+def test_reader_rnncell_matches_reference_equations():
+    I, H, B, T = 3, 4, 2, 5
+    wi, bi = _rand((H, I), 0) * 0.3, _rand((H,), 1) * 0.1
+    wh, bh = _rand((H, H), 2) * 0.3, _rand((H,), 3) * 0.1
+    dc = _DescCache()
+    pre = _time_distributed(dc, _linear(dc, wi, bi))
+    h2h = _linear(dc, wh, bh)
+    pt = _obj(dc, "ParallelTable", [], [])  # structure placeholder
+    cell_seq = _seq(dc, pt, _obj(dc, "CAddTable", [], []),
+                    _simple(dc, "Tanh"),
+                    _simple(dc, "Identity"))
+    topo = _obj(dc, "RnnCell", [],
+                [("hiddensShape", "[I", _hiddens_shape(dc, [H])),
+                 ("h2h", "Lx;", h2h), ("cell", "Lx;", cell_seq)])
+    rec = _container(dc, "Recurrent", [pre, topo])
+    model = load_bytes(_stream_bytes(rec))
+
+    x = _rand((B, T, I), 4)
+    y, _ = model.apply(model.params, model.state, jnp.asarray(x))
+    # reference recurrence: h_t = tanh(Wi x_t + bi + Wh h_{t-1} + bh)
+    h = np.zeros((B, H), np.float32)
+    expect = []
+    for t in range(T):
+        h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+        expect.append(h)
+    np.testing.assert_allclose(np.asarray(y), np.stack(expect, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_reader_lstm_matches_reference_equations():
+    """Gate chunk order on the wire is [input, gain(tanh), forget, output]
+    (LSTM.scala:124-133); the reader must permute into ours."""
+    I, H, B, T = 3, 4, 2, 4
+    wi, bi = _rand((4 * H, I), 0) * 0.3, _rand((4 * H,), 1) * 0.1
+    wh = _rand((4 * H, H), 2) * 0.3
+    dc = _DescCache()
+    pre = _time_distributed(dc, _linear(dc, wi, bi))
+    cell_seq = _seq(dc, _linear(dc, wh, None))  # h2g, found by subtree scan
+    topo = _obj(dc, "LSTM",
+                [("I", "inputSize", I), ("I", "hiddenSize", H),
+                 ("D", "p", 0.0)],
+                [("hiddensShape", "[I", _hiddens_shape(dc, [H, H])),
+                 ("cell", "Lx;", cell_seq)])
+    rec = _container(dc, "Recurrent", [pre, topo])
+    model = load_bytes(_stream_bytes(rec))
+
+    x = _rand((B, T, I), 3)
+    y, _ = model.apply(model.params, model.state, jnp.asarray(x))
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    expect = []
+    for t in range(T):
+        pre_t = x[:, t] @ wi.T + bi + h @ wh.T
+        ig = _sigmoid(pre_t[:, 0:H])            # input
+        g = np.tanh(pre_t[:, H:2 * H])          # gain ("hidden")
+        fg = _sigmoid(pre_t[:, 2 * H:3 * H])    # forget
+        og = _sigmoid(pre_t[:, 3 * H:4 * H])    # output
+        c = ig * g + fg * c
+        h = og * np.tanh(c)
+        expect.append(h)
+    np.testing.assert_allclose(np.asarray(y), np.stack(expect, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_reader_gru_matches_reference_equations():
+    """Reference combination h' = (1-z)*cand + z*h (GRU.scala:155-172);
+    ours is h' = (1-u)*h + u*cand with u = 1-z, so the z weights must be
+    negated on the way in — exact, not approximate."""
+    I, O, B, T = 3, 4, 2, 4
+    wi, bi = _rand((3 * O, I), 0) * 0.3, _rand((3 * O,), 1) * 0.1
+    wh2g = _rand((2 * O, O), 2) * 0.3
+    whh = _rand((O, O), 3) * 0.3
+    dc = _DescCache()
+    pre = _time_distributed(dc, _linear(dc, wi, bi))
+    cell_seq = _seq(dc, _linear(dc, wh2g, None), _linear(dc, whh, None))
+    topo = _obj(dc, "GRU",
+                [("I", "inputSize", I), ("I", "outputSize", O),
+                 ("D", "p", 0.0)],
+                [("hiddensShape", "[I", _hiddens_shape(dc, [O])),
+                 ("cell", "Lx;", cell_seq)])
+    rec = _container(dc, "Recurrent", [pre, topo])
+    model = load_bytes(_stream_bytes(rec))
+
+    x = _rand((B, T, I), 4)
+    y, _ = model.apply(model.params, model.state, jnp.asarray(x))
+    h = np.zeros((B, O), np.float32)
+    expect = []
+    for t in range(T):
+        xt = x[:, t]
+        r = _sigmoid(xt @ wi[:O].T + bi[:O] + h @ wh2g[:O].T)
+        z = _sigmoid(xt @ wi[O:2 * O].T + bi[O:2 * O] + h @ wh2g[O:].T)
+        cand = np.tanh(xt @ wi[2 * O:].T + bi[2 * O:] + (r * h) @ whh.T)
+        h = (1 - z) * cand + z * h
+        expect.append(h)
+    np.testing.assert_allclose(np.asarray(y), np.stack(expect, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# roundtrips
+# ---------------------------------------------------------------------------
+
+def _roundtrip(m, x, tmp_path, rtol=1e-4, atol=1e-5):
+    m.build(jax.random.PRNGKey(0))
+    y0, _ = m.apply(m.params, m.state, x)
+    p = str(tmp_path / "model.bigdl")
+    bigdl_fmt.save(m, p)
+    m2 = bigdl_fmt.load(p)
+    y1, _ = m2.apply(m2.params, m2.state, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=rtol, atol=atol)
+    # and a second generation: save(load(x)) is stable
+    p2 = str(tmp_path / "model2.bigdl")
+    bigdl_fmt.save(m2, p2)
+    m3 = bigdl_fmt.load(p2)
+    y2, _ = m3.apply(m3.params, m3.state, x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                               rtol=1e-6, atol=1e-6)
+    return m2
+
+
+@pytest.mark.parametrize("cell_ctor", [
+    lambda: nn.RnnCell(6, 8),
+    lambda: nn.LSTM(6, 8),
+    lambda: nn.GRU(6, 8),
+])
+def test_recurrent_roundtrip(cell_ctor, tmp_path):
+    m = nn.Sequential()
+    m.add(nn.Recurrent(cell_ctor()))
+    m.add(nn.TimeDistributed(nn.Linear(8, 5)))
+    x = jnp.asarray(_rand((3, 7, 6), 11))
+    _roundtrip(m, x, tmp_path)
+
+
+def test_simple_rnn_migrates_and_fine_tunes(tmp_path):
+    """The SimpleRNN shape (models/rnn/SimpleRNN.scala:29-31): roundtrip
+    through the wire format, then fine-tune the migrated model and verify
+    the loss drops — the 'a reference user can keep training' contract."""
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    I, H, O = 10, 12, 4
+    m = nn.Sequential()
+    m.add(nn.Recurrent(nn.RnnCell(I, H, jnp.tanh)))
+    m.add(nn.TimeDistributed(nn.Linear(H, O)))
+    m.build(jax.random.PRNGKey(1))
+    p = str(tmp_path / "simple_rnn.bigdl")
+    bigdl_fmt.save(m, p)
+    model = bigdl_fmt.load(p)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 6, I).astype(np.float32)
+    ys = (rng.rand(64, 6) * O).astype(np.int32)
+    samples = [Sample(x, y) for x, y in zip(xs, ys)]
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(16, drop_last=True))
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+
+    def loss_of(mdl):
+        out, _ = mdl.apply(mdl.params, mdl.state, jnp.asarray(xs))
+        return float(crit.forward(out, jnp.asarray(ys)))
+
+    before = loss_of(model)
+    opt = (Optimizer(model, ds, crit)
+           .set_optim_method(SGD(learning_rate=0.05))
+           .set_end_when(Trigger.max_epoch(3)))
+    tuned = opt.optimize()
+    assert loss_of(tuned) < before
+
+
+def test_lookup_temporal_textclassifier_roundtrip(tmp_path):
+    """The text-classifier front half: embedding + temporal conv
+    (example/textclassification; nn/LookupTable.scala,
+    nn/TemporalConvolution.scala)."""
+    m = nn.Sequential()
+    m.add(nn.LookupTable(20, 8, one_based=True))
+    m.add(nn.TemporalConvolution(8, 6, 3))
+    m.add(nn.ReLU())
+    x = jnp.asarray(
+        np.random.RandomState(3).randint(1, 21, (2, 9)).astype(np.float32))
+    _roundtrip(m, x, tmp_path)
+
+
+def test_graph_dag_roundtrip(tmp_path):
+    """A diamond DAG through the Node wire graph (utils/DirectedGraph.scala
+    Node element/nexts/prevs; Graph.scala inputs/outputs)."""
+    inp = nn.Input()
+    h = nn.Linear(10, 16)(inp)
+    a = nn.ReLU()(h)
+    b = nn.Tanh()(h)
+    out = nn.CAddTable()([a, b])
+    m = nn.Graph(inp, out)
+    x = jnp.asarray(_rand((4, 10), 7))
+    m2 = _roundtrip(m, x, tmp_path)
+    assert isinstance(m2, nn.Graph)
+    assert len(m2.modules) == len(m.modules)
+
+
+def test_unsupported_cell_variant_fails_loud(tmp_path):
+    """p!=0 LSTM restructures the reference graph (per-gate dropout
+    stacks, no preTopology) — must refuse, not mis-load."""
+    dc = _DescCache()
+    topo = _obj(dc, "LSTM",
+                [("I", "inputSize", 3), ("I", "hiddenSize", 4),
+                 ("D", "p", 0.25)],
+                [("hiddensShape", "[I", _hiddens_shape(dc, [4, 4])),
+                 ("cell", "Lx;", _seq(dc))])
+    rec = _container(dc, "Recurrent", [topo])
+    with pytest.raises(ValueError, match="p!=0|preTopology"):
+        load_bytes(_stream_bytes(rec))

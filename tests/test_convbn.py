@@ -126,7 +126,10 @@ def test_module_fusion_parity(monkeypatch):
     y0, s0 = m.apply(m.params, m.state, x, training=True)
 
     params, state = m.params, m.state
-    fuse_conv_bn(m)
+    # bypass the fuse-before-build guard deliberately: this test keeps the
+    # pre-built param VALUES and regroups them to the fused tree itself
+    from bigdl_tpu.nn.fused import _fuse
+    _fuse(m)
     assert isinstance(m.modules[0], ConvBN)          # the 1x1 pair fused
     assert isinstance(m.modules[2], nn.SpatialConvolution)  # 3x3 untouched
     fp, fs = _regroup(params, m), _regroup(state, m)
@@ -174,3 +177,43 @@ def test_resnet50_rewrite_fuses_bottleneck_convs():
 
     n = count(model)
     assert n >= 32, f"expected >=32 fused pairs in ResNet-50, got {n}"
+
+
+def test_module_fusion_parity_bf16(monkeypatch):
+    """Under a bf16 compute policy the fused path must cast exactly like
+    the unfused conv (x and w to compute dtype) — caught by review: the
+    all-f32 parity tests could not see a missing cast."""
+    from bigdl_tpu.common import DTypePolicy, get_policy, set_policy
+
+    prev = get_policy()
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    try:
+        m = nn.Sequential()
+        m.add(nn.SpatialConvolution(8, 16, 1, 1, with_bias=False))
+        m.add(nn.SpatialBatchNormalization(16))
+        fuse_conv_bn(m)
+        m.build(jax.random.PRNGKey(0))
+        x = _rand((4, 6, 6, 8), 21)
+        monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+        y1, s1 = m.apply(m.params, m.state, x, training=True)
+        monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+        y0, s0 = m.apply(m.params, m.state, x, training=True)
+        assert y1.dtype == y0.dtype
+        np.testing.assert_allclose(
+            np.asarray(y1, np.float32), np.asarray(y0, np.float32),
+            rtol=0.05, atol=0.05)
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s0)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=0.05, atol=0.05)
+    finally:
+        set_policy(prev)
+
+
+def test_fuse_after_build_fails_loud():
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(4, 8, 1, 1))
+    m.add(nn.SpatialBatchNormalization(8))
+    m.build(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="BEFORE build"):
+        fuse_conv_bn(m)
