@@ -72,6 +72,7 @@ _SUID = {
     _PKG + "NarrowTable": 8046335768231475724,
     _PKG + "SelectTable": 8787233248773612598,
     _PKG + "FlattenTable": 7620301574431959449,
+    _PKG + "SplitTable": -4318640284973082779,
     _PKG + "CMulTable": 8888147326550637025,
     _PKG + "Narrow": 988790441682879293,
     _PKG + "MulConstant": -8747642888169310696,
