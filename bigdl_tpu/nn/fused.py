@@ -17,9 +17,11 @@ line up.
 ConvBN subclasses Sequential, so its params/state are exactly the pair's
 [conv, bn] list entries and every container facility (get_parameters,
 checkpoint traversal, repr) works unchanged.  When the fused path cannot
-engage (eval mode, multi-device GSPMD, GPU backend, non-affine BN) it
-falls back to the children's own apply — numerics are identical either
-way (parity-tested in tests/test_convbn.py).
+engage (eval mode, GPU backend, a multi-axis/TP mesh, non-affine BN)
+it falls back to the children's own apply — numerics are identical
+either way (parity-tested in tests/test_convbn.py).  On a DATA-ONLY
+mesh the kernel runs per shard inside shard_map with psum'd epilogue
+stats (same construction as BatchNormalization's pallas mesh route).
 """
 
 from __future__ import annotations
@@ -58,16 +60,28 @@ class ConvBN(Sequential):
         conv, bn = self.modules
         from ..utils.platform import backend_kind
         backend = backend_kind()  # resolves TPU plugin names like 'axon'
-        # engagement mirrors BatchNormalization._route_pallas: the fused
-        # pallas_call is opaque to GSPMD, so multi-device jits fall back to
-        # the children (where the BN layer applies its own mesh routing).
-        # Off-TPU the kernels would run in interpret mode — orders of
-        # magnitude slower — so that needs the explicit
-        # BN_IMPL=pallas_interpret opt-in (tests/CPU smoke), never silence.
+        # engagement mirrors BatchNormalization._route_pallas.  Off-TPU
+        # the kernels would run in interpret mode — orders of magnitude
+        # slower — so that needs the explicit BN_IMPL=pallas_interpret
+        # opt-in (tests/CPU smoke), never silence.
         interpret_req = config.get_str("BN_IMPL", "") == "pallas_interpret"
+        multi = jax.device_count() > 1
+        mesh = None
+        if multi and (interpret_req or backend == "tpu"):
+            # multi-device: the opaque pallas_call cannot be partitioned by
+            # GSPMD directly, but on a data-only Engine mesh the kernel
+            # runs per shard inside shard_map with psum'd epilogue stats —
+            # identical sync-BN semantics, matmul fusion intact.  Other
+            # multi-device shapes (TP meshes, no mesh) fall back to the
+            # children.
+            from ..utils.engine import Engine
+            if SpatialBatchNormalization.shardmap_route_engages(
+                    Engine._mesh, x.shape[0]):
+                mesh = Engine._mesh
         if not training or not (
-                interpret_req
-                or (backend == "tpu" and jax.device_count() == 1)):
+                mesh is not None
+                or interpret_req
+                or (backend == "tpu" and not multi)):
             return super().apply(params, state, x, training=training,
                                  rng=rng)
         from ..common import get_policy
@@ -76,13 +90,33 @@ class ConvBN(Sequential):
         conv_p, bn_p = params
         n, h, w_, k = x.shape
         c = get_policy().compute_dtype  # same cast the unfused conv makes
-        x2 = x.reshape(n * h * w_, k).astype(c)
         w2 = conv_p["weight"].reshape(k, conv.n_output_plane).astype(c)
-        z2, mean, var = fused_conv_bn_train(
-            x2, w2, conv_p.get("bias"), bn_p["weight"], bn_p["bias"],
-            bn.eps, interpret_req or backend != "tpu")
-        z = z2.reshape(n, h, w_, conv.n_output_plane)
-        new_bn_state = bn._ema_update(state[1], mean, var, x2.shape[0])
+        interpret = interpret_req or backend != "tpu"
+
+        def run(xl, w2, cbias, gamma, beta, axis):
+            r = xl.shape[0] * h * w_
+            z2, mean, var = fused_conv_bn_train(
+                xl.reshape(r, k).astype(c), w2, cbias, gamma, beta,
+                bn.eps, interpret, axis)
+            return z2.reshape(xl.shape[0], h, w_, -1), mean, var
+
+        args = (x, w2, conv_p.get("bias"), bn_p["weight"], bn_p["bias"])
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..utils.compat import shard_map_unchecked
+            from ..utils.engine import Engine
+            axis = Engine.DATA_AXIS
+            xspec = P(axis, None, None, None)
+            vspec = P(None)
+            z, mean, var = shard_map_unchecked(
+                lambda *a: run(*a, axis),
+                mesh=mesh,
+                in_specs=(xspec, vspec, vspec, vspec, vspec),
+                out_specs=(xspec, vspec, vspec))(*args)
+        else:
+            z, mean, var = run(*args, None)
+        new_bn_state = bn._ema_update(state[1], mean, var, n * h * w_)
         return z, [state[0], new_bn_state]
 
 

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .batchnorm import _bn_grad_stats_pallas, _pad_cols, _LANE
+from .batchnorm import (_bn_grad_stats_pallas, _global_n, _pad_cols,
+                        _LANE)
 
 __all__ = ["matmul_stats", "matmul_stats_reference", "fused_conv_bn_train"]
 
@@ -161,20 +162,27 @@ def matmul_stats(x2, w2, bias=None, *, interpret=False):
 # fused conv(1x1) + training-mode BN with hand-written VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def fused_conv_bn_train(x2, w2, bias, gamma, beta, eps, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def fused_conv_bn_train(x2, w2, bias, gamma, beta, eps, interpret=False,
+                        axis_name=None):
     """z = BN_train(x2 @ w2 (+bias)) over rows; returns (z, mean, var).
 
     Stats come from the matmul epilogue (no separate stat pass).  mean/var
     are the biased f32 batch statistics for the caller's running EMA and
     are non-differentiable outputs (cotangents ignored), like
     ops.batchnorm.bn_train.
+
+    With `axis_name` (inside a shard_map body) the per-shard epilogue
+    sums are psum'd over the mesh axis — global sync-BN statistics with
+    the matmul fusion intact, the same composition as
+    ops.batchnorm.bn_train_sync.
     """
-    out, _ = _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret)
+    out, _ = _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret,
+                             axis_name)
     return out
 
 
-def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret):
+def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret, axis_name):
     from jax.ad_checkpoint import checkpoint_name
 
     y, s, ss = matmul_stats(x2, w2, bias, interpret=interpret)
@@ -182,7 +190,10 @@ def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret):
     # save_only_these_names("conv_out") policy keeps the matmul output and
     # the backward's grad-stat pass doesn't re-run the whole MXU matmul
     y = checkpoint_name(y, "conv_out")
-    n = x2.shape[0]
+    if axis_name is not None:
+        s = lax.psum(s, axis_name)
+        ss = lax.psum(ss, axis_name)
+    n = _global_n(x2.shape[0], axis_name)
     mean = s / n
     var = ss / n - jnp.square(mean)
     inv = lax.rsqrt(var + eps)
@@ -193,18 +204,24 @@ def _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret):
                             bias is not None)
 
 
-def _fused_fwd(x2, w2, bias, gamma, beta, eps, interpret):
-    return _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret)
+def _fused_fwd(x2, w2, bias, gamma, beta, eps, interpret, axis_name):
+    return _fused_fwd_impl(x2, w2, bias, gamma, beta, eps, interpret,
+                           axis_name)
 
 
-def _fused_bwd(eps, interpret, res, cotangents):
+def _fused_bwd(eps, interpret, axis_name, res, cotangents):
     x2, w2, y, mean, inv, gamma, has_bias = res
     dz, _, _ = cotangents  # stat cotangents ignored
-    n = y.shape[0]
     # grad-stat pass over (y, dz) — the same fused Pallas reduction the
     # standalone BN backward uses
-    sdy, sdyx = _bn_grad_stats_pallas(y, dz, mean, inv,
-                                      block_r=1024, interpret=interpret)
+    sdy_local, sdyx_local = _bn_grad_stats_pallas(
+        y, dz, mean, inv, block_r=1024, interpret=interpret)
+    if axis_name is not None:
+        sdy = lax.psum(sdy_local, axis_name)
+        sdyx = lax.psum(sdyx_local, axis_name)
+    else:
+        sdy, sdyx = sdy_local, sdyx_local
+    n = _global_n(y.shape[0], axis_name)
     xhat = (y.astype(jnp.float32) - mean) * inv
     scale = (gamma.astype(jnp.float32) * inv).astype(y.dtype)
     dy = scale * (dz
@@ -216,8 +233,11 @@ def _fused_bwd(eps, interpret, res, cotangents):
     # d(bias) through a following BN is identically zero: a pre-BN bias
     # shift moves the mean by the same amount and cancels in (y - mean)
     dbias = jnp.zeros_like(mean).astype(w2.dtype) if has_bias else None
+    # dw/dgamma/dbeta are the LOCAL shard values: replicated inputs are
+    # transposed by shard_map with a psum over shards (see
+    # batchnorm._bn_sync_bwd for the double-counting hazard)
     return (dx.astype(x2.dtype), dw, dbias,
-            sdyx.astype(gamma.dtype), sdy.astype(gamma.dtype))
+            sdyx_local.astype(gamma.dtype), sdy_local.astype(gamma.dtype))
 
 
 fused_conv_bn_train.defvjp(_fused_fwd, _fused_bwd)

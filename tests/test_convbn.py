@@ -217,3 +217,44 @@ def test_fuse_after_build_fails_loud():
     m.build(jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="BEFORE build"):
         fuse_conv_bn(m)
+
+
+def test_module_fusion_mesh_parity(monkeypatch):
+    """The fused path composes with a data-only mesh: per-shard matmul
+    epilogues + psum'd stats == the unfused global-batch model (same
+    shard_map+psum construction as BatchNormalization's pallas route)."""
+    from bigdl_tpu.nn.fused import _fuse
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()  # 8-device data mesh from the conftest virtual CPUs
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(8, 16, 1, 1, with_bias=False))
+    m.add(nn.SpatialBatchNormalization(16))
+    m.build(jax.random.PRNGKey(0))
+    x = _rand((16, 5, 5, 8), 31)  # batch 16 over the 8-way data axis
+    y0, s0 = m.apply(m.params, m.state, x, training=True)
+
+    params, state = m.params, m.state
+    _fuse(m)
+    fp, fs = _regroup(params, m), _regroup(state, m)
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    y1, s1 = jax.jit(
+        lambda p, s, x: m.apply(p, s, x, training=True))(fp, fs, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+    t = _rand(np.asarray(y0).shape, 32)
+
+    def loss(p):
+        y, _ = m.apply(p, fs, x, training=True)
+        return jnp.mean((y - t) ** 2)
+
+    g1 = jax.jit(jax.grad(loss))(fp)
+    monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+    g0 = jax.grad(loss)(fp)  # unfused fallback on the same tree
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)

@@ -92,7 +92,9 @@ def _class_region(src: str, short: str):
     if not m:
         return None, None
     rest = src[m.start():]
-    nxt = re.search(r"\n(?:abstract\s+)?(?:class|object)\s+\w", rest[5:])
+    nxt = re.search(
+        r"\n(?:(?:abstract|sealed|final|private(?:\[\w+\])?|protected|"
+        r"case)\s+)*(?:class|object|trait)\s+\w", rest[5:])
     region = rest[:nxt.start() + 5] if nxt else rest
     bm = re.search(r"\{", region)
     if bm is None:
@@ -181,7 +183,7 @@ def scala_suid(classname: str):
     path = _source_file(short)
     if path is None:
         return None
-    src = open(path).read()
+    src = _strip_comments(open(path).read())
     cm = re.search(rf"(?:^|\n)[^\n]*?\bclass\s+{re.escape(short)}\b", src)
     if cm is None:
         return None
