@@ -154,6 +154,9 @@ def build_seq(short: str, obj: JavaObject, build: Callable):
     if short == "Recurrent":
         return _build_recurrent(obj, build)
 
+    if short == "BiRecurrent":
+        return _build_birecurrent(obj, build)
+
     if short == "Graph":
         return _build_graph(obj, build)
 
@@ -240,6 +243,36 @@ def _build_recurrent(obj: JavaObject, build):
         raise ValueError(f"bigdl format: Recurrent cell {tshort} not "
                          "mapped (RnnCell/LSTM/GRU only)")
     return nn.Recurrent(cell), [p], [{}]
+
+
+def _build_birecurrent(obj: JavaObject, build):
+    """BiRecurrent.scala:33 — `layer`/`revLayer` Recurrents (revLayer holds
+    a CLONED cell with independent weights) merged by the last module of
+    the internal `birnn` Sequential (CAddTable default, JoinTable for
+    concat)."""
+    from .. import nn
+    from .bigdl import _children
+
+    fwd_m, fwd_p, fwd_s = build(obj.fields["layer"])
+    rev_m, rev_p, rev_s = build(obj.fields["revLayer"])
+    merge_obj = _children(obj.fields["birnn"])[-1]
+    mshort = _short(merge_obj.classname)
+    if mshort == "CAddTable":
+        merge = "sum"
+    elif mshort == "JoinTable":
+        dim = int(merge_obj.fields.get("dimension", 3))
+        if dim != 3:  # (batch, time, feature) 1-based: features only
+            raise ValueError(
+                f"bigdl format: BiRecurrent JoinTable merge over dim {dim} "
+                "has no mapping here (feature concat, dim=3, only)")
+        merge = "concat"
+    else:
+        raise ValueError(f"bigdl format: BiRecurrent merge {mshort} not "
+                         "mapped (CAddTable/JoinTable only)")
+    bi = nn.BiRecurrent(fwd_m.modules[0], merge)
+    bi.modules[0] = fwd_m   # keep the two loaded Recurrents verbatim
+    bi.modules[1] = rev_m   # (revLayer's weights are independent)
+    return bi, [fwd_p, rev_p], [fwd_s, rev_s]
 
 
 def _gate_perm_ref_to_ours(h: int) -> np.ndarray:
@@ -419,6 +452,33 @@ def write_seq(dc, m, params, state, w_module):
                      ("Z", "propagateBack", True)],
                     [("weight", _T, _w_tensor(dc, w2)),
                      ("bias", _T, _w_tensor(dc, params["bias"]))])
+
+    if isinstance(m, nn.BiRecurrent):
+        layer = _write_recurrent(dc, m.modules[0], params[0], state[0])
+        rev = _write_recurrent(dc, m.modules[1], params[1], state[1])
+        if m.merge == "concat":
+            # (batch, time, feature) 1-based: feature dim 3
+            merge_obj = _obj(dc, "JoinTable",
+                             [("I", "dimension", 3),
+                              ("I", "nInputDims", 0)], [])
+        else:
+            merge_obj = _cadd(dc, True)
+        rev_wrap = _seq(dc, _obj(dc, "Reverse", [("I", "dimension", 2)], []),
+                        rev,
+                        _obj(dc, "Reverse", [("I", "dimension", 2)], []))
+        birnn = _seq(
+            dc,
+            _concat_table(dc, _simple(dc, "Identity"),
+                          _simple(dc, "Identity")),
+            _parallel_table(dc, layer, rev_wrap),
+            merge_obj)
+        # the reference's own modules buffer stays EMPTY (its add()
+        # delegates to layer/revLayer; BiRecurrent.scala:52-57)
+        return _container(dc, "BiRecurrent", [], (
+            ("I", "timeDim", 2),),
+            [("layer", _MODULE_SIG, layer),
+             ("revLayer", _MODULE_SIG, rev),
+             ("birnn", _MODULE_SIG, birnn)])
 
     if isinstance(m, nn.Recurrent):
         return _write_recurrent(dc, m, params, state)

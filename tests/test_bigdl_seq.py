@@ -257,3 +257,19 @@ def test_unsupported_cell_variant_fails_loud(tmp_path):
     rec = _container(dc, "Recurrent", [topo])
     with pytest.raises(ValueError, match="p!=0|preTopology"):
         load_bytes(_stream_bytes(rec))
+
+
+@pytest.mark.parametrize("merge", ["sum", "concat"])
+def test_birecurrent_roundtrip(merge, tmp_path):
+    """BiRecurrent (BiRecurrent.scala:33): independent fwd/rev weights,
+    CAddTable or JoinTable merge."""
+    m = nn.Sequential()
+    m.add(nn.BiRecurrent(nn.LSTM(5, 7), merge))
+    x = jnp.asarray(_rand((2, 6, 5), 13))
+    m2 = _roundtrip(m, x, tmp_path)
+    bi = m2.modules[0]
+    assert isinstance(bi, nn.BiRecurrent) and bi.merge == merge
+    # fwd/rev weights must stay independent through the wire
+    fwd_k = np.asarray(m2.params[0][0][0]["kernel"])
+    rev_k = np.asarray(m2.params[0][1][0]["kernel"])
+    assert not np.allclose(fwd_k, rev_k)
