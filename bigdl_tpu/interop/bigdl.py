@@ -77,7 +77,9 @@ _SUID = {
     _PKG + "Narrow": 988790441682879293,
     _PKG + "MulConstant": -8747642888169310696,
     _PKG + "AddConstant": -1572711921601326233,
-    # Recurrent / RnnCell / TimeDistributed / TemporalConvolution carry no
+    _PKG + "Container": -2120105647780417237,
+    # Recurrent / RnnCell / TimeDistributed / TemporalConvolution /
+    # AbstractModule / Cell / BiRecurrent / Reverse carry no
     # @SerialVersionUID annotation in the reference source; the JVM
     # computes a structural default (a SHA-1 over the compiled class's
     # members) that cannot be derived without a JVM — they fall back to
@@ -123,7 +125,19 @@ def _children(obj: JavaObject) -> List[JavaObject]:
 
 
 def _build(obj: JavaObject):
-    """Map one reference module object -> (bigdl_tpu module, params, state)."""
+    """Map one reference module object -> (bigdl_tpu module, params, state);
+    re-applies the stream's AbstractModule scaleW/scaleB so layer-wise
+    scales survive migration."""
+    m, p, s = _build_raw(obj)
+    f = obj.fields
+    for attr, key in (("scale_w", "scaleW"), ("scale_b", "scaleB")):
+        v = f.get(key)
+        if v is not None and float(v) != 1.0:
+            setattr(m, attr, float(v))  # property setter bumps scale epoch
+    return m, p, s
+
+
+def _build_raw(obj: JavaObject):
     from .. import nn
 
     cls = obj.classname
@@ -288,20 +302,144 @@ def load_bytes(data: bytes):
 # writing
 # ---------------------------------------------------------------------------
 
+# JVM-grade classdesc machinery.  A real ObjectInputStream matches the
+# stream's classdesc hierarchy against the local classes, so the writer
+# must emit (a) the actual superclass chain (Linear -> TensorModule ->
+# AbstractModule, ReLU -> Threshold -> ..., containers -> Container),
+# (b) AbstractModule's own non-transient base fields, and (c) fields in
+# the JOS canonical order (primitives before objects, each sorted by
+# name — java.io.ObjectStreamField.compareTo).  The name-based reader is
+# order-agnostic, so old flat streams (the frozen fixture) still load.
+_ABSTRACTNN = "com.intel.analytics.bigdl.nn.abstractnn."
+_AM = _ABSTRACTNN + "AbstractModule"
+_TM = _ABSTRACTNN + "TensorModule"
+_CONTAINER = _PKG + "Container"
+_CELL = _PKG + "Cell"
+_ACTIVITY_SIG = "Lcom/intel/analytics/bigdl/nn/abstractnn/Activity;"
+_STRING_SIG = "Ljava/lang/String;"
+_BUF_SIG = "Lscala/collection/mutable/ArrayBuffer;"
+# AbstractModule.scala:58-341 non-transient members
+_AM_FIELDS = [
+    ("D", "scaleW", None), ("D", "scaleB", None),
+    ("J", "forwardTime", None), ("J", "backwardTime", None),
+    ("L", "output", _ACTIVITY_SIG), ("L", "gradInput", _ACTIVITY_SIG),
+    ("Z", "train", None),
+    ("L", "name", _STRING_SIG), ("L", "namePostfix", _STRING_SIG),
+    ("L", "line", _STRING_SIG),
+    ("L", "engineType", "Lcom/intel/analytics/bigdl/utils/EngineType;"),
+]
+# shared field lists for classes that appear both as a concrete class and
+# as someone's superclass (ReLU extends Threshold; SpatialBatchNormalization
+# extends BatchNormalization) — one definition so the descs cannot diverge
+_TENSOR_SIG = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
+_THRESHOLD_FIELDS = [("D", "threshold", None), ("D", "value", None),
+                     ("Z", "inPlace", None)]
+_BN_FIELDS = [("I", "nOutput", None), ("D", "eps", None),
+              ("D", "momentum", None), ("Z", "affine", None),
+              ("L", "weight", _TENSOR_SIG), ("L", "bias", _TENSOR_SIG),
+              ("L", "runningMean", _TENSOR_SIG),
+              ("L", "runningVar", _TENSOR_SIG)]
+# default values for inherited/base fields the module builders don't set
+# explicitly; save() fills them in one walk over the finished object graph
+_FILL_DEFAULTS = {
+    "scaleW": 1.0, "scaleB": 1.0, "forwardTime": 0, "backwardTime": 0,
+    "train": True, "output": None, "gradInput": None, "name": None,
+    "namePostfix": "0", "line": "\n", "engineType": None,
+    "regularizers": None,
+    # ReLU is Threshold(0, 0, ip) in the reference (ReLU.scala)
+    "threshold": 0.0, "value": 0.0, "inPlace": False,
+}
+_PARENT_CONTAINER = {"Sequential", "Concat", "ConcatTable", "ParallelTable",
+                     "Recurrent", "BiRecurrent", "Graph"}
+_PARENT_CELL = {"RnnCell", "LSTM", "GRU"}
+_PARENT_AM_DIRECT = {"CAddTable", "CMulTable", "JoinTable", "SplitTable",
+                     "NarrowTable", "SelectTable", "FlattenTable",
+                     "Identity"}
+
+
+def _canonical(fields):
+    """JOS field order: primitives first, each group sorted by name."""
+    return sorted(fields, key=lambda f: (0 if f[0] in "BCDFIJSZ" else 1,
+                                         f[1]))
+
+
 class _DescCache:
-    """One JavaClassDesc per class per stream (so repeats become refs)."""
+    """One JavaClassDesc per class per stream (so repeats become refs).
+    nn-module classes get their real superclass chain attached
+    automatically; fields are stored in JOS canonical order."""
 
     def __init__(self):
         self.cache: Dict[str, JavaClassDesc] = {}
 
     def get(self, name: str, fields, super_desc=None) -> JavaClassDesc:
         if name not in self.cache:
+            if super_desc is None:
+                super_desc = self._auto_super(name)
             self.cache[name] = JavaClassDesc(
-                name, _SUID.get(name, 1), SC_SERIALIZABLE, fields, super_desc)
+                name, _SUID.get(name, 1), SC_SERIALIZABLE,
+                _canonical(fields), super_desc)
         return self.cache[name]
+
+    def _auto_super(self, name: str):
+        if name == _AM:
+            return None
+        if name == _TM or name in (_CONTAINER, _CELL):
+            # Container.scala:40 / Cell.scala:44 / TensorModule all extend
+            # AbstractModule directly
+            return self.get(_AM, list(_AM_FIELDS))
+        if not name.startswith(_PKG) or name.startswith(_ABSTRACTNN):
+            return None
+        short = name[len(_PKG):]
+        if "." in short:  # nested package (not an nn module class)
+            return None
+        if short == "ReLU":  # ReLU.scala: extends Threshold
+            return self.get(_PKG + "Threshold", list(_THRESHOLD_FIELDS))
+        if short == "SpatialBatchNormalization":  # extends BatchNormalization
+            return self.get(_PKG + "BatchNormalization", list(_BN_FIELDS))
+        if short in _PARENT_CONTAINER:
+            return self.get(_CONTAINER, [("L", "modules", _BUF_SIG)])
+        if short in _PARENT_CELL:
+            return self.get(_CELL, [
+                ("[", "hiddensShape", "[I"),
+                ("L", "regularizers",
+                 "[Lcom/intel/analytics/bigdl/optim/Regularizer;")])
+        if short in _PARENT_AM_DIRECT:
+            return self.get(_AM, list(_AM_FIELDS))
+        return self.get(_TM, [])  # TensorModule: no fields of its own
 
     def array(self, signature: str) -> JavaClassDesc:
         return self.get(signature, [])
+
+
+def _fill_base_fields(root: JavaObject) -> None:
+    """Fill inherited-field defaults for every module object in the graph
+    (one walk, cycle-safe); unknown missing fields fail loud."""
+    seen = set()
+
+    def walk(o):
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, JavaArray):
+            if o.values is not None and getattr(o.values, "dtype",
+                                                None) is None:
+                for v in o.values:
+                    walk(v)
+            return
+        if not isinstance(o, JavaObject):
+            return
+        for cls in o.classdesc.hierarchy():
+            for _t, fname, _sig in cls.fields:
+                if fname not in o.fields:
+                    if fname not in _FILL_DEFAULTS:
+                        raise ValueError(
+                            f"bigdl format save: {cls.name}.{fname} has no "
+                            "value and no known default")
+                    o.fields[fname] = _FILL_DEFAULTS[fname]
+        for v in list(o.fields.values()):
+            walk(v)
+
+    walk(root)
 
 
 def _w_tensor(dc: _DescCache, a: np.ndarray) -> JavaObject:
@@ -320,6 +458,13 @@ def _w_tensor(dc: _DescCache, a: np.ndarray) -> JavaObject:
         "_stride": JavaArray(dc.array("[I"), stride)})
 
 
+def _scales(m) -> dict:
+    """The module's real scale_w/scale_b (AbstractModule.scala:73-74
+    scaleW/scaleB) so the layer-wise gradient scale survives migration."""
+    return {"scaleW": float(getattr(m, "scale_w", 1.0)),
+            "scaleB": float(getattr(m, "scale_b", 1.0))}
+
+
 def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
     from .. import nn
 
@@ -330,6 +475,7 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         cd = dc.get(_PKG + short, fields)
         vals = {n: v for _t, n, v in prim_fields}
         vals.update({n: v for n, _s, v in obj_fields})
+        vals.update(_scales(m))
         return JavaObject(cd, vals)
 
     t = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
@@ -342,20 +488,21 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         buf = JavaObject(buf_cd, {
             "initialSize": 16, "size0": len(kids),
             "array": JavaArray(dc.array("[Ljava.lang.Object;"), kids)})
-        buf_sig = "Lscala/collection/mutable/ArrayBuffer;"
+        # `modules` lives on the Container superclass desc (attached by
+        # _DescCache automatically); only class-own fields are declared here
         if isinstance(m, nn.Concat):
             if m.dimension not in (-1, 3):
                 raise ValueError("bigdl format save: only channel Concat "
                                  "maps to the reference's NCHW dim 2")
-            cd = dc.get(_PKG + "Concat",
-                        [("I", "dimension", None), ("L", "modules", buf_sig)])
-            return JavaObject(cd, {"dimension": 2, "modules": buf})
+            cd = dc.get(_PKG + "Concat", [("I", "dimension", None)])
+            return JavaObject(cd, {"dimension": 2, "modules": buf,
+                                   **_scales(m)})
         # fused subclasses (nn.ConvBN) are a TPU-local optimization, not a
         # reference class: serialize as the plain Sequential they subclass
         short = ("Sequential" if isinstance(m, nn.Sequential)
                  else type(m).__name__)
-        cd = dc.get(_PKG + short, [("L", "modules", buf_sig)])
-        return JavaObject(cd, {"modules": buf})
+        cd = dc.get(_PKG + short, [])
+        return JavaObject(cd, {"modules": buf, **_scales(m)})
     if isinstance(m, nn.CAddTable):
         return obj("CAddTable", [("Z", "inplace", bool(m.inplace))], [])
     if isinstance(m, nn.View):
@@ -400,17 +547,20 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
                     ("bias", t, _w_tensor(dc, params["bias"])
                      if m.with_bias else None)])
     if isinstance(m, (nn.SpatialBatchNormalization, nn.BatchNormalization)):
-        short = type(m).__name__
-        return obj(short,
-                   [("I", "nOutput", m.n_output), ("D", "eps", m.eps),
-                    ("D", "momentum", m.momentum),
-                    ("Z", "affine", m.affine)],
-                   [("weight", t, _w_tensor(dc, params["weight"])
-                     if m.affine else None),
-                    ("bias", t, _w_tensor(dc, params["bias"])
-                     if m.affine else None),
-                    ("runningMean", t, _w_tensor(dc, state["running_mean"])),
-                    ("runningVar", t, _w_tensor(dc, state["running_var"]))])
+        # SpatialBatchNormalization extends BatchNormalization (which holds
+        # every field) — the subclass desc is empty with the BN super desc
+        bn_cd = dc.get(_PKG + "BatchNormalization", list(_BN_FIELDS))
+        cd = (dc.get(_PKG + "SpatialBatchNormalization", [],
+                     super_desc=bn_cd)
+              if isinstance(m, nn.SpatialBatchNormalization) else bn_cd)
+        return JavaObject(cd, {
+            "nOutput": m.n_output, "eps": m.eps, "momentum": m.momentum,
+            "affine": m.affine,
+            "weight": _w_tensor(dc, params["weight"]) if m.affine else None,
+            "bias": _w_tensor(dc, params["bias"]) if m.affine else None,
+            "runningMean": _w_tensor(dc, state["running_mean"]),
+            "runningVar": _w_tensor(dc, state["running_var"]),
+            **_scales(m)})
     if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
         kh, kw = m.kernel
         sh, sw = m.stride
@@ -470,6 +620,7 @@ def save(model, path: str):
 
     dc = _DescCache()
     root = _w_module(dc, model, host(model.params), host(model.state))
+    _fill_base_fields(root)  # inherited AbstractModule/field defaults
     w = JavaWriter()
     w.write_object(root)
     with open(path, "wb") as fh:

@@ -242,6 +242,12 @@ def _build_recurrent(obj: JavaObject, build):
     else:
         raise ValueError(f"bigdl format: Recurrent cell {tshort} not "
                          "mapped (RnnCell/LSTM/GRU only)")
+    # the cell object is built here, not via _build dispatch, so its
+    # AbstractModule grad scales are re-applied here too
+    for attr, key in (("scale_w", "scaleW"), ("scale_b", "scaleB")):
+        v = tf.get(key)
+        if v is not None and float(v) != 1.0:
+            setattr(cell, attr, float(v))
     return nn.Recurrent(cell), [p], [{}]
 
 
@@ -346,9 +352,12 @@ def _buffer(dc, items) -> JavaObject:
 
 def _container(dc, short, children, extra_prims=(), extra_objs=()) \
         -> JavaObject:
-    return _obj(dc, short, list(extra_prims),
-                [("modules", _BUF_SIG, _buffer(dc, children))]
-                + list(extra_objs))
+    # `modules` is declared on the Container SUPER desc (attached by
+    # _DescCache automatically) — only class-own fields go on this desc;
+    # the value is written under Container's classdata
+    o = _obj(dc, short, list(extra_prims), list(extra_objs))
+    o.fields["modules"] = _buffer(dc, children)
+    return o
 
 
 def _seq(dc, *children) -> JavaObject:
@@ -420,10 +429,15 @@ def write_seq(dc, m, params, state, w_module):
     from ..nn.graph import _InputModule
 
     _init_act_maps()
+    from .bigdl import _scales
+
+    def stamped(o):
+        o.fields.update(_scales(m))  # layer-wise grad scale survives
+        return o
 
     if isinstance(m, nn.TimeDistributed):
-        return _time_distributed(dc, w_module(dc, m.modules[0], params[0],
-                                              state[0]))
+        return stamped(_time_distributed(
+            dc, w_module(dc, m.modules[0], params[0], state[0])))
 
     if isinstance(m, nn.LookupTable):
         if not m.one_based:
@@ -432,26 +446,26 @@ def write_seq(dc, m, params, state, w_module):
                 "reference equivalent (reference indices are 1-based)")
         from .bigdl import _w_tensor
         big = np.finfo(np.float64).max
-        return _obj(dc, "LookupTable",
+        return stamped(_obj(dc, "LookupTable",
                     [("I", "nIndex", m.n_index), ("I", "nOutput", m.n_output),
                      ("D", "paddingValue", float(m.padding_value or 0.0)),
                      ("D", "maxNorm", float(m.max_norm)
                       if m.max_norm is not None else big),
                      ("D", "normType", float(m.norm_type))],
-                    [("weight", _T, _w_tensor(dc, params["weight"]))])
+                    [("weight", _T, _w_tensor(dc, params["weight"]))]))
 
     if isinstance(m, nn.TemporalConvolution):
         from .bigdl import _w_tensor
         w = np.asarray(params["weight"])           # (kw, in, out)
         w2 = w.transpose(2, 0, 1).reshape(m.output_frame_size, -1)
-        return _obj(dc, "TemporalConvolution",
+        return stamped(_obj(dc, "TemporalConvolution",
                     [("I", "inputFrameSize", m.input_frame_size),
                      ("I", "outputFrameSize", m.output_frame_size),
                      ("I", "kernelW", m.kernel_w),
                      ("I", "strideW", m.stride_w),
                      ("Z", "propagateBack", True)],
                     [("weight", _T, _w_tensor(dc, w2)),
-                     ("bias", _T, _w_tensor(dc, params["bias"]))])
+                     ("bias", _T, _w_tensor(dc, params["bias"]))]))
 
     if isinstance(m, nn.BiRecurrent):
         layer = _write_recurrent(dc, m.modules[0], params[0], state[0])
@@ -474,17 +488,17 @@ def write_seq(dc, m, params, state, w_module):
             merge_obj)
         # the reference's own modules buffer stays EMPTY (its add()
         # delegates to layer/revLayer; BiRecurrent.scala:52-57)
-        return _container(dc, "BiRecurrent", [], (
+        return stamped(_container(dc, "BiRecurrent", [], (
             ("I", "timeDim", 2),),
             [("layer", _MODULE_SIG, layer),
              ("revLayer", _MODULE_SIG, rev),
-             ("birnn", _MODULE_SIG, birnn)])
+             ("birnn", _MODULE_SIG, birnn)]))
 
     if isinstance(m, nn.Recurrent):
-        return _write_recurrent(dc, m, params, state)
+        return stamped(_write_recurrent(dc, m, params, state))
 
     if isinstance(m, nn.Graph):
-        return _write_graph(dc, m, params, state, w_module)
+        return stamped(_write_graph(dc, m, params, state, w_module))
 
     if isinstance(m, _InputModule):
         return _simple(dc, "Input")
@@ -515,12 +529,12 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
                      _concat_table(dc, _simple(dc, "Identity"),
                                    _simple(dc, "Identity")))
         topo = _obj(dc, "RnnCell", [],
-                    [("hiddensShape", "[I", _hiddens_shape(dc, [H])),
-                     ("parallelTable", _MODULE_SIG, pt),
+                    [("parallelTable", _MODULE_SIG, pt),
                      ("i2h", _MODULE_SIG, i2h),
                      ("h2h", _MODULE_SIG, h2h),
                      ("cAddTable", _MODULE_SIG, cadd),
                      ("cell", _MODULE_SIG, inner)])
+        topo.fields["hiddensShape"] = _hiddens_shape(dc, [H])  # Cell desc
     elif isinstance(cell, nn.LSTM):
         I, H = cell.input_size, cell.hidden_size
         perm = _gate_perm_ref_to_ours(H)     # involution: ours -> ref too
@@ -563,10 +577,10 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
         topo = _obj(dc, "LSTM",
                     [("I", "inputSize", I), ("I", "hiddenSize", H),
                      ("D", "p", 0.0)],
-                    [("hiddensShape", "[I", _hiddens_shape(dc, [H, H])),
-                     ("gates", _MODULE_SIG, gates),
+                    [("gates", _MODULE_SIG, gates),
                      ("cellLayer", _MODULE_SIG, None),
                      ("cell", _MODULE_SIG, lstm)])
+        topo.fields["hiddensShape"] = _hiddens_shape(dc, [H, H])  # Cell desc
     elif isinstance(cell, nn.GRU):
         I, O = cell.input_size, cell.hidden_size
         gk = np.asarray(cp["gate_kernel"])
@@ -626,17 +640,21 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
         topo = _obj(dc, "GRU",
                     [("I", "inputSize", I), ("I", "outputSize", O),
                      ("D", "p", 0.0), ("I", "featDim", 2)],
-                    [("hiddensShape", "[I", _hiddens_shape(dc, [O])),
-                     ("i2g", _MODULE_SIG, i2g),
+                    [("i2g", _MODULE_SIG, i2g),
                      ("h2g", _MODULE_SIG, h2g),
                      ("gates", _MODULE_SIG, gates),
                      ("cell", _MODULE_SIG, gru)])
+        topo.fields["hiddensShape"] = _hiddens_shape(dc, [O])  # Cell desc
     else:
         raise ValueError(f"bigdl format save: Recurrent cell "
                          f"{type(cell).__name__} not mapped")
-    return _container(dc, "Recurrent", [pre, topo], (),
-                      [("topology", _MODULE_SIG, topo),
-                       ("preTopology", _MODULE_SIG, pre)])
+    from .bigdl import _scales
+    topo.fields.update(_scales(cell))  # the cell module's own grad scale
+    rec = _container(dc, "Recurrent", [pre, topo], (),
+                     [("topology", _MODULE_SIG, topo),
+                      ("preTopology", _MODULE_SIG, pre)])
+    rec.fields.update(_scales(m))
+    return rec
 
 
 def _write_graph(dc, m, params, state, w_module) -> JavaObject:

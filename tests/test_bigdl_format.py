@@ -338,3 +338,39 @@ def test_threshold_inplace_flag_roundtrips(tmp_path):
     [root] = [c for c in contents if isinstance(c, JavaObject)]
     th = root.fields["modules"].fields["array"].values[0]
     assert th.fields["inPlace"] is True
+
+
+def test_layerwise_grad_scale_survives_migration(tmp_path):
+    """scale_w/scale_b (the reference's AbstractModule scaleW/scaleB,
+    :73-74) must round-trip as the REAL property the gradient-scaling
+    machinery reads — not a dangling attribute (round-5 review catch)."""
+    import jax.numpy as jnp
+
+    m = nn.Sequential()
+    lin = nn.Linear(6, 4)
+    lin.scale_w = 2.0
+    lin.scale_b = 0.5
+    m.add(lin)
+    m.add(nn.Tanh())
+    rec = nn.Recurrent(nn.RnnCell(4, 4))
+    rec.modules[0].scale_w = 3.0
+    m2 = nn.Sequential()
+    m2.add(rec)
+    m.add(nn.Reshape([4]))
+    m.build(jax.random.PRNGKey(0))
+    m2.build(jax.random.PRNGKey(1))
+
+    p1 = str(tmp_path / "scaled.bigdl")
+    bigdl_fmt.save(m, p1)
+    back = bigdl_fmt.load(p1)
+    assert back.modules[0].scale_w == 2.0
+    assert back.modules[0].scale_b == 0.5
+    # the wire carries the reference field names
+    with open(p1, "rb") as fh:
+        raw = fh.read()
+    assert b"scaleW" in raw
+
+    p2 = str(tmp_path / "scaled_rnn.bigdl")
+    bigdl_fmt.save(m2, p2)
+    back2 = bigdl_fmt.load(p2)
+    assert back2.modules[0].modules[0].scale_w == 3.0

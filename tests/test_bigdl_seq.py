@@ -34,6 +34,8 @@ def _rand(shape, seed):
 
 
 def _stream_bytes(root):
+    from bigdl_tpu.interop.bigdl import _fill_base_fields
+    _fill_base_fields(root)  # inherited AbstractModule defaults
     w = JavaWriter()
     w.write_object(root)
     return w.getvalue()
@@ -54,7 +56,8 @@ def test_reader_rnncell_matches_reference_equations():
     dc = _DescCache()
     pre = _time_distributed(dc, _linear(dc, wi, bi))
     h2h = _linear(dc, wh, bh)
-    pt = _obj(dc, "ParallelTable", [], [])  # structure placeholder
+    from bigdl_tpu.interop.bigdl_seq import _parallel_table
+    pt = _parallel_table(dc)  # structure placeholder (empty container)
     cell_seq = _seq(dc, pt, _obj(dc, "CAddTable", [], []),
                     _simple(dc, "Tanh"),
                     _simple(dc, "Identity"))

@@ -266,8 +266,11 @@ def _collect_classdescs(models) -> dict:
                 return [host(v) for v in tree]
             return np.asarray(tree)
 
+        from bigdl_tpu.interop.bigdl import _fill_base_fields
+
         dc = _DescCache()
         root = _w_module(dc, m, host(m.params), host(m.state))
+        _fill_base_fields(root)
         w = JavaWriter()
         w.write_object(root)
         [back] = loads(w.getvalue())
@@ -409,3 +412,56 @@ def test_audit_detects_a_wrong_field_and_wrong_suid():
                                [("D", "inputSize", None)], None)
     errs = audit_classdesc(wrong_type)
     assert any("primitive" in e for e in errs)
+
+
+def scala_parent_chain(short: str):
+    """The class's superCLASS chain from the source (traits — Tensor,
+    Storage, Serializable — end the chain, matching what JOS serializes)."""
+    chain, seen = [], set()
+    cur = short
+    while True:
+        path = _source_file(cur)
+        if path is None:
+            break
+        src = _strip_comments(open(path).read())
+        header, _ = _class_region(src, cur)
+        if header is None:
+            break
+        parent = _super_name(header)
+        if parent is None or parent in seen:
+            break
+        ppath = _source_file(parent)
+        if ppath is None:
+            break  # scala stdlib / java base
+        ph, _ = _class_region(_strip_comments(open(ppath).read()), parent)
+        if ph is None:
+            break  # a trait, not a class
+        chain.append(parent)
+        seen.add(parent)
+        cur = parent
+    return chain
+
+
+def test_super_chains_match_scala(kitchen_descs):
+    """The emitted classdesc hierarchy must equal the reference's actual
+    superclass chain (ReLU -> Threshold -> TensorModule -> AbstractModule,
+    containers -> Container, cells -> Cell, ...) — a real
+    ObjectInputStream validates exactly this."""
+    errors = []
+    checked = 0
+    for name, cd in sorted(kitchen_descs.items()):
+        if not name.startswith(_PKG):
+            continue
+        checked += 1
+        emitted = []
+        c = cd.super_desc
+        while c is not None:
+            emitted.append(c.name.rsplit(".", 1)[-1])
+            c = c.super_desc
+        expected = scala_parent_chain(name.rsplit(".", 1)[-1])
+        if emitted != expected:
+            errors.append(f"{name}: emitted super chain {emitted} != "
+                          f"source {expected}")
+    assert checked >= 30
+    assert not errors, "super-chain drift vs Scala source:\n" + \
+        "\n".join(errors)
