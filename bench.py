@@ -307,6 +307,74 @@ def _bench_config(name, build, peak_flops):
                         jnp.dtype(policy.compute_dtype).name)
 
 
+def _bench_resnet50_bf16_autotune(name, build, peak_flops):
+    """Race the semantics-identical BN implementations for the HEADLINE
+    config and report the fastest, with per-variant provenance.
+
+    Rationale: the BN variant race (bigdl_tpu.tools.bn_experiment) has
+    never executed on hardware (tunnel outages, rounds 3-5), so the
+    default BN path is an unmeasured guess.  If the only hardware contact
+    this round is the driver's own bench run, this race IS the
+    measurement: baseline XLA stats, the hand-written fused VJP
+    (BIGDL_TPU_BN_FUSED_VJP), and conv-epilogue stat fusion
+    (nn.fuse_conv_bn) — all parity-pinned against torch goldens /
+    the unfused model, so whichever wins is numerically identical.
+    A variant failure is recorded and skipped, never fatal.  Gated to
+    real TPUs (BIGDL_TPU_BENCH_BN_AUTOTUNE=0 disables; =1 forces on CPU,
+    where tripling a multi-minute compile is test-only).
+    """
+    from bigdl_tpu.utils.platform import backend_kind
+
+    auto = os.environ.get("BIGDL_TPU_BENCH_BN_AUTOTUNE", "")
+    if auto == "0" or (backend_kind() != "tpu" and auto != "1"):
+        return _bench_config(name, build, peak_flops)
+
+    variants = [
+        ("baseline", {}, False),
+        ("fused_vjp", {"BIGDL_TPU_BN_FUSED_VJP": "1"}, False),
+        ("conv_epilogue", {}, True),
+    ]
+    raced, best = {}, None
+    for vname, env, fuse in variants:
+        def build_v(fuse=fuse):
+            out = build()
+            if fuse:
+                from bigdl_tpu.nn import fuse_conv_bn
+                fuse_conv_bn(out[0])
+            return out
+
+        # ambient BN knobs would corrupt the race (an exported
+        # BN_FUSED_VJP=1 makes "baseline" measure the fused path) — pop
+        # them all first, like bn_experiment does, and restore after
+        bn_vars = ("BIGDL_TPU_BN_FUSED_VJP", "BIGDL_TPU_BN_IMPL",
+                   "BIGDL_TPU_BN_STAT_ROWS")
+        saved = {k: os.environ.get(k) for k in (*bn_vars, *env)}
+        for k in bn_vars:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        try:
+            rec = _bench_config(name, build_v, peak_flops)
+            rec["bn_variant"] = vname
+            raced[vname] = {k: rec[k] for k in
+                            ("step_seconds", "images_per_sec", "mfu",
+                             "compile_seconds")}
+            if best is None or rec["step_seconds"] < best["step_seconds"]:
+                best = rec
+        except Exception as e:  # noqa: BLE001 — a variant must not kill
+            _log(f"{name}: variant {vname} failed: {e}")  # the headline
+            raced[vname] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    if best is None:
+        raise RuntimeError(f"every BN variant failed: {raced}")
+    best["bn_variants_raced"] = raced
+    return best
+
+
 def _bench_infer(name, build, peak_flops):
     """Time the compiled INFERENCE forward (the Predictor/Evaluator hot path,
     reference AbstractModule.evaluate -> Evaluator.test, SURVEY.md §3.4) on
@@ -633,6 +701,8 @@ def main(argv=None):
             _beat(f"build:{name}")
             bench_fn = (_bench_infer if name in INFER_CONFIGS
                         else _bench_flash if name == "flash_attention"
+                        else _bench_resnet50_bf16_autotune
+                        if name == "resnet50_bf16"
                         else _bench_config)
             results[name] = bench_fn(name, CONFIGS[name], peak)
         except Exception as e:  # noqa: BLE001 — recorded per config
