@@ -332,7 +332,12 @@ def _bench_resnet50_bf16_autotune(name, build, peak_flops):
     variants = [
         ("baseline", {}, False),
         ("fused_vjp", {"BIGDL_TPU_BN_FUSED_VJP": "1"}, False),
-        ("conv_epilogue", {}, True),
+        # off-TPU (forced-on test mode) ConvBN needs the explicit
+        # interpret opt-in or it silently falls back to the unfused
+        # children and 'conv_epilogue' would mislabel a baseline run
+        ("conv_epilogue",
+         {} if backend_kind() == "tpu"
+         else {"BIGDL_TPU_BN_IMPL": "pallas_interpret"}, True),
     ]
     raced, best = {}, None
     for vname, env, fuse in variants:

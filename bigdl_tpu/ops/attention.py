@@ -253,12 +253,28 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     interpret: bool = False):
     """Blockwise (flash) attention.  q,k,v: [B, H, T, D] -> [B, H, Tq, D].
 
-    use_pallas: None = auto (Pallas on TPU, jnp reference elsewhere).
+    use_pallas: None = auto (Pallas on TPU, jnp reference elsewhere;
+    BIGDL_TPU_ATTN_IMPL=jnp|pallas overrides — the flash-vs-XLA op race
+    has not yet run on hardware, so the default stays overridable).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        from ..utils import config
+        impl = config.get_str("ATTN_IMPL", "")
+        if impl and impl not in ("jnp", "pallas"):
+            # a typo must not silently measure the wrong path under a
+            # forced label (same rule as bn_experiment's unknown variants)
+            raise ValueError(
+                f"BIGDL_TPU_ATTN_IMPL={impl!r}: expected 'jnp' or 'pallas'")
+        if impl:
+            use_pallas = impl == "pallas"
+        else:
+            # backend_kind resolves TPU plugin platform names ('axon') —
+            # default_backend()=='tpu' alone would silently route every
+            # model-level attention through the jnp path on such plugins
+            from ..utils.platform import backend_kind
+            use_pallas = backend_kind() == "tpu"
     if not use_pallas:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash_diff(q, k, v, causal, sm_scale, block_q, block_k,

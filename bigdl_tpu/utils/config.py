@@ -25,6 +25,7 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_BN_BATCH | (net-new: bn_experiment batch) | 256 |
 | BIGDL_TPU_BENCH_REMAT / _FLASH_SHAPE | (net-new: bench knobs) | off |
 | BIGDL_TPU_BENCH_BN_AUTOTUNE | (net-new: resnet50_bf16 BN-variant race; 0=off, 1=force on CPU, default=TPU only) | tpu |
+| BIGDL_TPU_ATTN_IMPL | (net-new: flash-attention dispatch, jnp/pallas; ops/attention.py) | auto |
 | BIGDL_TPU_TEST_INSTALLED | (net-new: suite resolves installed wheel) | off |
 """
 

@@ -472,3 +472,26 @@ class TestExpertParallel:
         assert MoEFFN._warned_no_mesh is False
         loss = opt.optim_method.hyper["loss"]
         assert np.isfinite(loss) and loss < 2.4  # descending from ln(12)
+
+
+def test_attn_impl_env_override(monkeypatch):
+    """BIGDL_TPU_ATTN_IMPL forces the dispatch; both paths agree (the
+    flash-vs-XLA race is measured on hardware, so the default must stay
+    overridable — and plugin platform names must not silently reroute)."""
+    import numpy as np
+    import jax
+
+    from bigdl_tpu.ops.attention import flash_attention
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 16, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 16, 8))
+    monkeypatch.setenv("BIGDL_TPU_ATTN_IMPL", "jnp")
+    o_jnp = flash_attention(q, k, v, causal=True)
+    monkeypatch.setenv("BIGDL_TPU_ATTN_IMPL", "pallas")
+    o_pl = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(o_jnp), np.asarray(o_pl),
+                               rtol=1e-4, atol=1e-4)
+    monkeypatch.setenv("BIGDL_TPU_ATTN_IMPL", "xla")
+    with pytest.raises(ValueError, match="ATTN_IMPL"):
+        flash_attention(q, k, v, causal=True)

@@ -203,3 +203,4 @@ def test_moe_transformer_lm_trains_copy_task():
     pred = np.argmax(np.asarray(out), -1)
     tgt = np.asarray([s[1:] for s in seqs[:32]])
     assert (pred == tgt).mean() > 0.55
+
