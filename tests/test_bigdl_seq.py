@@ -276,3 +276,26 @@ def test_birecurrent_roundtrip(merge, tmp_path):
     fwd_k = np.asarray(m2.params[0][0][0]["kernel"])
     rev_k = np.asarray(m2.params[0][1][0]["kernel"])
     assert not np.allclose(fwd_k, rev_k)
+
+
+def test_frozen_canonical_fixture_loads_and_predicts():
+    """A frozen stream written by the JVM-canonical writer (super chains,
+    AbstractModule base fields, JOS field order): the BYTES are the
+    contract, like the round-4 lenet fixture for the flat format."""
+    import os
+    import struct
+
+    fx = os.path.join(os.path.dirname(__file__), "fixtures", "interop",
+                      "simple_rnn_canonical.bigdl")
+    raw = open(fx, "rb").read()
+    assert struct.unpack(">HH", raw[:4]) == (0xACED, 5)
+    assert b"com.intel.analytics.bigdl.nn.Recurrent" in raw
+    assert b"abstractnn.AbstractModule" in raw      # real super chain
+    assert b"com.intel.analytics.bigdl.nn.Container" in raw
+
+    model = bigdl_fmt.load(fx)
+    assert model.modules[0].modules[0].scale_w == 1.5  # base field survived
+    x = np.fromfile(fx + ".x", dtype=np.float32).reshape(2, 5, 6)
+    golden = np.fromfile(fx + ".y", dtype=np.float32).reshape(2, 5, 4)
+    y, _ = model.apply(model.params, model.state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), golden, rtol=1e-5, atol=1e-5)
