@@ -78,6 +78,8 @@ _SUID = {
     _PKG + "MulConstant": -8747642888169310696,
     _PKG + "AddConstant": -1572711921601326233,
     _PKG + "Container": -2120105647780417237,
+    _PKG + "LSTMPeephole": -7566757838561436619,
+    _PKG + "CMul": 8888147326550637025,  # same literal as CMulTable in src
     # Recurrent / RnnCell / TimeDistributed / TemporalConvolution /
     # AbstractModule / Cell / BiRecurrent / Reverse carry no
     # @SerialVersionUID annotation in the reference source; the JVM
@@ -351,7 +353,7 @@ _FILL_DEFAULTS = {
 }
 _PARENT_CONTAINER = {"Sequential", "Concat", "ConcatTable", "ParallelTable",
                      "Recurrent", "BiRecurrent", "Graph"}
-_PARENT_CELL = {"RnnCell", "LSTM", "GRU"}
+_PARENT_CELL = {"RnnCell", "LSTM", "GRU", "LSTMPeephole"}
 _PARENT_AM_DIRECT = {"CAddTable", "CMulTable", "JoinTable", "SplitTable",
                      "NarrowTable", "SelectTable", "FlattenTable",
                      "Identity"}

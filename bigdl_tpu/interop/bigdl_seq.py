@@ -174,7 +174,7 @@ def _ref_linear_wb(lin: JavaObject):
 
 def _build_recurrent(obj: JavaObject, build):
     from .. import nn
-    from .bigdl import _children
+    from .bigdl import _children, _to_numpy
 
     _init_act_maps()
     kids = _children(obj)
@@ -184,6 +184,13 @@ def _build_recurrent(obj: JavaObject, build):
             f"({len(kids)} children) — the p!=0 dropout cell variants "
             "restructure the reference graph and are not mapped")
     pre, topo = kids
+    if _short(pre.classname) == "Sequential":
+        # LSTMPeephole wraps its preTopology as Sequential(Dropout, TD)
+        # (LSTMPeephole.scala:71-75)
+        tds = [c for c in _children(pre)
+               if _short(c.classname) == "TimeDistributed"]
+        if len(tds) == 1:
+            pre = tds[0]
     if _short(pre.classname) != "TimeDistributed":
         raise ValueError(f"bigdl format: Recurrent preTopology "
                          f"{pre.classname} not supported")
@@ -239,9 +246,48 @@ def _build_recurrent(obj: JavaObject, build):
              "gate_bias": np.concatenate([bi[:out], -bi[out:2 * out]]),
              "cand_kernel": np.concatenate([wi[2 * out:].T, whh.T], axis=0),
              "cand_bias": np.asarray(bi[2 * out:], np.float32)}
+    elif tshort == "LSTMPeephole":
+        if float(tf.get("p", 0.0)) != 0.0:
+            raise ValueError("bigdl format: LSTMPeephole with p!=0 is not "
+                             "mapped")
+        hidden = int(tf["hiddenSize"])
+        insize = int(tf["inputSize"])
+        # gate identity comes from each gate ParallelTable's Narrow offset
+        # (buildGate/buildHidden, LSTMPeephole.scala:77-130): offset 1=i,
+        # 1+H=f, 1+2H=g (hidden, no peephole), 1+3H=o — wire chunk order
+        # [i, f, g, o], the SAME as this framework's kernel, no permute
+        wh = {}
+        peep = {}
+        for pt in (o for o in _walk(tf["cell"])
+                   if isinstance(o, JavaObject)
+                   and o.classname == _PKG + "ParallelTable"):
+            members = _children(pt)
+            narrows = [c for c in members
+                       if _short(c.classname) == "Narrow"]
+            if len(narrows) != 1:
+                continue
+            chunk = (int(narrows[0].fields["offset"]) - 1) // hidden
+            [lin] = _find_linears(pt)
+            wh[chunk], _ = _ref_linear_wb(lin)
+            cmuls = [c for c in _walk(pt)
+                     if isinstance(c, JavaObject)
+                     and c.classname == _PKG + "CMul"]
+            if cmuls:
+                peep[chunk] = _to_numpy(
+                    cmuls[0].fields["weight"]).reshape(-1)
+        if sorted(wh) != [0, 1, 2, 3] or sorted(peep) != [0, 1, 3]:
+            raise ValueError(
+                f"bigdl format: LSTMPeephole cell structure not recognized "
+                f"(gates {sorted(wh)}, peepholes {sorted(peep)})")
+        cell = nn.LSTMPeephole(insize, hidden)
+        kernel = np.concatenate(
+            [wi.T] + [np.concatenate([wh[c].T for c in range(4)], axis=1)],
+            axis=0)
+        p = {"kernel": kernel, "bias": np.asarray(bi, np.float32),
+             "peep_i": peep[0], "peep_f": peep[1], "peep_o": peep[3]}
     else:
         raise ValueError(f"bigdl format: Recurrent cell {tshort} not "
-                         "mapped (RnnCell/LSTM/GRU only)")
+                         "mapped (RnnCell/LSTM/GRU/LSTMPeephole only)")
     # the cell object is built here, not via _build dispatch, so its
     # AbstractModule grad scales are re-applied here too
     for attr, key in (("scale_w", "scaleW"), ("scale_b", "scaleB")):
@@ -581,6 +627,82 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
                      ("cellLayer", _MODULE_SIG, None),
                      ("cell", _MODULE_SIG, lstm)])
         topo.fields["hiddensShape"] = _hiddens_shape(dc, [H, H])  # Cell desc
+    elif isinstance(cell, nn.LSTMPeephole):
+        I, H = cell.input_size, cell.hidden_size
+        kernel = np.asarray(cp["kernel"])
+        wi = kernel[:I].T                      # (4H, I), chunks [i,f,g,o]
+        bi = np.asarray(cp["bias"])
+        pre = _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+                   _time_distributed(dc, _linear(dc, wi, bi)))
+
+        def h2h_seq(chunk):
+            w = kernel[I:, chunk * H:(chunk + 1) * H].T    # (H, H)
+            return _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+                        _linear(dc, w, None))
+
+        def cmul(weight):
+            from .bigdl import _w_tensor
+            return _obj(dc, "CMul", [],
+                        [("size", "[I", _hiddens_shape(dc, [H])),
+                         ("weight", _T, _w_tensor(
+                             dc, np.asarray(weight).reshape(H)))])
+
+        def gate(chunk, peep):                 # buildGate, :77-93
+            return _seq(
+                dc,
+                _parallel_table(
+                    dc,
+                    _obj(dc, "Narrow",
+                         [("I", "dimension", 2),
+                          ("I", "offset", 1 + chunk * H),
+                          ("I", "length", H)], []),
+                    h2h_seq(chunk), cmul(peep)),
+                _cadd(dc, False), _simple(dc, "Sigmoid"))
+
+        input_gate = gate(0, cp["peep_i"])
+        forget_gate = gate(1, cp["peep_f"])
+        output_gate = gate(3, cp["peep_o"])
+        hidden_layer = _seq(                   # buildHidden, :110-130
+            dc, _narrow_table(dc, 1, 2),
+            _parallel_table(
+                dc,
+                _obj(dc, "Narrow",
+                     [("I", "dimension", 2), ("I", "offset", 1 + 2 * H),
+                      ("I", "length", H)], []),
+                h2h_seq(2)),
+            _cadd(dc, False), _simple(dc, "Tanh"))
+        forget_layer = _seq(
+            dc, _concat_table(dc, forget_gate, _select(dc, 3)),
+            _simple(dc, "CMulTable"))
+        input_layer = _seq(
+            dc, _concat_table(dc, input_gate, hidden_layer),
+            _simple(dc, "CMulTable"))
+        cell_layer = _seq(                     # buildCell, :133-156
+            dc, _concat_table(dc, forget_layer, input_layer),
+            _cadd(dc, False))
+        lstm = _seq(                           # buildLSTM, :159-184
+            dc, _simple(dc, "FlattenTable"),
+            _concat_table(dc, _narrow_table(dc, 1, 2), cell_layer),
+            _simple(dc, "FlattenTable"),
+            _concat_table(
+                dc,
+                _seq(dc,
+                     _concat_table(dc, output_gate,
+                                   _seq(dc, _select(dc, 3),
+                                        _simple(dc, "Tanh"))),
+                     _simple(dc, "CMulTable")),
+                _select(dc, 3)),
+            _concat_table(dc, _select(dc, 1), _simple(dc, "Identity")))
+        topo = _obj(dc, "LSTMPeephole",
+                    [("I", "inputSize", I), ("I", "hiddenSize", H),
+                     ("D", "p", 0.0), ("I", "featDim", 2)],
+                    [("inputGate", _MODULE_SIG, input_gate),
+                     ("forgetGate", _MODULE_SIG, forget_gate),
+                     ("outputGate", _MODULE_SIG, output_gate),
+                     ("hiddenLayer", _MODULE_SIG, hidden_layer),
+                     ("cellLayer", _MODULE_SIG, cell_layer),
+                     ("cell", _MODULE_SIG, lstm)])
+        topo.fields["hiddensShape"] = _hiddens_shape(dc, [H, H])
     elif isinstance(cell, nn.GRU):
         I, O = cell.input_size, cell.hidden_size
         gk = np.asarray(cp["gate_kernel"])

@@ -299,3 +299,71 @@ def test_frozen_canonical_fixture_loads_and_predicts():
     golden = np.fromfile(fx + ".y", dtype=np.float32).reshape(2, 5, 4)
     y, _ = model.apply(model.params, model.state, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(y), golden, rtol=1e-5, atol=1e-5)
+
+
+def test_lstmpeephole_roundtrip(tmp_path):
+    """LSTMPeephole (LSTMPeephole.scala:50): gate chunks [i,f,g,o] keyed
+    by Narrow offsets, CMul peephole weights (i/f on c_prev, o on c_new)."""
+    m = nn.Sequential()
+    m.add(nn.Recurrent(nn.LSTMPeephole(5, 7)))
+    x = jnp.asarray(_rand((2, 6, 5), 17))
+    _roundtrip(m, x, tmp_path)
+
+
+def test_reader_lstmpeephole_matches_reference_equations():
+    """Hand-built reference-structure stream -> our cell equations
+    (chunk order [i, f, g, o] — offsets 1, 1+H, 1+2H, 1+3H)."""
+    from bigdl_tpu.interop.bigdl_seq import (_cadd, _concat_table,
+                                             _parallel_table, _select)
+
+    I, H, B, T = 3, 4, 2, 4
+    wi, bi = _rand((4 * H, I), 0) * 0.3, _rand((4 * H,), 1) * 0.1
+    whs = [_rand((H, H), 2 + c) * 0.3 for c in range(4)]
+    peeps = {0: _rand((H,), 11) * 0.2, 1: _rand((H,), 12) * 0.2,
+             3: _rand((H,), 13) * 0.2}
+    dc = _DescCache()
+    pre = _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+               _time_distributed(dc, _linear(dc, wi, bi)))
+
+    def gate(chunk):
+        members = [
+            _obj(dc, "Narrow",
+                 [("I", "dimension", 2), ("I", "offset", 1 + chunk * H),
+                  ("I", "length", H)], []),
+            _seq(dc, _linear(dc, whs[chunk], None)),
+        ]
+        if chunk in peeps:
+            members.append(_obj(dc, "CMul", [],
+                                [("weight", "Lx;",
+                                  _w_tensor(dc, peeps[chunk]))]))
+        return _seq(dc, _parallel_table(dc, *members), _cadd(dc, False),
+                    _simple(dc, "Sigmoid" if chunk != 2 else "Tanh"))
+
+    cell_seq = _seq(dc, gate(0), gate(1), gate(2), gate(3))
+    topo = _obj(dc, "LSTMPeephole",
+                [("I", "inputSize", I), ("I", "hiddenSize", H),
+                 ("D", "p", 0.0)],
+                [("cell", "Lx;", cell_seq)])
+    topo.fields["hiddensShape"] = _hiddens_shape(dc, [H, H])
+    rec = _container(dc, "Recurrent", [pre, topo])
+    model = load_bytes(_stream_bytes(rec))
+
+    x = _rand((B, T, I), 4)
+    y, _ = model.apply(model.params, model.state, jnp.asarray(x))
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    expect = []
+    for t in range(T):
+        pre_t = x[:, t] @ wi.T + bi
+        i_pre = pre_t[:, 0:H] + h @ whs[0].T + peeps[0] * c
+        f_pre = pre_t[:, H:2 * H] + h @ whs[1].T + peeps[1] * c
+        g_pre = pre_t[:, 2 * H:3 * H] + h @ whs[2].T
+        ig, fg = _sigmoid(i_pre), _sigmoid(f_pre)
+        g = np.tanh(g_pre)
+        c = fg * c + ig * g
+        o_pre = pre_t[:, 3 * H:4 * H] + h @ whs[3].T + peeps[3] * c
+        og = _sigmoid(o_pre)
+        h = og * np.tanh(c)
+        expect.append(h)
+    np.testing.assert_allclose(np.asarray(y), np.stack(expect, 1),
+                               rtol=1e-4, atol=1e-5)

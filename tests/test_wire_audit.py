@@ -348,6 +348,9 @@ def _kitchen_sink_models():
     gru = nn.Sequential()
     gru.add(nn.Recurrent(nn.GRU(4, 6)))
 
+    peep = nn.Sequential()
+    peep.add(nn.Recurrent(nn.LSTMPeephole(4, 6)))
+
     text = nn.Sequential()
     text.add(nn.LookupTable(10, 8, one_based=True))
     text.add(nn.TemporalConvolution(8, 6, 3))
@@ -359,7 +362,7 @@ def _kitchen_sink_models():
     out = nn.CAddTable()([a, b])
     graph = nn.Graph(inp, out)
 
-    return [cnn, joined, rnn, lstm, gru, text, graph]
+    return [cnn, joined, rnn, lstm, gru, peep, text, graph]
 
 
 # ---------------------------------------------------------------------------
