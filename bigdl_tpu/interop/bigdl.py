@@ -42,6 +42,7 @@ _SUID = {
     _PKG + "Linear": 359656776803598943,
     _PKG + "ReLU": 1208478077576570643,
     _PKG + "SpatialConvolution": -8446523046224797382,
+    _PKG + "SpatialShareConvolution": 4479683852714800631,
     _PKG + "SpatialMaxPooling": 2277597677473874749,
     _PKG + "SpatialAveragePooling": 4533142511857387857,
     _PKG + "BatchNormalization": -3181824540272906068,
@@ -175,9 +176,12 @@ def _build_raw(obj: JavaObject):
         if f.get("withBias", True) and f.get("bias") is not None:
             p["bias"] = _to_numpy(f["bias"])
         return m, p, {}
-    if short == "SpatialConvolution":
+    if short in ("SpatialConvolution", "SpatialShareConvolution"):
         g = int(f.get("nGroup", 1))
-        m = nn.SpatialConvolution(
+        ctor = (nn.SpatialShareConvolution
+                if short == "SpatialShareConvolution"
+                else nn.SpatialConvolution)
+        m = ctor(
             int(f["nInputPlane"]), int(f["nOutputPlane"]),
             int(f["kernelW"]), int(f["kernelH"]),
             int(f.get("strideW", 1)), int(f.get("strideH", 1)),
@@ -336,6 +340,12 @@ _AM_FIELDS = [
 _TENSOR_SIG = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
 _THRESHOLD_FIELDS = [("D", "threshold", None), ("D", "value", None),
                      ("Z", "inPlace", None)]
+_SCONV_FIELDS = [("I", "nInputPlane", None), ("I", "nOutputPlane", None),
+                 ("I", "kernelW", None), ("I", "kernelH", None),
+                 ("I", "strideW", None), ("I", "strideH", None),
+                 ("I", "padW", None), ("I", "padH", None),
+                 ("I", "nGroup", None),
+                 ("L", "weight", _TENSOR_SIG), ("L", "bias", _TENSOR_SIG)]
 _BN_FIELDS = [("I", "nOutput", None), ("D", "eps", None),
               ("D", "momentum", None), ("Z", "affine", None),
               ("L", "weight", _TENSOR_SIG), ("L", "bias", _TENSOR_SIG),
@@ -398,6 +408,9 @@ class _DescCache:
             return self.get(_PKG + "Threshold", list(_THRESHOLD_FIELDS))
         if short == "SpatialBatchNormalization":  # extends BatchNormalization
             return self.get(_PKG + "BatchNormalization", list(_BN_FIELDS))
+        if short == "SpatialShareConvolution":  # extends SpatialConvolution
+            return self.get(_PKG + "SpatialConvolution",
+                            list(_SCONV_FIELDS))
         if short in _PARENT_CONTAINER:
             return self.get(_CONTAINER, [("L", "modules", _BUF_SIG)])
         if short in _PARENT_CELL:
@@ -538,16 +551,19 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         w = np.asarray(params["weight"])  # HWIO
         g = m.n_group
         w5 = w.reshape(kh, kw, w.shape[2], g, -1).transpose(3, 4, 2, 0, 1)
-        return obj("SpatialConvolution",
-                   [("I", "nInputPlane", m.n_input_plane),
-                    ("I", "nOutputPlane", m.n_output_plane),
-                    ("I", "kernelW", kw), ("I", "kernelH", kh),
-                    ("I", "strideW", sw), ("I", "strideH", sh),
-                    ("I", "padW", pw), ("I", "padH", ph),
-                    ("I", "nGroup", g)],
-                   [("weight", t, _w_tensor(dc, w5)),
-                    ("bias", t, _w_tensor(dc, params["bias"])
-                     if m.with_bias else None)])
+        sconv_cd = dc.get(_PKG + "SpatialConvolution", list(_SCONV_FIELDS))
+        cd = (dc.get(_PKG + "SpatialShareConvolution", [],
+                     super_desc=sconv_cd)
+              if isinstance(m, nn.SpatialShareConvolution) else sconv_cd)
+        return JavaObject(cd, {
+            "nInputPlane": m.n_input_plane,
+            "nOutputPlane": m.n_output_plane,
+            "kernelW": kw, "kernelH": kh, "strideW": sw, "strideH": sh,
+            "padW": pw, "padH": ph, "nGroup": g,
+            "weight": _w_tensor(dc, w5),
+            "bias": (_w_tensor(dc, params["bias"])
+                     if m.with_bias else None),
+            **_scales(m)})
     if isinstance(m, (nn.SpatialBatchNormalization, nn.BatchNormalization)):
         # SpatialBatchNormalization extends BatchNormalization (which holds
         # every field) — the subclass desc is empty with the BN super desc

@@ -186,10 +186,16 @@ def _build_recurrent(obj: JavaObject, build):
     pre, topo = kids
     if _short(pre.classname) == "Sequential":
         # LSTMPeephole wraps its preTopology as Sequential(Dropout, TD)
-        # (LSTMPeephole.scala:71-75)
-        tds = [c for c in _children(pre)
+        # (LSTMPeephole.scala:71-75).  Only inference-identity Dropout
+        # siblings may be discarded — any other module would change the
+        # forward, so unwrapping it silently would mis-load the stream.
+        kids_pre = _children(pre)
+        tds = [c for c in kids_pre
                if _short(c.classname) == "TimeDistributed"]
-        if len(tds) == 1:
+        others = [c for c in kids_pre
+                  if _short(c.classname) not in ("TimeDistributed",
+                                                 "Dropout")]
+        if len(tds) == 1 and not others:
             pre = tds[0]
     if _short(pre.classname) != "TimeDistributed":
         raise ValueError(f"bigdl format: Recurrent preTopology "

@@ -176,13 +176,13 @@ def test_wire_layout_matches_reference():
 def test_unknown_layer_fails_loud(tmp_path):
     from bigdl_tpu.interop.javaser import JavaClassDesc
 
-    cd = JavaClassDesc("com.intel.analytics.bigdl.nn.SpatialShareConvolution",
+    cd = JavaClassDesc("com.intel.analytics.bigdl.nn.RoiPooling",
                        1, 2, [], None)
     w = JavaWriter()
     w.write_object(JavaObject(cd, {}))
     p = tmp_path / "weird.bigdl"
     p.write_bytes(w.getvalue())
-    with pytest.raises(ValueError, match="SpatialShareConvolution"):
+    with pytest.raises(ValueError, match="RoiPooling"):
         bigdl_fmt.load(str(p))
 
 
@@ -374,3 +374,27 @@ def test_layerwise_grad_scale_survives_migration(tmp_path):
     bigdl_fmt.save(m2, p2)
     back2 = bigdl_fmt.load(p2)
     assert back2.modules[0].modules[0].scale_w == 3.0
+
+
+def test_share_convolution_resnet_style_roundtrip(tmp_path):
+    """The reference ResNet's default optnet=true path serializes
+    SpatialShareConvolution (models/resnet/ResNet.scala:47-49, a
+    buffer-sharing subclass with the identical wire layout,
+    nn/SpatialShareConvolution.scala:28) — its streams must load, and the
+    alias must re-export under its own class name + real SUID."""
+    m = nn.Sequential()
+    m.add(nn.SpatialShareConvolution(3, 8, 3, 3, pad_w=1, pad_h=1))
+    m.add(nn.SpatialBatchNormalization(8))
+    m.add(nn.ReLU())
+    m.build(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 6, 3))
+    y0, _ = m.apply(m.params, m.state, x)
+    p = str(tmp_path / "share.bigdl")
+    bigdl_fmt.save(m, p)
+    raw = open(p, "rb").read()
+    assert b"SpatialShareConvolution" in raw
+    m2 = bigdl_fmt.load(p)
+    assert type(m2.modules[0]).__name__ == "SpatialShareConvolution"
+    y1, _ = m2.apply(m2.params, m2.state, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-6)

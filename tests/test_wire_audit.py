@@ -302,6 +302,7 @@ def _kitchen_sink_models():
     cnn = nn.Sequential()
     cnn.add(nn.SpatialZeroPadding(1, 1, 1, 1))
     cnn.add(nn.SpatialConvolution(3, 8, 3, 3))
+    cnn.add(nn.SpatialShareConvolution(8, 8, 1, 1))
     cnn.add(nn.SpatialBatchNormalization(8))
     cnn.add(nn.ReLU())
     cnn.add(nn.SpatialCrossMapLRN(5))
