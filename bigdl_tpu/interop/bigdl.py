@@ -80,6 +80,8 @@ _SUID = {
     _PKG + "AddConstant": -1572711921601326233,
     _PKG + "Container": -2120105647780417237,
     _PKG + "LSTMPeephole": -7566757838561436619,
+    _PKG + "MapTable": 4403280698280280268,
+    _PKG + "Squeeze": 7998127436291978408,
     _PKG + "CMul": 8888147326550637025,  # same literal as CMulTable in src
     # Recurrent / RnnCell / TimeDistributed / TemporalConvolution /
     # AbstractModule / Cell / BiRecurrent / Reverse carry no
@@ -146,9 +148,19 @@ def _build_raw(obj: JavaObject):
     cls = obj.classname
     short = cls[len(_PKG):] if cls.startswith(_PKG) else cls
     f = obj.fields
-    if short in ("Sequential", "Concat", "ConcatTable"):
+    if short in ("Sequential", "Concat", "ConcatTable", "ParallelTable",
+                 "MapTable"):
         if short == "Sequential":
             container = nn.Sequential()
+        elif short == "ParallelTable":
+            container = nn.ParallelTable()
+        elif short == "MapTable":
+            # one SHARED child; the reference also stores per-application
+            # clones in `modules` — only the master (field `module`) maps
+            container = nn.MapTable()
+            m, p, s = _build(f["module"])
+            container.modules = [m]
+            return container, [p], [s]
         elif short == "Concat":
             # reference dimension is 1-based over NCHW: 2 = channels, which
             # is the LAST axis in this framework's NHWC layout (the only
@@ -252,6 +264,21 @@ def _build_raw(obj: JavaObject):
     if short == "Power":
         return nn.Power(float(f["power"]), float(f.get("scale", 1.0)),
                         float(f.get("shift", 0.0))), {}, {}
+    if short == "Squeeze":
+        dims = f.get("dims")
+        if bool(f.get("batchMode", False)) and dims is None:
+            # squeeze-all + batch-mode re-adds the batch singleton
+            # (Squeeze.scala:58-60) — unrepresentable here, fail loud
+            raise ValueError("bigdl format: Squeeze(batchMode=true, "
+                             "dims=null) has no mapping here")
+        if dims is not None:
+            d = [int(v) for v in np.asarray(dims.values)]
+            if len(d) != 1:
+                raise ValueError(f"bigdl format: Squeeze over dims {d} has "
+                                 "no single-axis mapping here")
+            # reference dims are 1-based including batch
+            return nn.Squeeze(d[0] - 1), {}, {}
+        return nn.Squeeze(), {}, {}
     if short == "ReLU":
         return nn.ReLU(), {}, {}
     if short == "Tanh":
@@ -362,7 +389,7 @@ _FILL_DEFAULTS = {
     "threshold": 0.0, "value": 0.0, "inPlace": False,
 }
 _PARENT_CONTAINER = {"Sequential", "Concat", "ConcatTable", "ParallelTable",
-                     "Recurrent", "BiRecurrent", "Graph"}
+                     "MapTable", "Recurrent", "BiRecurrent", "Graph"}
 _PARENT_CELL = {"RnnCell", "LSTM", "GRU", "LSTMPeephole"}
 _PARENT_AM_DIRECT = {"CAddTable", "CMulTable", "JoinTable", "SplitTable",
                      "NarrowTable", "SelectTable", "FlattenTable",
@@ -473,6 +500,17 @@ def _w_tensor(dc: _DescCache, a: np.ndarray) -> JavaObject:
         "_stride": JavaArray(dc.array("[I"), stride)})
 
 
+def _w_buffer(dc: "_DescCache", items) -> JavaObject:
+    """scala.collection.mutable.ArrayBuffer wire shape (one definition —
+    MapTable, the container branch, and bigdl_seq all share it)."""
+    cd = dc.get("scala.collection.mutable.ArrayBuffer",
+                [("I", "initialSize", None), ("I", "size0", None),
+                 ("[", "array", "[Ljava/lang/Object;")])
+    return JavaObject(cd, {
+        "initialSize": 16, "size0": len(items),
+        "array": JavaArray(dc.array("[Ljava.lang.Object;"), list(items))})
+
+
 def _scales(m) -> dict:
     """The module's real scale_w/scale_b (AbstractModule.scala:73-74
     scaleW/scaleB) so the layer-wise gradient scale survives migration."""
@@ -494,15 +532,34 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
         return JavaObject(cd, vals)
 
     t = "Lcom/intel/analytics/bigdl/tensor/Tensor;"
-    if isinstance(m, (nn.Sequential, nn.Concat, nn.ConcatTable)):
+    if isinstance(m, nn.MapTable):
+        inner = _w_module(dc, m.modules[0], params[0], state[0])
+        cd = dc.get(_PKG + "MapTable",
+                    [("L", "module",
+                      "Lcom/intel/analytics/bigdl/nn/abstractnn/"
+                      "AbstractModule;")])
+        return JavaObject(cd, {
+            "module": inner, "modules": _w_buffer(dc, [inner]),
+            **_scales(m)})
+    if isinstance(m, nn.Squeeze):
+        if m.dim is not None and m.dim < 0:
+            # the reference's squeeze is strictly 1-based positive
+            # (DenseTensor.scala:60) — a negative axis cannot be resolved
+            # without the input rank, so refuse instead of emitting a
+            # stream the JVM rejects at forward time
+            raise ValueError(f"bigdl format save: Squeeze(dim={m.dim}) "
+                             "needs a non-negative axis")
+        return obj("Squeeze",
+                   [("Z", "batchMode", False)],
+                   [("dims", "[I",
+                     JavaArray(dc.array("[I"),
+                               np.asarray([m.dim + 1], np.int32))
+                     if m.dim is not None else None)])
+    if isinstance(m, (nn.Sequential, nn.Concat, nn.ConcatTable,
+                      nn.ParallelTable)):
         kids = [_w_module(dc, c, p, s)
                 for c, p, s in zip(m.modules, params, state)]
-        buf_cd = dc.get("scala.collection.mutable.ArrayBuffer",
-                        [("I", "initialSize", None), ("I", "size0", None),
-                         ("[", "array", "[Ljava/lang/Object;")])
-        buf = JavaObject(buf_cd, {
-            "initialSize": 16, "size0": len(kids),
-            "array": JavaArray(dc.array("[Ljava.lang.Object;"), kids)})
+        buf = _w_buffer(dc, kids)
         # `modules` lives on the Container superclass desc (attached by
         # _DescCache automatically); only class-own fields are declared here
         if isinstance(m, nn.Concat):

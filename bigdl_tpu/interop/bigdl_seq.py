@@ -394,12 +394,8 @@ def _obj(dc, short, prims, objs) -> JavaObject:
 
 
 def _buffer(dc, items) -> JavaObject:
-    cd = dc.get("scala.collection.mutable.ArrayBuffer",
-                [("I", "initialSize", None), ("I", "size0", None),
-                 ("[", "array", "[Ljava/lang/Object;")])
-    return JavaObject(cd, {
-        "initialSize": 16, "size0": len(items),
-        "array": JavaArray(dc.array("[Ljava.lang.Object;"), list(items))})
+    from .bigdl import _w_buffer
+    return _w_buffer(dc, items)
 
 
 def _container(dc, short, children, extra_prims=(), extra_objs=()) \

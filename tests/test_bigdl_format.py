@@ -398,3 +398,32 @@ def test_share_convolution_resnet_style_roundtrip(tmp_path):
     y1, _ = m2.apply(m2.params, m2.state, x)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_paralleltable_maptable_squeeze_roundtrip(tmp_path):
+    """The treeLSTMSentiment front half's plumbing (TreeSentiment.scala:
+    46-51): MapTable's SHARED child (field `module`), ParallelTable over a
+    table input, Squeeze's 1-based dims array."""
+    m = nn.Sequential()
+    ct = nn.ConcatTable()
+    ct.add(nn.Identity())
+    ct.add(nn.Identity())
+    m.add(ct)
+    m.add(nn.MapTable(nn.Squeeze(2)))
+    pt = nn.ParallelTable()
+    pt.add(nn.Linear(6, 4))
+    pt.add(nn.Tanh())
+    m.add(pt)
+    m.add(nn.JoinTable(-1))
+    m.build(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 6, 1))
+    y0, _ = m.apply(m.params, m.state, x)
+    p = str(tmp_path / "tree_front.bigdl")
+    bigdl_fmt.save(m, p)
+    m2 = bigdl_fmt.load(p)
+    assert isinstance(m2.modules[1], nn.MapTable)
+    assert isinstance(m2.modules[2], nn.ParallelTable)
+    assert m2.modules[1].modules[0].dim == 2
+    y1, _ = m2.apply(m2.params, m2.state, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-6)

@@ -336,6 +336,7 @@ def _kitchen_sink_models():
     jt.add(nn.Identity())
     jt.add(nn.Identity())
     joined.add(jt)
+    joined.add(nn.MapTable(nn.Squeeze(1)))
     joined.add(nn.JoinTable(-1, 0))
     joined.add(nn.BatchNormalization(8))
 
