@@ -241,6 +241,10 @@ def _build_raw(obj: JavaObject):
         return nn.View(*sizes), {}, {}
     if short == "CAddTable":
         return nn.CAddTable(bool(f.get("inplace", False))), {}, {}
+    if short == "CMulTable":
+        return nn.CMulTable(), {}, {}
+    if short == "FlattenTable":
+        return nn.FlattenTable(), {}, {}
     if short == "JoinTable":
         dim = int(f.get("dimension", 2))
         if dim != 2:
@@ -440,6 +444,13 @@ class _DescCache:
                             list(_SCONV_FIELDS))
         if short in _PARENT_CONTAINER:
             return self.get(_CONTAINER, [("L", "modules", _BUF_SIG)])
+        if short == "BinaryTreeLSTM":  # extends TreeLSTM (TreeLSTM.scala:25)
+            return self.get(
+                _PKG + "TreeLSTM",
+                [("I", "inputSize", None), ("I", "hiddenSize", None),
+                 ("L", "memZero", _TENSOR_SIG)])
+        if short == "TreeLSTM":
+            return self.get(_AM, list(_AM_FIELDS))
         if short in _PARENT_CELL:
             return self.get(_CELL, [
                 ("[", "hiddensShape", "[I"),
@@ -668,7 +679,8 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
                    [("size", "[I", JavaArray(
                        dc.array("[I"), np.asarray(m.size, np.int32)))])
     simple = {nn.ReLU: "ReLU", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
-              nn.LogSoftMax: "LogSoftMax", nn.Identity: "Identity"}
+              nn.LogSoftMax: "LogSoftMax", nn.Identity: "Identity",
+              nn.CMulTable: "CMulTable", nn.FlattenTable: "FlattenTable"}
     for pycls, short in simple.items():
         if isinstance(m, pycls):
             return obj(short, [], [])

@@ -160,6 +160,9 @@ def build_seq(short: str, obj: JavaObject, build: Callable):
     if short == "Graph":
         return _build_graph(obj, build)
 
+    if short == "BinaryTreeLSTM":
+        return _build_treelstm(obj, build)
+
     return None
 
 
@@ -548,6 +551,9 @@ def write_seq(dc, m, params, state, w_module):
     if isinstance(m, nn.Graph):
         return stamped(_write_graph(dc, m, params, state, w_module))
 
+    if isinstance(m, nn.BinaryTreeLSTM):
+        return stamped(_write_treelstm(dc, m, params, w_module))
+
     if isinstance(m, _InputModule):
         return _simple(dc, "Input")
 
@@ -804,3 +810,227 @@ def _write_graph(dc, m, params, state, w_module) -> JavaObject:
           _buffer(dc, [jnodes[id(n)] for n in m.input_nodes])),
          ("outputs", _BUF_SIG,
           _buffer(dc, [jnodes[id(n)] for n in m.output_nodes]))])
+
+
+# ---------------------------------------------------------------------------
+# BinaryTreeLSTM (treeLSTMSentiment zoo family)
+# ---------------------------------------------------------------------------
+# The reference builds its leaf/composer as Graph modules
+# (BinaryTreeLSTM.scala:59-111, withGraph=true default): leaf
+# c = Linear(I,H)(x), h = Sigmoid(Linear(I,H)(x)) * Tanh(c); composer
+# gates i/lf/rf/update/o each = CAddTable(Linear(H,H)(lh), Linear(H,H)(rh))
+# -> Sigmoid (Tanh for update), c = i*update + lf*lc + rf*rc,
+# h = Sigmoid(o) * Tanh(c).  This framework fuses the ten gate Linears
+# into one (2H, 5H) kernel (nn/tree.py, column order [i, f_l, f_r, o, g]),
+# so load/save re-homes by identifying each gate's ROLE from the node
+# graph: side (lh/rh) from which Input feeds its Linear, role from the
+# activation type and what consumes it (update=Tanh; lf/rf multiply the
+# lc/rc Inputs; i multiplies the update; o is the h gate).
+
+def _jnodes(graph_obj):
+    """All Node objects of a serialized Graph, reachable from inputs."""
+    inputs = _seq_items(graph_obj.fields["inputs"])
+    seen, out, stack = set(), [], list(inputs)
+    while stack:
+        jn = stack.pop()
+        if id(jn) in seen:
+            continue
+        seen.add(id(jn))
+        out.append(jn)
+        stack.extend(_seq_items(jn.fields.get("nexts", [])))
+    return inputs, _seq_items(graph_obj.fields["outputs"]), out
+
+
+def _elem_short(jn):
+    e = jn.fields.get("element")
+    return _short(e.classname) if isinstance(e, JavaObject) else None
+
+
+def _build_treelstm(obj: JavaObject, build):
+    from .. import nn
+
+    f = obj.fields
+    I, H = int(f["inputSize"]), int(f["hiddenSize"])
+    gate_output = bool(f.get("gateOutput", True))
+    if not gate_output:
+        raise ValueError("bigdl format: BinaryTreeLSTM(gateOutput=false) "
+                         "not mapped")
+    if not bool(f.get("withGraph", True)):
+        # withGraph=false builds Sequential/ConcatTable cell trees
+        # (createLeafModuleWithSequential, BinaryTreeLSTM.scala:112-139)
+        raise ValueError("bigdl format: BinaryTreeLSTM(withGraph=false) "
+                         "not mapped (Graph-built cells only)")
+
+    # leaf: Linear feeding a Sigmoid is the o gate; the other is c
+    lin_c = lin_o = None
+    _, _, nodes = _jnodes(f["leafModule"])
+    for jn in nodes:
+        if _elem_short(jn) != "Linear":
+            continue
+        nxts = [_elem_short(n) for n in _seq_items(jn.fields["nexts"])]
+        if "Sigmoid" in nxts:
+            lin_o = jn.fields["element"]
+        else:
+            lin_c = jn.fields["element"]
+    if lin_c is None or lin_o is None:
+        raise ValueError("bigdl format: BinaryTreeLSTM leaf graph not "
+                         "recognized")
+    wc, bc = _ref_linear_wb(lin_c)
+    wo, bo = _ref_linear_wb(lin_o)
+
+    # composer: role-identify the five CAddTable gates
+    inputs, _, nodes = _jnodes(f["composer"])
+    if len(inputs) != 4:
+        raise ValueError("bigdl format: BinaryTreeLSTM composer graph "
+                         f"has {len(inputs)} inputs, expected 4 "
+                         "(lc, lh, rc, rh)")
+    lc_n, lh_n, rc_n, rh_n = inputs
+    gates = {}
+    update_act = None
+    cadds = [jn for jn in nodes
+             if _elem_short(jn) == "CAddTable"
+             and len([p for p in _seq_items(jn.fields["prevs"])
+                      if _elem_short(p) == "Linear"]) == 2]
+    for jn in cadds:
+        w_side = {}
+        for p in _seq_items(jn.fields["prevs"]):
+            if _elem_short(p) != "Linear":
+                continue
+            feeder = _seq_items(p.fields["prevs"])[0]
+            if feeder is lh_n:
+                w_side["l"] = p.fields["element"]
+            elif feeder is rh_n:
+                w_side["r"] = p.fields["element"]
+        acts = [n for n in _seq_items(jn.fields["nexts"])
+                if _elem_short(n) in ("Sigmoid", "Tanh")]
+        if len(w_side) != 2 or len(acts) != 1:
+            raise ValueError("bigdl format: BinaryTreeLSTM composer gate "
+                             "not recognized")
+        act = acts[0]
+        if _elem_short(act) == "Tanh":
+            role = "g"
+            update_act = act
+        else:
+            role = None
+            for consumer in _seq_items(act.fields["nexts"]):
+                if _elem_short(consumer) != "CMulTable":
+                    continue
+                partners = [p for p in _seq_items(consumer.fields["prevs"])
+                            if p is not act]
+                for partner in partners:
+                    if partner is lc_n:
+                        role = "f_l"
+                    elif partner is rc_n:
+                        role = "f_r"
+            if role is None:
+                role = "_sigmoid_pending"
+        gates[id(jn)] = (role, w_side, act)
+
+    # second pass: i multiplies the update Tanh; o is the remaining one
+    roles = {}
+    for role, w_side, act in gates.values():
+        if role == "_sigmoid_pending":
+            is_i = any(
+                update_act is not None and partner is update_act
+                for consumer in _seq_items(act.fields["nexts"])
+                if _elem_short(consumer) == "CMulTable"
+                for partner in _seq_items(consumer.fields["prevs"])
+                if partner is not act)
+            role = "i" if is_i else "o"
+        roles[role] = w_side
+    if sorted(roles) != ["f_l", "f_r", "g", "i", "o"]:
+        raise ValueError(f"bigdl format: BinaryTreeLSTM composer roles "
+                         f"{sorted(roles)} incomplete")
+
+    cols = {"i": 0, "f_l": 1, "f_r": 2, "o": 3, "g": 4}
+    comp_w = np.zeros((2 * H, 5 * H), np.float32)
+    comp_b = np.zeros((5 * H,), np.float32)
+    for role, w_side in roles.items():
+        c0 = cols[role] * H
+        wl, bl = _ref_linear_wb(w_side["l"])
+        wr, br = _ref_linear_wb(w_side["r"])
+        comp_w[:H, c0:c0 + H] = wl.T
+        comp_w[H:, c0:c0 + H] = wr.T
+        comp_b[c0:c0 + H] = ((bl if bl is not None else 0.0)
+                             + (br if br is not None else 0.0))
+
+    m = nn.BinaryTreeLSTM(I, H, gate_output)
+    p = {"leaf_c": wc.T.copy(), "leaf_cb": np.asarray(bc, np.float32),
+         "leaf_o": wo.T.copy(), "leaf_ob": np.asarray(bo, np.float32),
+         "comp_w": comp_w, "comp_b": comp_b}
+    return m, p, {}
+
+
+def _write_treelstm(dc, m, params, w_module):
+    """Emit the reference-shaped leaf/composer Graphs with re-homed
+    weights, then the BinaryTreeLSTM object around them."""
+    from .. import nn
+    from .bigdl import _w_buffer, _w_tensor
+
+    if not m.gate_output:
+        # the load path refuses gateOutput=false streams; emitting one
+        # here would silently write o-gated graphs a real JVM computes
+        # differently with
+        raise ValueError("bigdl format save: "
+                         "BinaryTreeLSTM(gate_output=False) not mapped")
+    I, H = m.input_size, m.hidden_size
+    cols = {"i": 0, "f_l": 1, "f_r": 2, "o": 3, "g": 4}
+    comp_w = np.asarray(params["comp_w"])
+    comp_b = np.asarray(params["comp_b"])
+
+    lin_params = {}
+
+    def linear(w_out_in, b):
+        lin = nn.Linear(w_out_in.shape[1], w_out_in.shape[0])
+        lin_params[id(lin)] = {"weight": np.asarray(w_out_in, np.float32),
+                               "bias": np.asarray(b, np.float32)}
+        return lin
+
+    # leaf graph (BinaryTreeLSTM.scala:59-76)
+    inp = nn.Input()
+    c = linear(np.asarray(params["leaf_c"]).T, params["leaf_cb"])(inp)
+    o = nn.Sigmoid()(
+        linear(np.asarray(params["leaf_o"]).T, params["leaf_ob"])(inp))
+    h = nn.CMulTable()([o, nn.Tanh()(c)])
+    leaf_graph = nn.Graph(inp, [c, h])
+
+    # composer graph (:78-111)
+    lc, lh, rc, rh = (nn.Input() for _ in range(4))
+
+    def gate(role):
+        c0 = cols[role] * H
+        wl = comp_w[:H, c0:c0 + H].T      # (H, H) out,in
+        wr = comp_w[H:, c0:c0 + H].T
+        # the fused bias goes to the lh-side Linear; rh-side gets zeros
+        add = nn.CAddTable()([linear(wl, comp_b[c0:c0 + H])(lh),
+                              linear(wr, np.zeros(H, np.float32))(rh)])
+        act = nn.Tanh() if role == "g" else nn.Sigmoid()
+        return act(add)
+
+    gi, gfl, gfr, gu = gate("i"), gate("f_l"), gate("f_r"), gate("g")
+    go = gate("o")
+    c2 = nn.CAddTable()([nn.CMulTable()([gi, gu]),
+                         nn.CMulTable()([gfl, lc]),
+                         nn.CMulTable()([gfr, rc])])
+    h2 = nn.CMulTable()([go, nn.Tanh()(c2)])
+    comp_graph = nn.Graph([lc, lh, rc, rh], [c2, h2])
+
+    def graph_obj(g):
+        ps = [lin_params.get(id(mod), {}) for mod in g.modules]
+        ss = [{} for _ in g.modules]
+        return _write_graph(dc, g, ps, ss, w_module)
+
+    leaf_obj = graph_obj(leaf_graph)
+    comp_obj = graph_obj(comp_graph)
+    tree = _obj(dc, "BinaryTreeLSTM",
+                [("Z", "gateOutput", True), ("Z", "withGraph", True)],
+                [("composer", _MODULE_SIG, comp_obj),
+                 ("leafModule", _MODULE_SIG, leaf_obj),
+                 ("composers", _BUF_SIG, _w_buffer(dc, [comp_obj])),
+                 ("leafModules", _BUF_SIG, _w_buffer(dc, [leaf_obj])),
+                 ("cells", _BUF_SIG, _w_buffer(dc, []))])
+    # TreeLSTM super-desc fields (inputSize/hiddenSize/memZero)
+    tree.fields["inputSize"] = I
+    tree.fields["hiddenSize"] = H
+    tree.fields["memZero"] = _w_tensor(dc, np.zeros(H, np.float32))
+    return tree

@@ -65,12 +65,16 @@ class BinaryTreeLSTM(TreeLSTM):
         self.gate_output = gate_output
 
     def _init(self, rng):
-        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        k1, k2, k3, k4, k5, k6 = jax.random.split(rng, 6)
         stdv = 1.0 / (self.hidden_size ** 0.5)
         h = self.hidden_size
         return {
+            # the reference leaf Linears carry biases
+            # (BinaryTreeLSTM.scala:61-63) — kept for wire-format parity
             "leaf_c": _uniform(k1, (self.input_size, h), stdv),
+            "leaf_cb": _uniform(k5, (h,), stdv),
             "leaf_o": _uniform(k2, (self.input_size, h), stdv),
+            "leaf_ob": _uniform(k6, (h,), stdv),
             # composer: [h_l, h_r] -> 5 gates (i, f_l, f_r, o, g)
             "comp_w": _uniform(k3, (2 * h, 5 * h), stdv),
             "comp_b": _uniform(k4, (5 * h,), stdv),
@@ -78,9 +82,11 @@ class BinaryTreeLSTM(TreeLSTM):
 
     def _leaf(self, params, x):
         cd = get_policy().compute_dtype
-        c = x.astype(cd) @ params["leaf_c"].astype(cd)
+        c = x.astype(cd) @ params["leaf_c"].astype(cd) + params["leaf_cb"]
         if self.gate_output:
-            o = jax.nn.sigmoid(x.astype(cd) @ params["leaf_o"].astype(cd))
+            o = jax.nn.sigmoid(
+                x.astype(cd) @ params["leaf_o"].astype(cd)
+                + params["leaf_ob"])
             h = o * jnp.tanh(c)
         else:
             h = jnp.tanh(c)

@@ -367,3 +367,40 @@ def test_reader_lstmpeephole_matches_reference_equations():
         expect.append(h)
     np.testing.assert_allclose(np.asarray(y), np.stack(expect, 1),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_binarytreelstm_roundtrip(tmp_path):
+    """BinaryTreeLSTM (BinaryTreeLSTM.scala:36, withGraph=true): the ten
+    composer gate Linears re-home into the fused (2H,5H) kernel by graph
+    ROLE (update=Tanh, f_l/f_r multiply the lc/rc Inputs, i multiplies
+    the update, o gates h) — and back out into the reference-shaped
+    leaf/composer Graphs."""
+    m = nn.Sequential()
+    m.add(nn.BinaryTreeLSTM(6, 5))
+    m.build(jax.random.PRNGKey(3))
+    # a tiny batch of two 3-leaf trees: nodes [leaf0, leaf1, (0,1), ...]
+    inputs = jnp.asarray(_rand((2, 3, 6), 21))
+    children = jnp.asarray(
+        np.tile(np.array([[-1, -1], [-1, -1], [0, 1], [-1, -1]],
+                         np.int32), (2, 1, 1)))
+    leaf_ids = jnp.asarray(
+        np.tile(np.array([0, 1, -1, -1], np.int32), (2, 1)))
+    x = (inputs, children, leaf_ids)
+    y0, _ = m.apply(m.params, m.state, x)
+
+    p = str(tmp_path / "tree.bigdl")
+    bigdl_fmt.save(m, p)
+    raw = open(p, "rb").read()
+    assert b"BinaryTreeLSTM" in raw and b"TreeLSTM" in raw
+    m2 = bigdl_fmt.load(p)
+    assert isinstance(m2.modules[0], nn.BinaryTreeLSTM)
+    y1, _ = m2.apply(m2.params, m2.state, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-5)
+    # second generation stability
+    p2 = str(tmp_path / "tree2.bigdl")
+    bigdl_fmt.save(m2, p2)
+    m3 = bigdl_fmt.load(p2)
+    y2, _ = m3.apply(m3.params, m3.state, x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                               rtol=1e-6, atol=1e-6)

@@ -357,6 +357,9 @@ def _kitchen_sink_models():
     text.add(nn.LookupTable(10, 8, one_based=True))
     text.add(nn.TemporalConvolution(8, 6, 3))
 
+    tree = nn.Sequential()
+    tree.add(nn.BinaryTreeLSTM(4, 5))
+
     inp = nn.Input()
     h = nn.Linear(5, 5)(inp)
     a = nn.ReLU()(h)
@@ -364,7 +367,7 @@ def _kitchen_sink_models():
     out = nn.CAddTable()([a, b])
     graph = nn.Graph(inp, out)
 
-    return [cnn, joined, rnn, lstm, gru, peep, text, graph]
+    return [cnn, joined, rnn, lstm, gru, peep, text, tree, graph]
 
 
 # ---------------------------------------------------------------------------
