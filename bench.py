@@ -640,6 +640,11 @@ def main(argv=None):
                          "roofline, scaling, and timing-chain fetches "
                          "(--stall-seconds covers the remaining, "
                          "quick-transition stages)")
+    ap.add_argument("--chaos", default=None,
+                    help="fault-injection spec (bigdl_tpu.utils.chaos), "
+                         "e.g. 'fs.remote=fail*2@1;data.batch=fail@6' — "
+                         "measure throughput WITH the robustness machinery "
+                         "exercised; deterministic count-based schedules")
     args = ap.parse_args(argv)
     t_start = time.perf_counter()
     _beat("init")
@@ -651,6 +656,10 @@ def main(argv=None):
             _jax.config.update("jax_platforms", args.platform)
         except RuntimeError:
             pass
+    if args.chaos:
+        from bigdl_tpu.utils import chaos as _chaos
+        _chaos.install(args.chaos)
+        _log(f"chaos schedules installed: {args.chaos}")
     # persistent XLA cache: warm compiles across processes — the difference
     # between LeNet's pathological 800s+ compile fitting the budget or
     # stalling (utils/platform.py; BIGDL_TPU_XLA_CACHE=0 disables)

@@ -83,6 +83,11 @@ _SUID = {
     _PKG + "MapTable": 4403280698280280268,
     _PKG + "Squeeze": 7998127436291978408,
     _PKG + "CMul": 8888147326550637025,  # same literal as CMulTable in src
+    # JDK box classes (MulConstant/AddConstant's derived `scalar: T` field
+    # erases to a boxed java.lang.Float) — SUIDs are JDK spec constants
+    "java.lang.Number": -8742448824652078965,
+    "java.lang.Float": -2671257302660747028,
+    "java.lang.Double": -9172774392245257468,
     # Recurrent / RnnCell / TimeDistributed / TemporalConvolution /
     # AbstractModule / Cell / BiRecurrent / Reverse carry no
     # @SerialVersionUID annotation in the reference source; the JVM
@@ -658,7 +663,12 @@ def _w_module(dc: _DescCache, m, params, state) -> JavaObject:
                     ("I", "dH", sh), ("I", "padW", pw), ("I", "padH", ph)],
                    [])
     if isinstance(m, nn.Dropout):
-        return obj("Dropout", [("D", "initP", float(m.p))], [])
+        # initP (ctor) plus the DERIVED runtime fields updateOutput reads:
+        # `private var p = initP`, inplace, scale — a stream without them
+        # deserializes with JOS zero-defaults (p=0.0: dropout silently off)
+        return obj("Dropout",
+                   [("D", "initP", float(m.p)), ("D", "p", float(m.p)),
+                    ("Z", "inplace", False), ("Z", "scale", True)], [])
     if isinstance(m, nn.SpatialCrossMapLRN):
         return obj("SpatialCrossMapLRN",
                    [("I", "size", m.size), ("D", "alpha", float(m.alpha)),

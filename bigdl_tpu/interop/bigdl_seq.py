@@ -90,7 +90,14 @@ def _find_linears(obj) -> List[JavaObject]:
 
 def _seq_items(v) -> list:
     """Items of a serialized scala sequence (ArrayBuffer / plain array /
-    WrappedArray)."""
+    WrappedArray).  None and plain (possibly empty) Python sequences mean
+    "no elements" — callers pass `fields.get("nexts", [])`, and a Node
+    with a null/absent successor buffer must read as a leaf, not as an
+    'unsupported scala sequence encoding' error."""
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [x for x in v if x is not None]
     if isinstance(v, JavaArray):
         return [x for x in v.values if x is not None]
     if isinstance(v, JavaObject):
@@ -401,6 +408,45 @@ def _buffer(dc, items) -> JavaObject:
     return _w_buffer(dc, items)
 
 
+_OBJ_SIG = "Ljava/lang/Object;"
+
+
+def _boxed_float(dc, v: float) -> JavaObject:
+    """A java.lang.Float box — the erased value of a Scala `val x: T`
+    field under TensorNumeric[Float].  Real JDK SUIDs (spec constants), so
+    an actual ObjectInputStream resolves the boxes."""
+    num_cd = dc.get("java.lang.Number", [])
+    cd = dc.get("java.lang.Float", [("F", "value", None)],
+                super_desc=num_cd)
+    return JavaObject(cd, {"value": float(v)})
+
+
+def _dropout(dc, init_p: float) -> JavaObject:
+    """Dropout with the DERIVED runtime field the JVM's updateOutput reads
+    (`private var p = initP`) — a stream carrying only initP deserializes
+    with p = 0.0 (JOS missing-field default) and drops nothing/everything
+    wrongly on a real BigDL."""
+    return _obj(dc, "Dropout",
+                [("D", "initP", float(init_p)), ("D", "p", float(init_p)),
+                 ("Z", "inplace", False), ("Z", "scale", True)], [])
+
+
+def _mul_constant(dc, v: float) -> JavaObject:
+    # `scalar` is a derived non-transient val (ev.fromType(constant)) the
+    # reference's updateOutput multiplies by — omit it and a JVM load
+    # computes with scalar = null (NPE) despite a well-formed stream
+    return _obj(dc, "MulConstant",
+                [("D", "constant", float(v)), ("Z", "inplace", False)],
+                [("scalar", _OBJ_SIG, _boxed_float(dc, v))])
+
+
+def _add_constant(dc, v: float) -> JavaObject:
+    return _obj(dc, "AddConstant",
+                [("D", "constant_scalar", float(v)),
+                 ("Z", "inplace", False)],
+                [("scalar", _OBJ_SIG, _boxed_float(dc, v))])
+
+
 def _container(dc, short, children, extra_prims=(), extra_objs=()) \
         -> JavaObject:
     # `modules` is declared on the Container SUPER desc (attached by
@@ -640,12 +686,12 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
         kernel = np.asarray(cp["kernel"])
         wi = kernel[:I].T                      # (4H, I), chunks [i,f,g,o]
         bi = np.asarray(cp["bias"])
-        pre = _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+        pre = _seq(dc, _dropout(dc, 0.0),
                    _time_distributed(dc, _linear(dc, wi, bi)))
 
         def h2h_seq(chunk):
             w = kernel[I:, chunk * H:(chunk + 1) * H].T    # (H, H)
-            return _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+            return _seq(dc, _dropout(dc, 0.0),
                         _linear(dc, w, None))
 
         def cmul(weight):
@@ -742,7 +788,7 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
                                _simple(dc, "CMulTable"))),
             _parallel_table(
                 dc, _simple(dc, "Identity"),
-                _seq(dc, _obj(dc, "Dropout", [("D", "initP", 0.0)], []),
+                _seq(dc, _dropout(dc, 0.0),
                      _linear(dc, whh, None))),
             _cadd(dc, True), _simple(dc, "Tanh"))
         gru = _seq(
@@ -755,12 +801,8 @@ def _write_recurrent(dc, m, params, state) -> JavaObject:
                          dc, h_hat,
                          _seq(dc,
                               _select(dc, 4),
-                              _obj(dc, "MulConstant",
-                                   [("D", "constant", -1.0),
-                                    ("Z", "inplace", False)], []),
-                              _obj(dc, "AddConstant",
-                                   [("D", "constant_scalar", 1.0),
-                                    ("Z", "inplace", False)], []))),
+                              _mul_constant(dc, -1.0),
+                              _add_constant(dc, 1.0))),
                      _simple(dc, "CMulTable")),
                 _seq(dc, _concat_table(dc, _select(dc, 2), _select(dc, 4)),
                      _simple(dc, "CMulTable"))),

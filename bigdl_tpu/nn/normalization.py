@@ -218,13 +218,19 @@ class BatchNormalization(Module):
             # over the caller's axis with psum directly
             return self._apply_pallas_sync(params, state, x,
                                            self.sync_axis, interpret)
+        # mesh route FIRST (matching ConvBN.apply): under an explicit
+        # pallas_interpret opt-in on a multi-device data mesh, the layer
+        # must still wrap the kernel in shard_map — the single-device
+        # pallas_call is opaque to GSPMD and would be all-gathered onto
+        # every chip inside a multi-device jit
+        if jax.device_count() > 1:
+            from ..utils.engine import Engine
+            mesh = Engine._mesh
+            if self.shardmap_route_engages(mesh, x.shape[0]):
+                return self._apply_pallas_shardmap(params, state, x, mesh,
+                                                   interpret)
         if impl == "pallas_interpret" or jax.device_count() == 1:
             return self._apply_pallas(params, state, x, axes, interpret)
-        from ..utils.engine import Engine
-        mesh = Engine._mesh
-        if self.shardmap_route_engages(mesh, x.shape[0]):
-            return self._apply_pallas_shardmap(params, state, x, mesh,
-                                               interpret)
         return None
 
     @staticmethod

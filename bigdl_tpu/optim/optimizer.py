@@ -35,6 +35,8 @@ from __future__ import annotations
 import itertools
 import logging
 import math
+import os
+import re
 import time
 from functools import lru_cache, partial
 from typing import List, Optional, Sequence
@@ -50,7 +52,7 @@ from ..dataset.sample import Sample
 from ..nn.module import Criterion, Module
 from ..parallel.sharding import DataParallel, ShardingStrategy
 from ..utils.engine import Engine
-from ..utils import file_io
+from ..utils import chaos, file_io
 from .method import OptimMethod, SGD
 from .metrics import Metrics
 from .trigger import Trigger
@@ -60,7 +62,7 @@ logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
            "Predictor", "Validator", "DistriValidator", "LocalValidator",
-           "ConfigurationError", "TrainingPreempted"]
+           "ConfigurationError", "TrainingPreempted", "NonFiniteLossError"]
 
 
 def _as_dataset(dataset):
@@ -95,6 +97,14 @@ class TrainingPreempted(RuntimeError):
     exception, which the retry loop re-raises immediately — the process is
     being evicted, recovery happens on the NEXT incarnation via the normal
     checkpoint-resume path."""
+
+
+class NonFiniteLossError(RuntimeError):
+    """The host-observed training loss went NaN/Inf.  Raised into the
+    retry loop exactly like the reference's NaN check
+    (DistriOptimizer.scala's driver requires a finite lossSum): recovery
+    reloads the newest VALID snapshot instead of silently optimizing
+    garbage for the rest of the run."""
 
 
 def _any_deleted(tree) -> bool:
@@ -255,6 +265,10 @@ class Optimizer:
         self.checkpoint_trigger = None
         self.checkpoint_path = None
         self.is_overwrite = True
+        self.ckpt_keep_last = None
+        self.ckpt_keep_every_epochs = None
+        self._ckpt_keepers = set()
+        self._kept_epoch_block = 0
         self.train_summary = None
         self.validation_summary = None
         self.grad_clip_norm = None
@@ -306,16 +320,28 @@ class Optimizer:
 
     def set_checkpoint(self, path: str, trigger: Trigger,
                        is_overwrite: bool = True,
-                       async_write: bool = False):
+                       async_write: bool = False,
+                       keep_last: Optional[int] = None,
+                       keep_every_epochs: Optional[int] = None):
         """async_write=True snapshots to host synchronously but performs
         pickling + filesystem IO on a background thread
         (file_io.save_checkpoint_async) — the train loop does not stall
         on multi-GB writes; pending writes are joined before recovery
-        reads and at the end of the run."""
+        reads and at the end of the run.
+
+        Retention (net-new vs the reference, whose overwrite=true relied
+        on same-name clobbering): `keep_last` bounds the lineage to the
+        newest K snapshot pairs; `keep_every_epochs` additionally marks
+        the first snapshot of every N-th epoch as a permanent keeper
+        (long-horizon rollback points).  None defers to the
+        BIGDL_TPU_CKPT_KEEP_LAST / _CKPT_KEEP_EVERY_EPOCHS env knobs;
+        0 disables.  Quarantined ``.corrupt`` files are never pruned."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.is_overwrite = is_overwrite
         self.checkpoint_async = async_write
+        self.ckpt_keep_last = keep_last
+        self.ckpt_keep_every_epochs = keep_every_epochs
         return self
 
     def set_train_summary(self, summary):
@@ -661,12 +687,48 @@ class Optimizer:
         """Resume from explicit snapshot files — the reference's
         `--model model.<n> --state optimMethod.<n>` CLI contract
         (models/lenet/Train.scala:48-59).  With only a model snapshot the
-        optimizer restarts fresh on the loaded weights."""
+        optimizer restarts fresh on the loaded weights.
+
+        A snapshot that fails integrity verification is quarantined
+        (``.corrupt``) and, when the path follows the ``model.<n>``
+        lineage naming, resume falls back to the newest VALID older
+        snapshot in the same directory — loudly.  With no valid fallback
+        the CorruptCheckpoint propagates."""
+        try:
+            return self._load_snapshot(model_path, optim_path)
+        except file_io.CorruptCheckpoint as e:
+            logger.warning("snapshot %s failed verification (%s); "
+                           "quarantining and falling back to the newest "
+                           "valid snapshot", model_path, e)
+            file_io.quarantine_checkpoint(model_path, optim_path)
+            base, name = os.path.dirname(model_path), \
+                os.path.basename(model_path)
+            m = re.fullmatch(r"model\.(\d+)", name)
+            if base and m and self._lineage_resume(base,
+                                                   below=int(m.group(1))):
+                return self
+            raise
+
+    def _load_snapshot(self, model_path: str,
+                       optim_path: Optional[str] = None) -> "Optimizer":
+        """Load + verify one snapshot pair, then install it (both blobs are
+        read and structurally checked BEFORE any state is mutated, so a
+        corrupt optimMethod file cannot leave half-resumed state)."""
         blob = file_io.load(model_path)
-        self.model.params = blob["params"]
-        self.model.state = blob["state"]
+        if not isinstance(blob, dict) or "params" not in blob \
+                or "state" not in blob:
+            raise file_io.CorruptCheckpoint(
+                f"{model_path}: not a model snapshot blob")
+        oblob = None
         if optim_path is not None:
             oblob = file_io.load(optim_path)
+            if not isinstance(oblob, dict) or "method" not in oblob \
+                    or "driver_state" not in oblob:
+                raise file_io.CorruptCheckpoint(
+                    f"{optim_path}: not an optimMethod snapshot blob")
+        self.model.params = blob["params"]
+        self.model.state = blob["state"]
+        if oblob is not None:
             self.optim_method.load_state_dict(oblob["method"])
             self._resume_state = oblob["driver_state"]
             self._resume_opt_state = oblob.get("opt_state")
@@ -678,34 +740,61 @@ class Optimizer:
         self._compiled = None
         return self
 
+    def _lineage_resume(self, path: str, below: Optional[int] = None) \
+            -> bool:
+        """Walk the checkpoint lineage newest-first, quarantining corrupt
+        snapshots, until one loads (True) or the lineage is exhausted
+        (False).  `below` restricts to snapshots older than that neval
+        (resume_from's explicit-file fallback)."""
+        skipped = []
+        for mp, op, n in file_io.checkpoint_lineage(path):
+            if below is not None and n >= below:
+                continue
+            try:
+                self._load_snapshot(mp, op)
+                if skipped:
+                    logger.warning(
+                        "recovery skipped corrupt snapshot(s) %s; resumed "
+                        "from iteration %d (%s)", skipped, n, mp)
+                else:
+                    logger.info("recovered from checkpoint %s "
+                                "(iteration %d)", mp, n)
+                return True
+            except file_io.CorruptCheckpoint as e:
+                logger.warning("checkpoint %s failed verification (%s); "
+                               "quarantining and walking back the lineage",
+                               mp, e)
+                file_io.quarantine_checkpoint(mp, op)
+                skipped.append(n)
+        return False
+
     def _recover_from_checkpoint(self):
         # in-flight writes must land before the directory scan; a FAILED
         # write must not abort recovery (older snapshots remain valid, and
         # sync-write errors would have been retried the same way)
         self._drain_ckpt_futures(context="recovery")
-        latest = file_io.latest_checkpoint(self.checkpoint_path)
-        if latest is None:
-            # failure before the first snapshot: the crashed attempt's
-            # buffers were donated to the compiled step (deleted), so a
-            # bare re-run would crash on device_put — restore the starting
-            # weights captured at optimize() entry (the reference restarts
-            # from the initial model, DistriOptimizer.scala:828-845);
-            # fresh-init only if the model was never built by then
-            if _any_deleted(self.model.params) or \
-                    _any_deleted(self.model.state):
-                blob = getattr(self, "_initial_blob", None)
-                if blob is not None:
-                    logger.warning("no checkpoint yet; restoring the "
-                                   "initial weights for the retry")
-                    self.model.params = jax.tree.map(jnp.asarray, blob[0])
-                    self.model.state = jax.tree.map(jnp.asarray, blob[1])
-                else:
-                    logger.warning("no checkpoint yet; re-initializing "
-                                   "model for the retry")
-                    self.model.build()
+        if self.checkpoint_path is not None and \
+                self._lineage_resume(self.checkpoint_path):
             return
-        model_path, optim_path, neval = latest
-        self.resume_from(model_path, optim_path)
+        # no valid snapshot anywhere (none written yet, or every one
+        # quarantined): the crashed attempt's buffers were donated to the
+        # compiled step (deleted), so a bare re-run would crash on
+        # device_put — restore the starting weights captured at optimize()
+        # entry (the reference restarts from the initial model,
+        # DistriOptimizer.scala:828-845); fresh-init only if the model was
+        # never built by then
+        if _any_deleted(self.model.params) or \
+                _any_deleted(self.model.state):
+            blob = getattr(self, "_initial_blob", None)
+            if blob is not None:
+                logger.warning("no valid checkpoint; restoring the "
+                               "initial weights for the retry")
+                self.model.params = jax.tree.map(jnp.asarray, blob[0])
+                self.model.state = jax.tree.map(jnp.asarray, blob[1])
+            else:
+                logger.warning("no valid checkpoint; re-initializing "
+                               "model for the retry")
+                self.model.build()
 
     def _check_accum_batching(self):
         """Fail at optimize() start (not mid-epoch on the final partial
@@ -816,6 +905,10 @@ class Optimizer:
                 batch = next(data_iter, None)
                 if batch is None or self.end_trigger(state):
                     break
+                # chaos fault point: one count per training minibatch — a
+                # fail@ schedule lands in the retry loop like any transient
+                # data-pipeline failure (the reference's ExceptionTest)
+                chaos.fire("data.batch")
                 data_wait = time.perf_counter() - data_t0
                 self.metrics.add("get batch time average", data_wait)
                 if self._straggler_check(data_wait, state["neval"]):
@@ -833,13 +926,14 @@ class Optimizer:
                 # therefore act on a 1-iteration-stale value instead of forcing
                 # a device sync every step.
                 if pending_loss is not None:
-                    state["loss"] = float(pending_loss)
+                    state["loss"] = self._observe_loss(
+                        float(pending_loss), state)
                 pending_loss = loss
                 n = batch.size()
                 epoch_records += n
                 neval = state["neval"]
                 if neval % self.log_interval == 0:
-                    lossf = float(loss)
+                    lossf = self._observe_loss(float(loss), state)
                     state["loss"] = lossf
                     pending_loss = None
                     dt = time.perf_counter() - iter_start
@@ -899,7 +993,8 @@ class Optimizer:
                         "Optimizer.resume_from or the retry loop of the "
                         "next incarnation")
             if pending_loss is not None:
-                state["loss"] = float(pending_loss)
+                state["loss"] = self._observe_loss(float(pending_loss),
+                                                   state)
                 pending_loss = None
 
             wall = time.perf_counter() - epoch_start
@@ -945,6 +1040,20 @@ class Optimizer:
         self._final_opt_state = opt_state
         self._initial_blob = None  # release the host copy (run succeeded)
         return model
+
+    def _observe_loss(self, lossf: float, state) -> float:
+        """Every host materialization of the training loss funnels through
+        here: the ``step.loss_nan`` chaos point may corrupt it (tests), and
+        a non-finite value raises NonFiniteLossError into the retry loop —
+        the reference's driver-side NaN check, instead of silently
+        optimizing garbage for the rest of the run."""
+        lossf = chaos.transform("step.loss_nan", lossf)
+        if not math.isfinite(lossf):
+            raise NonFiniteLossError(
+                f"non-finite training loss {lossf} observed at iteration "
+                f"{state['neval']} (epoch {state['epoch']}); recovering "
+                "from the newest valid checkpoint")
+        return lossf
 
     # -- trigger hooks --------------------------------------------------
 
@@ -1154,6 +1263,37 @@ class Optimizer:
                     "queued (async)" if is_async else "written",
                     neval, self.checkpoint_path,
                     " (preemption final snapshot)" if preempt else "")
+        self._apply_retention(neval, state)
+
+    def _apply_retention(self, neval, state):
+        """Keep-last-K + keep-every-N-epochs pruning after each write
+        (rank 0 only — callers are already past the rank gate).  Pruning
+        is best-effort: a storage hiccup here must never take down
+        training.  Async-pending writes are invisible to the listdir and
+        simply join the lineage before the next prune."""
+        from ..utils import config
+        keep_last = self.ckpt_keep_last
+        if keep_last is None:
+            keep_last = config.get_int("CKPT_KEEP_LAST", 0)
+        every = self.ckpt_keep_every_epochs
+        if every is None:
+            every = config.get_int("CKPT_KEEP_EVERY_EPOCHS", 0)
+        if every > 0:
+            block = state["epoch"] // every
+            if block > self._kept_epoch_block:
+                # first snapshot at-or-past every N-th epoch boundary
+                # becomes a permanent rollback point
+                self._kept_epoch_block = block
+                self._ckpt_keepers.add(neval)
+                logger.info("retention: snapshot %d marked as epoch-%d "
+                            "keeper", neval, state["epoch"])
+        if keep_last > 0:
+            try:
+                file_io.prune_checkpoints(self.checkpoint_path, keep_last,
+                                          keep=self._ckpt_keepers)
+            except Exception as e:  # noqa: BLE001 — retention never fatal
+                logger.warning("retention pruning failed (non-fatal): %s",
+                               e)
 
 
 class DistriOptimizer(Optimizer):

@@ -1,0 +1,301 @@
+"""Fault-injection subsystem: named fault points with deterministic schedules.
+
+Reference: the reference's only fault-injection device is the
+`ExceptionTest` layer scheduled by invocation count
+(test/.../utils/TestUtils.scala:103, DistriOptimizerSpec.scala:89-97).
+This module generalizes that count-scheduled determinism into a first-class
+chaos layer the whole runtime shares: production code declares *fault
+points* (one `fire`/`transform` call per operation), tests and `bench.py
+--chaos` attach *schedules* to them.  Everything is counter-driven — no
+wall clock, no RNG — so every chaos run is exactly reproducible.
+
+Fault points wired into the runtime:
+
+| point           | where it fires                                | kind      |
+|-----------------|-----------------------------------------------|-----------|
+| ``ckpt.write``  | once per checkpoint blob written (file_io)    | fail/corrupt |
+| ``ckpt.read``   | once per checkpoint blob read (file_io)       | fail/corrupt |
+| ``fs.remote``   | once per remote filesystem op *attempt*       | fail      |
+| ``data.batch``  | once per training minibatch (driver loop)     | fail      |
+| ``step.loss_nan``| once per host loss observation (driver loop) | nan       |
+
+Schedules (1-based counts):
+
+- ``FailAt(3, 5)`` — raise on exactly those invocation counts
+- ``FailN(2, start=4)`` — raise on counts 4 and 5 (fail-n-times)
+- ``CorruptAt(2)`` / ``CorruptAt(2, mode="truncate")`` — mutate the
+  payload passing through ``transform`` (bytes: flip/truncate; floats:
+  NaN) on those counts
+
+Env/config spec (``BIGDL_TPU_CHAOS``), `;`-separated points::
+
+    ckpt.write=corrupt@3;fs.remote=fail*2@1;data.batch=fail@6
+
+`fail` raises :class:`ChaosFault` (a RuntimeError: the optimizer retry
+loop and the IO retry layer treat it like any transient failure).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "register",
+           "install", "clear", "reset", "armed", "fire", "transform",
+           "scoped", "counts", "FAULT_POINTS"]
+
+FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
+                "step.loss_nan")
+
+
+class ChaosFault(RuntimeError):
+    """An injected failure (point + invocation count in the message)."""
+
+
+class FailAt:
+    """Raise on exactly the given 1-based invocation counts."""
+
+    def __init__(self, *counts: int):
+        self.counts = frozenset(int(c) for c in counts)
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):  # fail schedules never mutate
+        raise AssertionError("FailAt has no payload mutation")
+
+    is_fail = True
+
+    def __repr__(self):
+        return f"FailAt({sorted(self.counts)})"
+
+
+class FailN:
+    """Raise on `n` consecutive counts starting at `start` (fail-n-times:
+    the reference's transient-fault shape — down, then back up)."""
+
+    def __init__(self, n: int, start: int = 1):
+        self.n, self.start = int(n), int(start)
+
+    def fires(self, count: int) -> bool:
+        return self.start <= count < self.start + self.n
+
+    def mutate(self, value):
+        raise AssertionError("FailN has no payload mutation")
+
+    is_fail = True
+
+    def __repr__(self):
+        return f"FailN({self.n}, start={self.start})"
+
+
+class CorruptAt:
+    """Mutate the payload at the given counts instead of raising.
+
+    bytes payloads: ``mode="flip"`` XORs a span in the middle (same length
+    — a bit-rot tear the CRC frame must catch), ``mode="truncate"`` drops
+    the tail (a torn write).  float payloads become NaN regardless of mode
+    (the ``step.loss_nan`` sentinel)."""
+
+    def __init__(self, *counts: int, mode: str = "flip"):
+        if mode not in ("flip", "truncate"):
+            raise ValueError(f"CorruptAt: unknown mode {mode!r}")
+        self.counts = frozenset(int(c) for c in counts)
+        self.mode = mode
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):
+        if isinstance(value, (bytes, bytearray)):
+            data = bytes(value)
+            if self.mode == "truncate":
+                return data[:max(len(data) // 2, 0)]
+            if not data:
+                return data
+            mid = len(data) // 2
+            span = min(8, len(data) - mid) or 1
+            return (data[:mid] +
+                    bytes(b ^ 0xFF for b in data[mid:mid + span]) +
+                    data[mid + span:])
+        if isinstance(value, (int, float)):
+            return float("nan")
+        raise TypeError(
+            f"CorruptAt cannot mutate {type(value).__name__} payloads")
+
+    is_fail = False
+
+    def __repr__(self):
+        return f"CorruptAt({sorted(self.counts)}, mode={self.mode!r})"
+
+
+class _Point:
+    __slots__ = ("schedules", "count")
+
+    def __init__(self):
+        self.schedules: List = []
+        self.count = 0
+
+
+_LOCK = threading.Lock()
+_POINTS: Dict[str, _Point] = {}
+_ENV_LOADED = False
+
+
+def register(point: str, schedule) -> None:
+    """Attach a schedule to a fault point (additive)."""
+    with _LOCK:
+        _POINTS.setdefault(point, _Point()).schedules.append(schedule)
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Remove schedules (and counters) for one point, or everything."""
+    global _ENV_LOADED
+    with _LOCK:
+        if point is None:
+            _POINTS.clear()
+            _ENV_LOADED = False
+        else:
+            _POINTS.pop(point, None)
+
+
+def reset(point: Optional[str] = None) -> None:
+    """Zero invocation counters, keeping schedules (re-run a scenario)."""
+    with _LOCK:
+        for name, p in _POINTS.items():
+            if point is None or name == point:
+                p.count = 0
+
+
+def counts() -> Dict[str, int]:
+    """Current invocation counters (diagnostics / test assertions)."""
+    with _LOCK:
+        return {name: p.count for name, p in _POINTS.items()}
+
+
+def armed(point: str) -> bool:
+    """True when any schedule is attached to `point` — production code may
+    branch to a chaos-compatible (e.g. non-streaming) path only then."""
+    _load_env()
+    with _LOCK:
+        return point in _POINTS and bool(_POINTS[point].schedules)
+
+
+def _bump(point: str):
+    """count++ and return (count, matching schedules) — one counted
+    invocation per fire()/transform() call."""
+    _load_env()
+    with _LOCK:
+        p = _POINTS.get(point)
+        if p is None or not p.schedules:
+            return 0, []
+        p.count += 1
+        return p.count, [s for s in p.schedules if s.fires(p.count)]
+
+
+def fire(point: str) -> None:
+    """Count one invocation; raise ChaosFault if a fail schedule matches.
+    Corrupt schedules are ignored here (no payload to mutate)."""
+    count, hits = _bump(point)
+    for s in hits:
+        if s.is_fail:
+            raise ChaosFault(f"chaos[{point}] injected failure "
+                             f"(invocation {count}, {s!r})")
+
+
+def transform(point: str, value):
+    """Count one invocation; raise on fail schedules, else pipe the payload
+    through every matching corrupt schedule."""
+    count, hits = _bump(point)
+    for s in hits:
+        if s.is_fail:
+            raise ChaosFault(f"chaos[{point}] injected failure "
+                             f"(invocation {count}, {s!r})")
+        value = s.mutate(value)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# spec parsing (env var / --chaos CLI)
+# ---------------------------------------------------------------------------
+
+def _parse_action(action: str):
+    """One schedule from ``fail@3,5`` / ``fail*2@4`` / ``corrupt@2`` /
+    ``truncate@2`` / ``nan@7``."""
+    if "@" not in action:
+        raise ValueError(f"chaos spec: missing '@counts' in {action!r}")
+    kind, _, at = action.partition("@")
+    counts_ = [int(c) for c in at.split(",") if c]
+    if not counts_:
+        raise ValueError(f"chaos spec: empty counts in {action!r}")
+    if kind.startswith("fail"):
+        if "*" in kind:  # fail*N@start
+            n = int(kind.split("*", 1)[1])
+            if len(counts_) != 1:
+                raise ValueError(
+                    f"chaos spec: fail*N takes one start count: {action!r}")
+            return FailN(n, start=counts_[0])
+        return FailAt(*counts_)
+    if kind in ("corrupt", "flip"):
+        return CorruptAt(*counts_, mode="flip")
+    if kind == "truncate":
+        return CorruptAt(*counts_, mode="truncate")
+    if kind == "nan":
+        return CorruptAt(*counts_)  # float payloads NaN under any mode
+    raise ValueError(f"chaos spec: unknown action {kind!r} in {action!r}")
+
+
+def install(spec: str) -> None:
+    """Install schedules from a spec string:
+    ``point=action@counts[;point=action@counts...]``."""
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"chaos spec: expected point=action, got "
+                             f"{part!r}")
+        point, _, action = part.partition("=")
+        register(point.strip(), _parse_action(action.strip()))
+
+
+def _load_env() -> None:
+    """One-shot pickup of BIGDL_TPU_CHAOS (config tier; see utils/config).
+    Loaded lazily on the first armed()/fire()/transform() so importing this
+    module never reads the environment."""
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    with _LOCK:
+        if _ENV_LOADED:
+            return
+        _ENV_LOADED = True
+    from . import config
+    spec = config.get_str("CHAOS", "")
+    if spec:
+        install(spec)
+
+
+class scoped:
+    """Context manager for tests: install a spec (or programmatic
+    (point, schedule) pairs), clear everything on exit."""
+
+    def __init__(self, spec: str = "", schedules:
+                 Optional[Iterable] = None):
+        self.spec = spec
+        self.schedules = list(schedules or [])
+
+    def __enter__(self):
+        clear()
+        global _ENV_LOADED
+        _ENV_LOADED = True  # scoped runs ignore the ambient env spec
+        if self.spec:
+            install(self.spec)
+        for point, schedule in self.schedules:
+            register(point, schedule)
+        import sys
+        return sys.modules[__name__]
+
+    def __exit__(self, *exc):
+        clear()
+        return False

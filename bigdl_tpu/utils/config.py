@@ -27,6 +27,12 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_BENCH_BN_AUTOTUNE | (net-new: resnet50_bf16 BN-variant race; 0=off, 1=force on CPU, default=TPU only) | tpu |
 | BIGDL_TPU_ATTN_IMPL | (net-new: flash-attention dispatch, jnp/pallas; ops/attention.py) | auto |
 | BIGDL_TPU_TEST_INSTALLED | (net-new: suite resolves installed wheel) | off |
+| BIGDL_TPU_IO_RETRIES | (net-new: remote-IO retry attempts per op, utils/file_io.py) | 3 |
+| BIGDL_TPU_IO_BACKOFF_BASE / _IO_BACKOFF_MAX | (net-new: remote-IO backoff seconds, exponential + deterministic jitter) | 0.05 / 2.0 |
+| BIGDL_TPU_IO_DEADLINE | (net-new: total seconds a retried remote op may take) | 60 |
+| BIGDL_TPU_CKPT_KEEP_LAST | (net-new: checkpoint retention keep-last-K; 0 = unlimited) | 0 |
+| BIGDL_TPU_CKPT_KEEP_EVERY_EPOCHS | (net-new: mark a keeper snapshot every N epochs) | 0 |
+| BIGDL_TPU_CHAOS | (net-new: fault-injection spec, utils/chaos.py; see docs/robustness.md) | off |
 """
 
 from __future__ import annotations

@@ -16,41 +16,279 @@ reference's scheme-based routing: plain paths use the local FS fast path
 (the TPU-native stack's HDFS: GCS is the storage actually attached to TPU
 pods).  Custom backends register with `register_filesystem` (tests register
 a `mem://` store).
+
+Durability guarantees the reference inherited from Spark's block manager
+and this rebuild must provide itself (docs/robustness.md):
+
+- **Integrity frame**: every `save()` payload carries a footer
+  ``<u64 payload length> <u32 masked CRC32C> <8-byte magic>`` — the same
+  TFRecord-style masked CRC32C as csrc/crc32c.cc / utils/recordio.py
+  (native-accelerated when the extension is built, pure-Python fallback).
+  `load()` verifies the frame and raises the typed
+  :class:`CorruptCheckpoint`; files without the magic load as legacy
+  unframed pickles.
+- **Atomicity**: local writes stay tmp+rename; remote (fsspec) writes are
+  write-then-verify-readback — a torn remote write is retried, never left
+  as the newest snapshot.
+- **Retry/backoff**: every non-local filesystem op runs under exponential
+  backoff with deterministic jitter and a deadline
+  (``BIGDL_TPU_IO_RETRIES`` / ``_IO_BACKOFF_BASE`` / ``_IO_BACKOFF_MAX`` /
+  ``_IO_DEADLINE``; clock and sleep injectable for tests), so a transient
+  fsspec error never reaches — and never burns — the optimizer's scarce
+  ``bigdl.failure.retryTimes`` budget.
+- **Lineage**: `checkpoint_lineage` lists valid-looking snapshots
+  newest-first; `quarantine_checkpoint` renames corrupt ones aside
+  (``.corrupt`` suffix — kept for forensics, invisible to resume);
+  `prune_checkpoints` enforces keep-last-K (+ explicit keeper set).
+
+Fault points (utils/chaos.py): ``ckpt.write`` / ``ckpt.read`` around every
+blob, ``fs.remote`` around every remote op attempt.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import re
+import struct
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from . import chaos, config
+from .recordio import crc32c_update
+
+logger = logging.getLogger("bigdl_tpu")
+
 __all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File",
-           "register_filesystem", "get_filesystem"]
+           "register_filesystem", "get_filesystem", "CorruptCheckpoint",
+           "checkpoint_lineage", "quarantine_checkpoint", "prune_checkpoints",
+           "RetryPolicy", "set_retry_timebase"]
 
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 
+
+class CorruptCheckpoint(IOError):
+    """A checkpoint whose integrity frame (or payload) failed verification.
+
+    Lineage-walking recovery (optim/Optimizer._recover_from_checkpoint)
+    catches exactly this type: it quarantines the file and falls back to
+    the next-newest snapshot instead of crashing the run on it."""
+
+
+# ---------------------------------------------------------------------------
+# integrity frame: <payload> <u64 length> <u32 masked crc32c> <magic>
+# ---------------------------------------------------------------------------
+
+_FRAME_MAGIC = b"BGLNCKP1"  # 8 bytes, last in the file
+_FOOTER = struct.Struct("<QI")
+_FOOTER_LEN = _FOOTER.size + len(_FRAME_MAGIC)
+_CRC_CHUNK = 4 << 20
+
+
+def _mask(crc: int) -> int:
+    """TFRecord CRC mask (csrc/crc32c.h MaskedCrc32c)."""
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _footer(length: int, masked_crc: int) -> bytes:
+    return _FOOTER.pack(length, masked_crc) + _FRAME_MAGIC
+
+
+def frame_bytes(payload: bytes) -> bytes:
+    """Payload + integrity footer (length + masked CRC32C + magic)."""
+    return payload + _footer(len(payload), _mask(crc32c_update(0, payload)))
+
+
+def unframe_bytes(data: bytes, path: str = "<bytes>") -> bytes:
+    """Verify and strip the integrity footer; raises CorruptCheckpoint on
+    any mismatch.  Data without the trailing magic passes through as-is
+    (legacy unframed pickle — pre-frame checkpoints stay loadable)."""
+    if len(data) < _FOOTER_LEN or data[-len(_FRAME_MAGIC):] != _FRAME_MAGIC:
+        return data
+    length, crc = _FOOTER.unpack(data[-_FOOTER_LEN:-len(_FRAME_MAGIC)])
+    payload = data[:-_FOOTER_LEN]
+    if length != len(payload):
+        raise CorruptCheckpoint(
+            f"{path}: truncated checkpoint (frame declares {length} payload "
+            f"bytes, file holds {len(payload)})")
+    got = _mask(crc32c_update(0, payload))
+    if got != crc:
+        raise CorruptCheckpoint(
+            f"{path}: checkpoint CRC mismatch (stored {crc:#010x}, "
+            f"computed {got:#010x})")
+    return payload
+
+
+class _CrcTee:
+    """File-object shim: streams pickle.dump output to `f` while keeping a
+    running CRC32C and byte count (no whole-blob copy for multi-GB
+    checkpoints; native `bigdl_crc32c_extend` when built)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.nbytes = 0
+
+    def write(self, data):
+        # protocol-5 pickling hands buffer-protocol objects (PickleBuffer,
+        # memoryview) to write(); normalize once for crc + length
+        data = bytes(data)
+        self._f.write(data)
+        self.crc = crc32c_update(self.crc, data)
+        self.nbytes += len(data)
+
+
+def _loads_payload(payload: bytes, path: str):
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — any unpickle error = corrupt
+        raise CorruptCheckpoint(f"{path}: unreadable payload "
+                                f"({type(e).__name__}: {e})") from e
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff for remote IO
+# ---------------------------------------------------------------------------
+
+# injectable time base so tests (and the chaos suite) run deterministic
+# backoff schedules with zero wall-clock sleeping
+_TIMEBASE = {"clock": time.monotonic, "sleep": time.sleep}
+
+
+def set_retry_timebase(clock=None, sleep=None):
+    """Swap the clock/sleep the retry layer uses (tests); None = real time.
+    Returns the previous (clock, sleep) pair."""
+    prev = (_TIMEBASE["clock"], _TIMEBASE["sleep"])
+    _TIMEBASE["clock"] = clock or time.monotonic
+    _TIMEBASE["sleep"] = sleep or time.sleep
+    return prev
+
+
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and a deadline.
+
+    Jitter is a pure function of the attempt number (golden-ratio hash into
+    [0.5, 1.0]) — retries de-synchronize across workers without any RNG, so
+    chaos runs stay exactly reproducible."""
+
+    def __init__(self, retries: Optional[int] = None,
+                 base: Optional[float] = None,
+                 max_delay: Optional[float] = None,
+                 deadline: Optional[float] = None,
+                 clock=None, sleep=None):
+        self.retries = (config.get_int("IO_RETRIES", 3)
+                        if retries is None else retries)
+        self.base = (config.get_float("IO_BACKOFF_BASE", 0.05)
+                     if base is None else base)
+        self.max_delay = (config.get_float("IO_BACKOFF_MAX", 2.0)
+                          if max_delay is None else max_delay)
+        self.deadline = (config.get_float("IO_DEADLINE", 60.0)
+                         if deadline is None else deadline)
+        self.clock = clock or _TIMEBASE["clock"]
+        self.sleep = sleep or _TIMEBASE["sleep"]
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry `attempt` (1-based): exponential, capped,
+        deterministically jittered."""
+        d = min(self.base * (2 ** (attempt - 1)), self.max_delay)
+        frac = (attempt * 0.6180339887498949) % 1.0
+        return d * (0.5 + 0.5 * frac)
+
+    def run(self, fn, describe: str = "", retriable=None):
+        """Call `fn()` with retries; `retriable(exc) -> bool` gates which
+        errors are worth another attempt (default: any Exception that is
+        not a CorruptCheckpoint — integrity failures need a rewrite, not a
+        reread, so callers opt in explicitly where that applies)."""
+        start = self.clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — filtered below
+                ok = (retriable(e) if retriable is not None
+                      else not isinstance(e, CorruptCheckpoint))
+                attempt += 1
+                if not ok or attempt > self.retries:
+                    raise
+                d = self.delay(attempt)
+                if self.clock() - start + d > self.deadline:
+                    logger.warning("remote IO %s: deadline %.1fs exhausted "
+                                   "after %d attempts", describe,
+                                   self.deadline, attempt)
+                    raise
+                logger.warning("remote IO %s failed (%s: %s); retry %d/%d "
+                               "in %.2fs", describe, type(e).__name__, e,
+                               attempt, self.retries, d)
+                self.sleep(d)
+
+
+# ---------------------------------------------------------------------------
+# filesystems
+# ---------------------------------------------------------------------------
 
 class LocalFileSystem:
     """Local fast path with atomic writes (tmp + rename)."""
 
     def write_pickle(self, path: str, obj) -> None:
         """Stream-pickle straight to disk (no whole-blob bytes object —
-        matters for multi-GB checkpoints)."""
+        matters for multi-GB checkpoints), CRC32C running alongside, then
+        footer + atomic rename."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            tee = _CrcTee(f)
+            pickle.dump(obj, tee, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(_footer(tee.nbytes, _mask(tee.crc)))
         os.replace(tmp, path)
 
     def read_pickle(self, path: str):
         with open(path, "rb") as f:
-            return pickle.load(f)
+            self._verify_frame(f, path)
+            f.seek(0)
+            try:
+                # pickle.load stops at the STOP opcode, so the trailing
+                # footer bytes are never consumed
+                return pickle.load(f)
+            except Exception as e:  # noqa: BLE001
+                raise CorruptCheckpoint(f"{path}: unreadable payload "
+                                        f"({type(e).__name__}: {e})") from e
+
+    @staticmethod
+    def _verify_frame(f, path: str) -> None:
+        """Chunked CRC verify of a framed file (legacy unframed: no-op)."""
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size < _FOOTER_LEN:
+            return
+        f.seek(size - len(_FRAME_MAGIC))
+        if f.read(len(_FRAME_MAGIC)) != _FRAME_MAGIC:
+            return
+        f.seek(size - _FOOTER_LEN)
+        length, crc = _FOOTER.unpack(f.read(_FOOTER.size))
+        payload_len = size - _FOOTER_LEN
+        if length != payload_len:
+            raise CorruptCheckpoint(
+                f"{path}: truncated checkpoint (frame declares {length} "
+                f"payload bytes, file holds {payload_len})")
+        f.seek(0)
+        got, left = 0, payload_len
+        while left:
+            chunk = f.read(min(_CRC_CHUNK, left))
+            if not chunk:
+                raise CorruptCheckpoint(f"{path}: short read during "
+                                        "CRC verification")
+            got = crc32c_update(got, chunk)
+            left -= len(chunk)
+        if _mask(got) != crc:
+            raise CorruptCheckpoint(
+                f"{path}: checkpoint CRC mismatch (stored {crc:#010x}, "
+                f"computed {_mask(got):#010x})")
 
     def write_bytes(self, path: str, data: bytes) -> None:
         d = os.path.dirname(path)
@@ -76,6 +314,12 @@ class LocalFileSystem:
 
     def makedirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
 
 
 class FsspecFileSystem:
@@ -119,9 +363,47 @@ class FsspecFileSystem:
         except Exception:  # noqa: BLE001 — flat stores have no dirs
             pass
 
+    def rename(self, src: str, dst: str) -> None:
+        try:
+            self._fs.mv(self._strip(src), self._strip(dst))
+        except (AttributeError, NotImplementedError):
+            # flat stores without a rename primitive: copy + delete
+            data = self.read_bytes(src)
+            self.write_bytes(dst, data)
+            self._fs.rm(self._strip(src))
+
+    def remove(self, path: str) -> None:
+        self._fs.rm(self._strip(path))
+
     def _strip(self, path: str) -> str:
         # fsspec accepts scheme-qualified paths; keep them as-is
         return path
+
+
+class RetryingFileSystem:
+    """Backoff wrapper for non-local filesystems: every op attempt runs
+    under RetryPolicy and fires the ``fs.remote`` chaos point — transient
+    remote faults are absorbed HERE, below the optimizer's retry loop, so
+    they never consume `bigdl.failure.retryTimes` budget."""
+
+    _OPS = ("write_bytes", "read_bytes", "exists", "isdir", "listdir",
+            "makedirs", "rename", "remove")
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        target = getattr(self.inner, name)
+        if name not in self._OPS:
+            return target
+
+        def op(*args, **kwargs):
+            def once():
+                chaos.fire("fs.remote")
+                return target(*args, **kwargs)
+            describe = f"{name}({args[0] if args else ''!s:.120})"
+            return RetryPolicy().run(once, describe=describe)
+        return op
 
 
 _REGISTRY: Dict[str, Any] = {}
@@ -129,8 +411,10 @@ _LOCAL = LocalFileSystem()
 
 
 def register_filesystem(scheme: str, fs) -> None:
-    """Install a filesystem for a URL scheme (tests: an in-memory store)."""
-    _REGISTRY[scheme] = fs
+    """Install a filesystem for a URL scheme (tests: an in-memory store).
+    Non-local backends are wrapped in the retry/backoff layer."""
+    _REGISTRY[scheme] = RetryingFileSystem(fs) if not isinstance(
+        fs, (LocalFileSystem, RetryingFileSystem)) else fs
 
 
 def get_filesystem(path: str):
@@ -142,7 +426,7 @@ def get_filesystem(path: str):
     if scheme == "file":
         return _LOCAL
     if scheme not in _REGISTRY:
-        _REGISTRY[scheme] = FsspecFileSystem(scheme)
+        _REGISTRY[scheme] = RetryingFileSystem(FsspecFileSystem(scheme))
     return _REGISTRY[scheme]
 
 
@@ -164,7 +448,11 @@ def _to_numpy(tree):
 
 
 def save(obj: Any, path: str, overwrite: bool = True) -> None:
-    """(File.scala:25 `save`; remote schemes = saveToHdfs:106 role)."""
+    """(File.scala:25 `save`; remote schemes = saveToHdfs:106 role).
+
+    The written file is integrity-framed (footer: length + masked CRC32C).
+    Remote writes verify by reading the bytes back; a mismatch (torn
+    write) retries the write under the IO RetryPolicy."""
     path = _strip_file_scheme(path)
     fs = get_filesystem(path)
     # check order matters: exists() can be a remote round-trip, skip it
@@ -172,20 +460,40 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
     if not overwrite and fs.exists(path):
         raise FileExistsError(path)
     obj = _to_numpy(obj)
-    if hasattr(fs, "write_pickle"):  # local: stream, no whole-blob copy
-        fs.write_pickle(path, obj)
-    else:
-        fs.write_bytes(path, pickle.dumps(obj,
-                                          protocol=pickle.HIGHEST_PROTOCOL))
+    if hasattr(fs, "write_pickle") and not chaos.armed("ckpt.write"):
+        fs.write_pickle(path, obj)  # local: stream, no whole-blob copy
+        return
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    # chaos mutates the FRAMED bytes: a corrupt@ schedule lands a file
+    # whose CRC verification must fail at read time
+    data = chaos.transform("ckpt.write", frame_bytes(payload))
+    if hasattr(fs, "write_pickle"):  # local path with chaos armed
+        fs.write_bytes(path, data)
+        return
+    def write_and_verify():
+        fs.write_bytes(path, data)
+        back = fs.read_bytes(path)
+        if back != data:
+            raise CorruptCheckpoint(
+                f"{path}: remote readback mismatch (wrote {len(data)} "
+                f"bytes, read {len(back)} back)")
+    # readback mismatch IS retriable here — the fix is another write
+    RetryPolicy().run(write_and_verify, describe=f"save({path})",
+                      retriable=lambda e: True)
 
 
 def load(path: str) -> Any:
-    """(File.scala `load`; remote schemes = loadFromHdfs:139 role)."""
+    """(File.scala `load`; remote schemes = loadFromHdfs:139 role).
+
+    Verifies the integrity frame; raises :class:`CorruptCheckpoint` on CRC
+    mismatch, truncation, or an unreadable payload.  Files without the
+    frame magic (pre-frame snapshots) load as plain pickles."""
     path = _strip_file_scheme(path)
     fs = get_filesystem(path)
-    if hasattr(fs, "read_pickle"):
+    if hasattr(fs, "read_pickle") and not chaos.armed("ckpt.read"):
         return fs.read_pickle(path)
-    return pickle.loads(fs.read_bytes(path))
+    data = chaos.transform("ckpt.read", fs.read_bytes(path))
+    return _loads_payload(unframe_bytes(data, path), path)
 
 
 def save_checkpoint(path: str, neval: int, model_blob: Any,
@@ -261,24 +569,81 @@ def wait_for_async_checkpoints() -> None:
     join_checkpoints(futs)
 
 
-def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
-    """Find the newest (model, optimMethod, neval) triple
-    (getLatestFile, DistriOptimizer.scala:828-845)."""
+# ---------------------------------------------------------------------------
+# lineage: list / resume-by-latest / quarantine / retention
+# ---------------------------------------------------------------------------
+
+def checkpoint_lineage(path: str):
+    """All complete snapshot triples (model, optimMethod, neval) in `path`,
+    NEWEST FIRST — the fall-back order for lineage-walking recovery.
+    Quarantined files (``.corrupt``) and half-written pairs (model without
+    optimMethod) are excluded; one listdir, no per-file round-trips."""
     path = _strip_file_scheme(path)
     fs = get_filesystem(path)
     if not fs.isdir(path):
-        return None
-    best = -1
-    for name in fs.listdir(path):
-        m = re.fullmatch(r"model\.(\d+)", name)
-        if m:
-            n = int(m.group(1))
-            if n > best and fs.exists(_join(path, f"optimMethod.{n}")):
-                best = n
-    if best < 0:
-        return None
-    return (_join(path, f"model.{best}"),
-            _join(path, f"optimMethod.{best}"), best)
+        return []
+    names = set(fs.listdir(path))
+    nevals = sorted((int(m.group(1)) for m in
+                     (re.fullmatch(r"model\.(\d+)", n) for n in names) if m),
+                    reverse=True)
+    return [(_join(path, f"model.{n}"), _join(path, f"optimMethod.{n}"), n)
+            for n in nevals if f"optimMethod.{n}" in names]
+
+
+def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
+    """Find the newest (model, optimMethod, neval) triple
+    (getLatestFile, DistriOptimizer.scala:828-845)."""
+    lineage = checkpoint_lineage(path)
+    return lineage[0] if lineage else None
+
+
+def quarantine_checkpoint(model_path: str,
+                          optim_path: Optional[str] = None) -> None:
+    """Rename a corrupt snapshot aside (``.corrupt`` suffix): it drops out
+    of the lineage (resume-by-latest skips it) but stays on disk for
+    forensics — quarantined, not deleted."""
+    for p in (model_path, optim_path):
+        if not p:
+            continue
+        p = _strip_file_scheme(p)
+        fs = get_filesystem(p)
+        try:
+            if fs.exists(p):
+                fs.rename(p, p + ".corrupt")
+                logger.warning("quarantined corrupt checkpoint file %s -> "
+                               "%s.corrupt", p, p)
+        except Exception as e:  # noqa: BLE001 — best-effort: recovery must
+            # proceed on older snapshots even if the rename fails
+            logger.warning("could not quarantine %s: %s", p, e)
+
+
+def prune_checkpoints(path: str, keep_last: int, keep=()) -> list:
+    """Retention: delete snapshot pairs beyond the newest `keep_last`,
+    except nevals in `keep` (the keep-every-N-epochs keepers the optimizer
+    marks).  Quarantined ``.corrupt`` files are never touched.  Returns the
+    pruned nevals."""
+    if keep_last <= 0:
+        return []
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    keep = set(keep)
+    pruned = []
+    for i, (mp, op, n) in enumerate(checkpoint_lineage(path)):
+        if i < keep_last or n in keep:
+            continue
+        try:
+            fs.remove(mp)
+            fs.remove(op)
+            pruned.append(n)
+        except Exception as e:  # noqa: BLE001 — retention is best-effort:
+            # a failed delete must never take down training
+            logger.warning("retention: could not prune snapshot %d in %s: "
+                           "%s", n, path, e)
+    if pruned:
+        logger.info("retention: pruned snapshots %s from %s (keep_last=%d, "
+                    "keepers=%s)", sorted(pruned), path, keep_last,
+                    sorted(keep))
+    return pruned
 
 
 class File:

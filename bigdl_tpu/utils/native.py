@@ -20,7 +20,8 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["lib", "crc32c", "is_native_loaded", "build", "set_num_threads",
+__all__ = ["lib", "crc32c", "crc32c_extend", "is_native_loaded", "build",
+           "set_num_threads",
            "get_num_threads", "f32_to_bf16", "bf16_to_f32",
            "NativeRecordWriter", "NativeRecordReader",
            "NativePrefetchReader", "has_prefetch"]
@@ -34,14 +35,25 @@ _candidates = [
 
 lib: Optional[ctypes.CDLL] = None
 crc32c = None
+crc32c_extend = None
 
 
 def _bind(cdll: ctypes.CDLL) -> None:
-    global crc32c
+    global crc32c, crc32c_extend
     cdll.bigdl_crc32c.restype = ctypes.c_uint32
     cdll.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     cdll.bigdl_masked_crc32c.restype = ctypes.c_uint32
     cdll.bigdl_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    if hasattr(cdll, "bigdl_crc32c_extend"):
+        # optional (newer than the first shipped .so): the streaming
+        # continuation used by the checkpoint framer; older binaries fall
+        # back to the pure-Python loop in utils/recordio.py
+        cdll.bigdl_crc32c_extend.restype = ctypes.c_uint32
+        cdll.bigdl_crc32c_extend.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+
+        def crc32c_extend(crc: int, data: bytes) -> int:  # noqa: F811
+            return cdll.bigdl_crc32c_extend(crc, data, len(data))
     cdll.bigdl_record_writer_open.restype = ctypes.c_void_p
     cdll.bigdl_record_writer_open.argtypes = [ctypes.c_char_p]
     cdll.bigdl_record_writer_write.restype = ctypes.c_int

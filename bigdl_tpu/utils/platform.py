@@ -72,7 +72,19 @@ def force_cpu(n_devices: Optional[int] = None) -> bool:
     try:
         jax.config.update("jax_platforms", "cpu")
         if n_devices is not None:
-            jax.config.update("jax_num_cpu_devices", int(n_devices))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(n_devices))
+            except AttributeError:
+                # older jax has no jax_num_cpu_devices config option; the
+                # XLA flag is read lazily at CPU-client creation, so the
+                # env var still works even after `import jax` as long as
+                # no backend is initialized yet
+                import os
+                flag = ("--xla_force_host_platform_device_count="
+                        f"{int(n_devices)}")
+                cur = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in cur:
+                    os.environ["XLA_FLAGS"] = (cur + " " + flag).strip()
         return True
     except RuntimeError:
         return False  # backend already initialized — use as-is

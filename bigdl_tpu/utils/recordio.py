@@ -28,12 +28,10 @@ from typing import Any, Iterable, Iterator, List
 
 __all__ = ["write_records", "read_records", "count_records",
            "write_record_bytes",
-           "read_record_bytes", "masked_crc32c"]
+           "read_record_bytes", "masked_crc32c", "crc32c_update"]
 
 
-def _crc32c_py(data: bytes) -> int:
-    """Pure-Python CRC32C (Castagnoli) — fallback when the native lib is
-    absent (reference vendors the same algorithm as netty/Crc32c.java)."""
+def _table():
     global _TABLE
     if _TABLE is None:
         poly = 0x82F63B78
@@ -44,9 +42,32 @@ def _crc32c_py(data: bytes) -> int:
                 crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
             table.append(crc)
         _TABLE = table
+    return _TABLE
+
+
+def crc32c_update(crc: int, data: bytes) -> int:
+    """Continue a finalized CRC32C over more bytes (seed 0 for the first
+    chunk): crc32c_update(crc32c_update(0, a), b) == crc32c(a + b).  The
+    checkpoint framer (utils/file_io) streams pickles through this; native
+    `bigdl_crc32c_extend` when the compiled library exports it, pure-Python
+    table loop otherwise."""
+    from .native import crc32c_extend as native_extend
+    if native_extend is not None:
+        return native_extend(crc, data)
+    tb = _table()
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = tb[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _crc32c_py(data: bytes) -> int:
+    """Pure-Python CRC32C (Castagnoli) — fallback when the native lib is
+    absent (reference vendors the same algorithm as netty/Crc32c.java)."""
+    tb = _table()
     crc = 0xFFFFFFFF
     for b in data:
-        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        crc = tb[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
 
 

@@ -32,10 +32,10 @@ const Tables& tables() {
   return tb;
 }
 
-uint32_t Crc32cSoftware(const void* data, size_t len) {
+uint32_t Crc32cSoftware(const void* data, size_t len, uint32_t seed) {
   const Tables& tb = tables();
   const uint8_t* p = static_cast<const uint8_t*>(data);
-  uint32_t crc = 0xffffffffu;
+  uint32_t crc = seed ^ 0xffffffffu;
   while (len >= 8) {
     uint64_t word;
     std::memcpy(&word, p, 8);
@@ -53,9 +53,10 @@ uint32_t Crc32cSoftware(const void* data, size_t len) {
 
 #ifdef BIGDL_HAVE_SSE42_INTRIN
 __attribute__((target("sse4.2"))) uint32_t Crc32cHardware(const void* data,
-                                                          size_t len) {
+                                                          size_t len,
+                                                          uint32_t seed) {
   const uint8_t* p = static_cast<const uint8_t*>(data);
-  uint64_t crc = 0xffffffffu;
+  uint64_t crc = seed ^ 0xffffffffu;
   while (len >= 8) {
     uint64_t word;
     std::memcpy(&word, p, 8);
@@ -73,12 +74,16 @@ bool HaveSse42() { return __builtin_cpu_supports("sse4.2"); }
 
 }  // namespace
 
-uint32_t Crc32c(const void* data, size_t len) {
+uint32_t Crc32cExtend(uint32_t crc, const void* data, size_t len) {
 #ifdef BIGDL_HAVE_SSE42_INTRIN
   static const bool hw = HaveSse42();
-  if (hw) return Crc32cHardware(data, len);
+  if (hw) return Crc32cHardware(data, len, crc);
 #endif
-  return Crc32cSoftware(data, len);
+  return Crc32cSoftware(data, len, crc);
+}
+
+uint32_t Crc32c(const void* data, size_t len) {
+  return Crc32cExtend(0, data, len);
 }
 
 }  // namespace bigdl
@@ -87,6 +92,14 @@ extern "C" {
 
 uint32_t bigdl_crc32c(const char* data, size_t len) {
   return bigdl::Crc32c(data, len);
+}
+
+// Streaming continuation: `crc` is the finalized CRC32C of the bytes seen
+// so far (0 for the first chunk); the return value is the finalized
+// CRC32C of the concatenation — the checkpoint framer
+// (bigdl_tpu/utils/file_io.py) streams multi-GB pickles through this.
+uint32_t bigdl_crc32c_extend(uint32_t crc, const char* data, size_t len) {
+  return bigdl::Crc32cExtend(crc, data, len);
 }
 
 uint32_t bigdl_masked_crc32c(const char* data, size_t len) {

@@ -13,6 +13,10 @@ namespace bigdl {
 // One-shot CRC32C of `len` bytes. Uses SSE4.2 when the CPU supports it.
 uint32_t Crc32c(const void* data, size_t len);
 
+// Streaming continuation: finalized-CRC in, finalized-CRC out (seed 0 for
+// the first chunk), so Crc32cExtend(Crc32cExtend(0, a), b) == Crc32c(a+b).
+uint32_t Crc32cExtend(uint32_t crc, const void* data, size_t len);
+
 // TFRecord-style masked CRC.
 inline uint32_t MaskedCrc32c(const void* data, size_t len) {
   uint32_t crc = Crc32c(data, len);

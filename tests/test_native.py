@@ -42,6 +42,27 @@ def test_crc32c_matches_python_fallback():
         assert native.crc32c(data) == recordio._crc32c_py(data)
 
 
+def test_crc32c_extend_streaming_parity():
+    """The checkpoint framer's streaming continuation: chunked extend ==
+    one-shot, native == pure-Python table loop, for chunk splits crossing
+    the sliced-by-8 word boundary."""
+    if native.crc32c_extend is None:
+        pytest.skip("built library predates bigdl_crc32c_extend")
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 4097, dtype=np.uint8).tobytes()
+    for split in (0, 1, 7, 8, 9, 2048, 4096):
+        a, b = data[:split], data[split:]
+        got = native.crc32c_extend(native.crc32c_extend(0, a), b)
+        assert got == native.crc32c(data)
+    # pure-Python incremental path agrees (what runs without the .so)
+    py = recordio._crc32c_py(data[:100])
+    tb = recordio._table()
+    c = py ^ 0xFFFFFFFF
+    for byte in data[100:]:
+        c = tb[(c ^ byte) & 0xFF] ^ (c >> 8)
+    assert (c ^ 0xFFFFFFFF) == native.crc32c(data)
+
+
 def test_masked_crc_matches():
     data = b"the quick brown fox"
     expected = recordio.masked_crc32c(data)

@@ -174,6 +174,21 @@ def scala_fields(classname: str) -> dict:
     return fields
 
 
+def scala_own_fields(short: str) -> dict:
+    """Declared non-transient fields of ONE class level (no super walk) —
+    the set its own classdesc must cover on the wire."""
+    path = _source_file(short)
+    if path is None:
+        return {}
+    src = _strip_comments(open(path).read())
+    header, body = _class_region(src, short)
+    if header is None:
+        return {}
+    fields = dict(_ctor_fields(header))
+    fields.update(_body_fields(body or ""))
+    return fields
+
+
 def scala_suid(classname: str):
     """The class's @SerialVersionUID, or None if the SOURCE carries none.
     Looks in a window above the class declaration (robust to modifiers,
@@ -390,6 +405,56 @@ def test_every_emitted_classdesc_matches_scala_source(kitchen_descs):
         errors += audit_classdesc(cd)
     assert audited >= 30, f"only {audited} bigdl classdescs audited"
     assert not errors, "wire-format drift vs Scala source:\n" + \
+        "\n".join(errors)
+
+
+# Declared non-transient fields the writer deliberately leaves off the
+# wire: a real JVM deserializes them as JOS zero-defaults (null/0.0/false),
+# which these specific fields tolerate (null-checked config holders and
+# init-time-only hints, not updateOutput inputs).  Key: (short class name
+# or "*", field name).  Anything NOT listed here that the source declares
+# non-transient and the writer omits is exactly the MulConstant.scalar /
+# Dropout.p bug class — fail loudly so it gets emitted or triaged.
+_ALLOWED_OMISSIONS = {
+    # regularizer config: null-checked everywhere it is read
+    ("*", "wRegularizer"), ("*", "bRegularizer"), ("*", "uRegularizer"),
+    # init-time-only hints consumed by reset(); the serialized weight
+    # tensors already carry their outcome
+    ("*", "initWeight"), ("*", "initBias"), ("*", "initGradWeight"),
+    ("*", "initGradBias"), ("*", "initMethod"),
+    # gradient buffers: populated lazily on the first backward
+    ("*", "gradWeight"), ("*", "gradBias"),
+}
+
+
+def test_writer_emits_every_declared_nontransient_field(kitchen_descs):
+    """Inverse of the subset audit above: every field the Scala source
+    declares non-transient at a class level must appear on that level's
+    emitted classdesc.  JOS gives a missing field its zero-default on
+    read, so an omission is invisible to roundtrip tests but breaks a real
+    BigDL at forward time (the MulConstant `scalar` / Dropout `p` class of
+    bug — derived non-transient vals the reference's updateOutput reads)."""
+    errors = []
+    checked = 0
+    for name, cd in sorted(kitchen_descs.items()):
+        if not name.startswith(_PKG):
+            continue
+        short = name.rsplit(".", 1)[-1]
+        own = scala_own_fields(short)
+        if not own:
+            continue  # no source found: the subset audit already flags it
+        checked += 1
+        emitted = {fname for _t, fname, _sig in cd.fields}
+        for fname in sorted(own):
+            if fname in emitted or (short, fname) in _ALLOWED_OMISSIONS \
+                    or ("*", fname) in _ALLOWED_OMISSIONS:
+                continue
+            errors.append(
+                f"{name}.{fname}: declared non-transient but never emitted "
+                "— a JVM deserializes the JOS zero-default; emit it or add "
+                "a justified _ALLOWED_OMISSIONS entry")
+    assert checked >= 20, f"only {checked} classes had auditable source"
+    assert not errors, "writer omits declared non-transient fields:\n" + \
         "\n".join(errors)
 
 

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Cold-compile timing for the LeNet train step (versioned: was the
+unversioned /tmp/lenet_cold.py the round-5 runbook depended on).
+
+Why LeNet: XLA compile of this SMALL model is the pathological case on
+the tunneled backend (809s+ measured, vs 27s for ResNet-50 —
+docs/benchmarking.md), driven by the C_in<8 conv backward.  The runbook
+runs this twice against fresh cache dirs for the pad A/B:
+
+    BIGDL_TPU_XLA_CACHE_DIR=/tmp/xla_cold_pad   python tools/lenet_cold.py
+    BIGDL_TPU_CONV_PAD_MIN_CIN=0 \
+    BIGDL_TPU_XLA_CACHE_DIR=/tmp/xla_cold_nopad python tools/lenet_cold.py
+
+Prints one JSON line: wall seconds for the first optimizer iteration
+(compile-dominated: the step itself is milliseconds) plus the knob state,
+so the A/B is self-describing.  `--platform cpu` dry-runs the same code
+path off-TPU (the runbook's smoke mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/lenet_cold.py` from the repo root (or anywhere)
+# without an installed wheel — same trick as tests/conftest.py
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) for smoke runs")
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+    from bigdl_tpu.utils.platform import enable_compilation_cache
+    cache_dir = enable_compilation_cache()
+
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rng = np.random.default_rng(0)
+    n = args.batch_size
+    xs = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    ys = rng.integers(0, 10, size=n)
+    ds = DataSet.array(
+        [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]).transform(
+        SampleToMiniBatch(n, drop_last=True))
+    opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_iteration(1)))
+
+    t0 = time.perf_counter()
+    opt.optimize()  # one iteration: cold compile + one step
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "lenet_cold_compile_seconds",
+        "value": round(dt, 3),
+        "batch_size": n,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "conv_pad_min_cin": os.environ.get("BIGDL_TPU_CONV_PAD_MIN_CIN",
+                                           "default(8)"),
+        "xla_cache_dir": cache_dir,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
