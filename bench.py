@@ -60,10 +60,13 @@ _PEAK_BF16 = (
 # Stall watchdog: the tunneled backend can lose an RPC mid-run (observed
 # 2026-07-31: roofline completed, then the next compile blocked forever in
 # wait_woken while a fresh probe process reached the chip fine).  Such a hang
-# would eat the driver's whole bench budget and land NO json line, so a
-# daemon thread watches a heartbeat that every log line / stage transition
-# refreshes; on stall it emits partial results (or a bench_error) and exits.
-_BEAT = {"t": time.monotonic(), "stage": "init"}
+# would eat the driver's whole bench budget and land NO json line.  The
+# watchdog is the shared supervision subsystem (bigdl_tpu.utils.supervisor
+# — the same Supervisor the Optimizer uses, so there is ONE liveness
+# mechanism, not two) with a bench-specific on_stall callback that emits
+# partial results (or a bench_error) and exits.  Stage transitions are
+# phase-tagged heartbeats; utils/timing's measure loops notify the active
+# supervisor per rep for free.
 _STALL_STATE = {"results": {}, "errors": {}, "skipped": [], "meta": None}
 # stages that legitimately hold ONE long silent device/subprocess call and
 # get the --compile-stall-seconds allowance: backend init, XLA compiles,
@@ -90,10 +93,39 @@ def _claim_emit() -> bool:
         return _EMITTED[0] == me
 
 
+def _on_bench_stall(stall):
+    """Supervisor on_stall callback: one thread claims the final JSON line
+    and the process exits; a lost claim stops the watchdog (the main
+    thread's late-resolving RPC owns the line).  Returns True to stop
+    monitoring."""
+    if not _claim_emit():
+        return True
+    # from here this thread OWNS the process exit: any uncaught raise
+    # (e.g. stderr pipe gone mid-log) must still _exit, or the parked
+    # loser threads would leave a zombie bench process holding the TPU
+    try:
+        _watchdog_emit(stall["phase"], stall["idle_seconds"],
+                       stall["deadline_seconds"])
+    except Exception:  # noqa: BLE001
+        pass
+    os._exit(1)
+
+
+_SUP = None  # the shared Supervisor, built lazily (keeps `import bench` light)
+
+
+def _get_sup():
+    global _SUP
+    if _SUP is None:
+        from bigdl_tpu.utils import supervisor as _supervision
+        _SUP = _supervision.Supervisor(name="bench-watchdog",
+                                       on_stall=_on_bench_stall,
+                                       poll_interval=10.0)
+    return _SUP
+
+
 def _beat(stage=None):
-    _BEAT["t"] = time.monotonic()
-    if stage is not None:
-        _BEAT["stage"] = stage
+    _get_sup().beat(stage)
 
 
 def _log(msg):
@@ -642,9 +674,13 @@ def main(argv=None):
                          "quick-transition stages)")
     ap.add_argument("--chaos", default=None,
                     help="fault-injection spec (bigdl_tpu.utils.chaos), "
-                         "e.g. 'fs.remote=fail*2@1;data.batch=fail@6' — "
-                         "measure throughput WITH the robustness machinery "
-                         "exercised; deterministic count-based schedules")
+                         "e.g. 'fs.remote=fail*2@1;data.batch=fail@6', "
+                         "'step.stall=stall*30@5' (deterministic hang the "
+                         "supervisor must catch), or "
+                         "'data.record=truncate@3' (corrupt-record "
+                         "quarantine) — measure throughput WITH the "
+                         "robustness machinery exercised; deterministic "
+                         "count-based schedules")
     args = ap.parse_args(argv)
     t_start = time.perf_counter()
     _beat("init")
@@ -792,37 +828,21 @@ def _assemble_and_print(args, results, errors, skipped, table_peak,
 
 
 def _start_watchdog(stall_seconds, compile_stall_seconds):
-    """Daemon thread: if no heartbeat for `stall_seconds` (stages known to
-    hold long silent device calls — init/compile — get the larger
-    allowance), print whatever is complete and exit.  Partial results are a
-    valid JSON line; an empty run becomes a bench_error naming the stage."""
-
-    def watch():
-        while True:
-            time.sleep(10)
-            # read stage BEFORE t: a stage transition writes t then stage,
-            # so this order can never pair a stale timestamp with a fresh
-            # short-limit stage (which would declare a false stall at the
-            # moment a long compile hands off to a timing stage)
-            stage = _BEAT["stage"]
-            limit = (compile_stall_seconds
-                     if stage.split(":")[0] in _LONG_STAGES
-                     else stall_seconds)
-            idle = time.monotonic() - _BEAT["t"]
-            if idle > limit:
-                if not _claim_emit():
-                    return  # main thread already claimed the final line
-                # from here on this thread OWNS the process exit: any
-                # uncaught raise (e.g. stderr pipe gone mid-_log) must
-                # still _exit, or the parked loser threads would leave a
-                # zombie bench process holding the TPU
-                try:
-                    _watchdog_emit(stage, idle, limit)
-                except Exception:  # noqa: BLE001
-                    pass
-                os._exit(1)
-
-    threading.Thread(target=watch, daemon=True, name="bench-watchdog").start()
+    """Arm the shared supervision subsystem (bigdl_tpu.utils.supervisor)
+    as bench's stall watchdog: stages known to hold long silent device
+    calls (_LONG_STAGES) get the larger allowance, everything else
+    `stall_seconds`; a missed deadline runs _on_bench_stall, which prints
+    whatever is complete and exits.  Partial results are a valid JSON
+    line; an empty run becomes a bench_error naming the stage.  The
+    supervisor is also installed as the process default, so
+    utils/timing's measure loops heartbeat it per rep."""
+    from bigdl_tpu.utils import supervisor as _supervision
+    sup = _get_sup()
+    sup.set_deadlines(default=stall_seconds,
+                      phases={s: compile_stall_seconds
+                              for s in _LONG_STAGES})
+    _supervision.set_active(sup)
+    sup.start()
 
 
 def _watchdog_emit(stage, idle, limit):

@@ -19,9 +19,12 @@ partition, DataSet.scala:251-299).
 
 from __future__ import annotations
 
+import logging
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger("bigdl_tpu")
 
 from .sample import Sample, MiniBatch, PaddingParam, FixedLength
 from .transformer import (Transformer, ChainedTransformer, SampleToMiniBatch,
@@ -185,12 +188,22 @@ class StreamingRecordDataSet(AbstractDataSet):
     each process for TRAINING passes; eval passes always use the
     sequential reader so output order matches input order (Predictor
     aligns predictions positionally).
+
+    Corrupt-record quarantine: `skip_budget` (default: the
+    ``BIGDL_TPU_DATA_SKIP_BUDGET`` env knob, 0 = fail loud) bounds how
+    many corrupt records each data pass may quarantine — offset + reason
+    logged per record, totals in `last_quarantined` and the process-wide
+    `recordio.quarantine_stats()` — instead of one rotten byte killing
+    the run.  A positive budget (or an armed ``data.record`` chaos
+    point) forces the sequential Python reader: the native prefetcher
+    can neither resync nor inject.
     """
 
     def __init__(self, paths, seed: int = 1, num_threads: int = 0,
                  distributed: bool = False,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None,
+                 skip_budget: Optional[int] = None):
         self.paths = [str(p) for p in paths]
         if not self.paths:
             raise FileNotFoundError("no record shards")
@@ -200,6 +213,9 @@ class StreamingRecordDataSet(AbstractDataSet):
         self.distributed = distributed
         self._explicit_shard = (process_index, process_count)
         self._counts = None
+        self.skip_budget = skip_budget
+        #: corrupt records quarantined during the most recent data() pass
+        self.last_quarantined = 0
 
     def _shard(self):
         import jax
@@ -243,41 +259,59 @@ class StreamingRecordDataSet(AbstractDataSet):
         cap = min(per_rank)  # equal steps on every host (collective safety)
         return [self.paths[i] for i in order[rank::count]], cap
 
-    def _read_shard(self, path: str) -> Iterator:
+    def _read_shard(self, path: str, skip=None) -> Iterator:
         """One shard's records, in file order — the codec hook subclasses
         (e.g. dataset/seqfile.SeqFileDataSet) override; the shared
-        plan/cap/emit loop in data() stays in one place."""
+        plan/cap/emit loop in data() stays in one place.  `skip` is the
+        pass's SkipBudget (None = fail loud)."""
         from ..utils.recordio import read_records
-        return read_records(path)
+        return read_records(path, skip=skip)
 
     def data(self, train: bool) -> Iterator:
         import pickle
+        from ..utils import chaos
+        from ..utils.recordio import SkipBudget
         order = self._order if train else np.arange(len(self.paths))
         paths, cap = self._plan(order)
         emitted = 0
+        # one budget per pass: "N quarantined records per epoch", counted
+        # and logged at pass end
+        skip = SkipBudget(self.skip_budget)
 
         def within_cap():
             return cap is None or emitted < cap
 
-        if train and self.num_threads > 0 and \
-                type(self)._read_shard is StreamingRecordDataSet._read_shard:
-            # the native prefetcher speaks the BDRecord codec only
-            from ..utils import native
-            if native.is_native_loaded() and native.has_prefetch():
-                with native.NativePrefetchReader(
-                        paths, num_threads=self.num_threads) as reader:
-                    for payload in reader:
-                        if not within_cap():
-                            return
-                        emitted += 1
-                        yield pickle.loads(payload)
-                return
-        for p in paths:
-            for rec in self._read_shard(p):
-                if not within_cap():
+        try:
+            if train and self.num_threads > 0 and skip.budget <= 0 and \
+                    not chaos.armed("data.record") and \
+                    type(self)._read_shard is \
+                    StreamingRecordDataSet._read_shard:
+                # the native prefetcher speaks the BDRecord codec only,
+                # and can neither resync past corruption nor inject chaos
+                from ..utils import native
+                if native.is_native_loaded() and native.has_prefetch():
+                    with native.NativePrefetchReader(
+                            paths, num_threads=self.num_threads) as reader:
+                        for payload in reader:
+                            if not within_cap():
+                                return
+                            emitted += 1
+                            yield pickle.loads(payload)
                     return
-                emitted += 1
-                yield rec
+            for p in paths:
+                for rec in self._read_shard(p, skip=skip):
+                    if not within_cap():
+                        return
+                    emitted += 1
+                    yield rec
+        finally:
+            # runs on normal exhaustion AND consumer abandonment (close)
+            self.last_quarantined = skip.count
+            if skip.count:
+                logger.warning(
+                    "data pass complete: quarantined %d corrupt record(s) "
+                    "(budget %d) — see per-record warnings above for "
+                    "offsets", skip.count, skip.budget)
 
 
 class TransformedDataSet(AbstractDataSet):
@@ -400,10 +434,12 @@ class DataSet:
     @staticmethod
     def record_stream(pattern, distributed: bool = False, seed: int = 1,
                       num_threads: int = 0, process_index=None,
-                      process_count=None):
+                      process_count=None, skip_budget=None):
         """Out-of-core variant of record_files: shards are re-read from
         disk every epoch (shard-granular shuffle) instead of cached in
-        memory — see StreamingRecordDataSet."""
+        memory — see StreamingRecordDataSet.  `skip_budget` bounds
+        per-pass corrupt-record quarantine (default: the
+        BIGDL_TPU_DATA_SKIP_BUDGET env knob; 0 = fail loud)."""
         import glob as _glob
         paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
                  else list(pattern))
@@ -413,4 +449,5 @@ class DataSet:
                                       num_threads=num_threads,
                                       distributed=distributed,
                                       process_index=process_index,
-                                      process_count=process_count)
+                                      process_count=process_count,
+                                      skip_budget=skip_budget)

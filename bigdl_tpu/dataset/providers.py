@@ -2,28 +2,118 @@
 
 Reference: `pyspark/bigdl/dataset/{mnist,news20,movielens}.py` — numpy
 loaders (IDX parsing in mnist.py:33-74, tar/text handling in news20) plus
-download helpers in base.py.  This image has no egress, so the download
-half is out of scope by design: these providers parse LOCAL copies of the
-standard files (idx/gz for MNIST, the CIFAR binary batches, news20-style
-labeled text directories) into `Sample` lists that plug straight into
+download helpers in base.py (`maybe_download`).  This image has no
+egress, so these providers primarily parse LOCAL copies of the standard
+files (idx/gz for MNIST, the CIFAR binary batches, news20-style labeled
+text directories) into `Sample` lists that plug straight into
 `DataSet.array(...)`.
+
+The `maybe_download` role is `fetch_file`: it pulls a file from any
+`file_io` scheme (``gs://``, ``s3://``, ``memory://``, anything fsspec
+mounts) into a local destination — every remote op runs under file_io's
+existing retry/backoff layer (``BIGDL_TPU_IO_*``), the whole transfer is
+size/sha256-verified, and a failed verification triggers a bounded
+re-fetch instead of feeding a torn file into training.  `load_mnist`
+accepts `source=` to fetch missing idx files through it.
 """
 
 from __future__ import annotations
 
 import glob
 import gzip
+import hashlib
+import logging
 import os
 import struct
 import tarfile
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .sample import Sample
 
+logger = logging.getLogger("bigdl_tpu")
+
 __all__ = ["load_mnist", "load_cifar10_binary", "load_labeled_text_dir",
-           "load_movielens"]
+           "load_movielens", "fetch_file", "DownloadIntegrityError"]
+
+
+class DownloadIntegrityError(IOError):
+    """A fetched file failed size/checksum verification after every
+    retry — the transfer is torn or the source is wrong, and feeding it
+    into training would corrupt the run silently."""
+
+
+def fetch_file(url: str, dest: str, expected_size: Optional[int] = None,
+               expected_sha256: Optional[str] = None) -> str:
+    """Download `url` to local `dest` (the reference's
+    dataset/base.py `maybe_download` role, rebuilt on file_io).
+
+    - Any `file_io` scheme works (``gs://``/``s3://``/``hdfs://``/
+      ``memory://``...); each remote op already runs under file_io's
+      retry/backoff layer (``BIGDL_TPU_IO_*`` knobs), so a transient
+      storage blip never surfaces here.
+    - `expected_size` / `expected_sha256` verify the WHOLE transfer; a
+      mismatch (torn read, wrong object) re-fetches under the same
+      RetryPolicy and finally raises :class:`DownloadIntegrityError`.
+    - An existing `dest` that passes verification is reused — no
+      re-download (maybe_download semantics).
+    - The local write is atomic (tmp + rename): a crash mid-fetch never
+      leaves a half file that a later call would trust.
+    """
+    from ..utils import file_io
+
+    def verify(data: bytes) -> None:
+        if expected_size is not None and len(data) != expected_size:
+            raise DownloadIntegrityError(
+                f"{url}: size mismatch (expected {expected_size} bytes, "
+                f"got {len(data)})")
+        if expected_sha256 is not None:
+            got = hashlib.sha256(data).hexdigest()
+            if got != expected_sha256.lower():
+                raise DownloadIntegrityError(
+                    f"{url}: sha256 mismatch (expected {expected_sha256}, "
+                    f"got {got})")
+
+    if os.path.exists(dest):
+        with open(dest, "rb") as f:
+            data = f.read()
+        try:
+            verify(data)
+            return dest  # cached copy verified: no re-download
+        except DownloadIntegrityError as e:
+            logger.warning("fetch_file: cached %s failed verification "
+                           "(%s); re-fetching", dest, e)
+
+    fs = file_io.get_filesystem(url)
+
+    def fetch_once():
+        data = fs.read_bytes(url)
+        verify(data)
+        d = os.path.dirname(dest)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = dest + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dest)
+
+    # integrity failures ARE retriable here: the fix is another fetch
+    # (fs.read_bytes itself already retried transient remote errors)
+    file_io.RetryPolicy().run(
+        fetch_once, describe=f"fetch({url})",
+        retriable=lambda e: isinstance(e, DownloadIntegrityError))
+    logger.info("fetch_file: %s -> %s (%d bytes%s)", url, dest,
+                os.path.getsize(dest),
+                ", sha256 verified" if expected_sha256 else "")
+    return dest
+
+
+#: the standard MNIST idx.gz artifact names (mnist.py read_data_sets)
+_MNIST_FILES = {
+    "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+    "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+}
 
 
 def _open_maybe_gz(path: str):
@@ -47,11 +137,24 @@ def _read_idx(path: str) -> np.ndarray:
 
 
 def load_mnist(directory: str, data_type: str = "train",
-               normalize: bool = True) -> List[Sample]:
+               normalize: bool = True, source: Optional[str] = None,
+               checksums: Optional[Dict[str, str]] = None) -> List[Sample]:
     """MNIST from the standard idx(.gz) pairs in `directory`
     (mnist.py:76 read_data_sets role).  Returns Samples with (28,28,1)
-    float features and int labels."""
+    float features and int labels.
+
+    `source` (a file_io URL base, e.g. ``gs://bucket/mnist``) fetches any
+    missing standard file through :func:`fetch_file` — retried/backed-off
+    remote IO with optional per-file sha256 verification via `checksums`
+    (filename -> hex digest)."""
     prefix = "train" if data_type == "train" else "t10k"
+    if source:
+        for name in _MNIST_FILES["train" if data_type == "train"
+                                 else "test"]:
+            dest = os.path.join(directory, name)
+            if not os.path.exists(dest):
+                fetch_file(source.rstrip("/") + "/" + name, dest,
+                           expected_sha256=(checksums or {}).get(name))
     def find(kind):
         for pat in (f"{prefix}-{kind}-idx?-ubyte", f"{prefix}-{kind}*ubyte*"):
             hits = sorted(glob.glob(os.path.join(directory, pat)))

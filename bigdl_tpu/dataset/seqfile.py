@@ -22,6 +22,17 @@ Hadoop zero-compressed VInt codec, and exposes:
 
 No compression support: the generator writes uncompressed files; a
 compressed header fails loudly with the codec name.
+
+Corruption handling: record-level failures (short/inconsistent image
+values, unparseable labels) raise the typed
+:class:`~bigdl_tpu.utils.recordio.CorruptRecord` (path + byte offset);
+`read_byte_records(skip=...)` opts into the bounded skip-budget
+quarantine (``BIGDL_TPU_DATA_SKIP_BUDGET``).  Framing-level corruption —
+a bad sync marker or keyLen — stays fatal regardless of budget: the
+stream cannot be resynced past it.  The ``data.record`` chaos point
+mutates value bytes before validation (``truncate`` mode is the
+detectable injection: SequenceFiles carry no CRC, so a mid-pixel flip is
+invisible by design).
 """
 
 from __future__ import annotations
@@ -36,6 +47,8 @@ import numpy as np
 
 from . import StreamingRecordDataSet
 from .image import LabeledImage
+from ..utils import chaos
+from ..utils.recordio import CorruptRecord, SkipBudget
 
 __all__ = ["read_seq_file", "read_byte_records", "write_seq_file",
            "count_seq_records", "find_seq_files", "SeqFileDataSet",
@@ -120,9 +133,14 @@ def _read_header(f) -> Tuple[str, str, bytes]:
 
 
 def _iter_records(path: str, keys_only: bool):
+    """Yield (key, value, record_byte_offset) triples.  Framing errors
+    (sync marker, keyLen) raise a non-resumable CorruptRecord — the
+    length fields themselves are untrusted, resync is impossible, so no
+    skip budget applies to them."""
     with open(path, "rb") as f:
         _key_cls, _val_cls, sync = _read_header(f)
         while True:
+            offset = f.tell()
             raw = f.read(4)
             if len(raw) < 4:
                 return
@@ -130,35 +148,47 @@ def _iter_records(path: str, keys_only: bool):
             if rec_len == _SYNC_ESCAPE:
                 marker = f.read(16)
                 if marker != sync:
-                    raise ValueError(f"{path}: corrupt sync marker")
+                    raise CorruptRecord(f"{path}: corrupt sync marker at "
+                                        f"offset {offset}", path=path,
+                                        offset=offset, resumable=False)
                 continue
             key_len = struct.unpack(">i", f.read(4))[0]
             if key_len < 0 or key_len > rec_len:
                 # f.read(negative) would silently slurp the rest of the
                 # file into one value — corrupt shards must fail loudly
-                raise ValueError(
-                    f"{path}: corrupt record (keyLen {key_len} vs "
-                    f"recordLen {rec_len})")
+                raise CorruptRecord(
+                    f"{path}: corrupt record at offset {offset} (keyLen "
+                    f"{key_len} vs recordLen {rec_len})", path=path,
+                    offset=offset, resumable=False)
             key = f.read(key_len)
             if keys_only:  # label walks skip the pixel payload entirely
                 f.seek(rec_len - key_len, os.SEEK_CUR)
-                yield _read_text(io.BytesIO(key)), None
+                yield _read_text(io.BytesIO(key)), None, offset
                 continue
             value = f.read(rec_len - key_len)
+            # chaos mutates the raw value BEFORE the vint strip +
+            # structural validation downstream (truncate = a torn shard)
+            value = chaos.transform("data.record", value)
             # both are Text: strip the vint length prefixes
-            yield (_read_text(io.BytesIO(key)),
-                   _read_text(io.BytesIO(value)))
+            try:
+                yield (_read_text(io.BytesIO(key)),
+                       _read_text(io.BytesIO(value)), offset)
+            except Exception as e:  # noqa: BLE001 — a torn vint header
+                raise CorruptRecord(
+                    f"{path}: corrupt Text payload at offset {offset} "
+                    f"({type(e).__name__}: {e})", path=path,
+                    offset=offset) from e
 
 
 def read_seq_file(path: str) -> Iterator[Tuple[bytes, bytes]]:
     """Yield raw (key, value) payloads (Text vint headers stripped)."""
-    return _iter_records(path, keys_only=False)
+    return ((k, v) for k, v, _off in _iter_records(path, keys_only=False))
 
 
 def iter_seq_keys(path: str) -> Iterator[bytes]:
     """Key-only walk: seeks past every value, so counting/label scans never
     pull the pixel payload through Python."""
-    return (k for k, _ in _iter_records(path, keys_only=True))
+    return (k for k, _v, _off in _iter_records(path, keys_only=True))
 
 
 def _parse_label(key: bytes) -> float:
@@ -167,17 +197,43 @@ def _parse_label(key: bytes) -> float:
     return float(parts[0] if len(parts) == 1 else parts[1])
 
 
-def read_byte_records(path: str, class_num: int = None) -> Iterator[dict]:
+def read_byte_records(path: str, class_num: int = None,
+                      skip: SkipBudget = None) -> Iterator[dict]:
     """Decode the generator's value layout into BDRecord-style dicts:
     {"data": (H, W, 3) uint8 BGR, "label": float} — ByteRecord semantics
-    (the label filter mirrors `.filter(_.label <= classNum)`)."""
-    for key, value in read_seq_file(path):
-        label = _parse_label(key)
-        if class_num is not None and label > class_num:
-            continue
-        w, h = struct.unpack(">ii", value[:8])
-        pixels = np.frombuffer(value[8:8 + w * h * 3], np.uint8)
-        yield {"data": pixels.reshape(h, w, 3), "label": label}
+    (the label filter mirrors `.filter(_.label <= classNum)`).
+
+    Record values are structurally validated (SequenceFiles carry no
+    CRC): a value too short for its declared w x h x 3 pixels, absurd
+    dimensions, or an unparseable label raise :class:`CorruptRecord`.
+    `skip` (a SkipBudget) quarantines such records — offset + reason
+    logged, counted — up to its budget instead of killing the pass."""
+    for key, value, offset in _iter_records(path, keys_only=False):
+        try:
+            try:
+                label = _parse_label(key)
+            except ValueError as e:
+                raise CorruptRecord(
+                    f"{path}: unparseable record label at offset {offset} "
+                    f"({e})", path=path, offset=offset) from e
+            if class_num is not None and label > class_num:
+                continue
+            if len(value) < 8:
+                raise CorruptRecord(
+                    f"{path}: short image record at offset {offset} "
+                    f"({len(value)} value bytes)", path=path, offset=offset)
+            w, h = struct.unpack(">ii", value[:8])
+            if w <= 0 or h <= 0 or 8 + w * h * 3 > len(value):
+                raise CorruptRecord(
+                    f"{path}: corrupt image record at offset {offset} "
+                    f"(w={w}, h={h} vs {len(value)} value bytes)",
+                    path=path, offset=offset)
+            pixels = np.frombuffer(value[8:8 + w * h * 3], np.uint8)
+            yield {"data": pixels.reshape(h, w, 3), "label": label}
+        except CorruptRecord as e:
+            if skip is not None and skip.quarantine(e):
+                continue
+            raise
 
 
 def count_seq_records(path: str) -> int:
@@ -268,8 +324,8 @@ class SeqFileDataSet(StreamingRecordDataSet):
                     for p in self.paths]
         return self._counts
 
-    def _read_shard(self, path):
-        for rec in read_byte_records(path, self.class_num):
+    def _read_shard(self, path, skip=None):
+        for rec in read_byte_records(path, self.class_num, skip=skip):
             yield LabeledImage(rec["data"].astype(np.float32),
                                float(rec["label"]))
 

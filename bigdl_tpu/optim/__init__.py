@@ -17,4 +17,4 @@ from .validation import (ValidationResult, AccuracyResult, LossResult,
 from .metrics import Metrics
 from .optimizer import (Optimizer, DistriOptimizer, LocalOptimizer, Evaluator,
                         Predictor, Validator, DistriValidator,
-                        LocalValidator, TrainingPreempted)
+                        LocalValidator, TrainingPreempted, StallError)

@@ -53,6 +53,7 @@ from ..nn.module import Criterion, Module
 from ..parallel.sharding import DataParallel, ShardingStrategy
 from ..utils.engine import Engine
 from ..utils import chaos, file_io
+from ..utils import supervisor as supervision
 from .method import OptimMethod, SGD
 from .metrics import Metrics
 from .trigger import Trigger
@@ -62,7 +63,11 @@ logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["Optimizer", "DistriOptimizer", "LocalOptimizer", "Evaluator",
            "Predictor", "Validator", "DistriValidator", "LocalValidator",
-           "ConfigurationError", "TrainingPreempted", "NonFiniteLossError"]
+           "ConfigurationError", "TrainingPreempted", "NonFiniteLossError",
+           "StallError"]
+
+# re-export: the supervision subsystem raises this into the retry loop
+StallError = supervision.StallError
 
 
 def _as_dataset(dataset):
@@ -288,6 +293,11 @@ class Optimizer:
         self._iter_times = []
         self._drop_threshold = None
         self._dropped_in_window = 0
+        # training-run supervision (utils/supervisor): stall watchdog +
+        # multi-host liveness, configured via set_supervision or the
+        # BIGDL_TPU_SUPERVISE_* env knobs
+        self._supervise_cfg = None
+        self._sup = None
 
     # ------------------------------------------------------------------
     # fluent config (reference: optim/Optimizer.scala:98-255)
@@ -464,6 +474,71 @@ class Optimizer:
     def set_log_interval(self, n: int):
         self.log_interval = n
         return self
+
+    def set_supervision(self, data=None, step=None, checkpoint=None,
+                        validation=None, compile=None, default=None,
+                        policy=None, report_dir=None, peer_dir=None,
+                        peer_stale=None, poll_interval=None):
+        """Arm training-run supervision (utils/supervisor; net-new vs the
+        reference, whose liveness came from Spark's synchronous jobs): a
+        monitor thread watches phase-tagged heartbeats from this loop
+        with per-phase deadlines in seconds (`data`/`step`/`checkpoint`/
+        `validation`, plus `default` for the rest).  A missed deadline
+        writes a JSON crash report next to the checkpoint dir and raises
+        a typed StallError into the retry machinery (`policy="raise"`,
+        the default) or hard-exits for wedged backends Python cannot
+        unwind (`policy="exit"`).  Omitted deadlines fall back to the
+        BIGDL_TPU_SUPERVISE_* env knobs; with no deadline configured
+        anywhere, supervision stays off.  Multi-host: each process
+        publishes a heartbeat file under `<checkpoint>/heartbeats/` (or
+        `peer_dir`) and stale peers (> `peer_stale` seconds) are named in
+        the stall error — "host 3 last seen 94s ago" instead of an
+        eternal allgather hang.
+
+        The FIRST step of each attempt is tagged `compile` (it holds the
+        XLA compile, which legitimately runs minutes on some backends)
+        and is unwatched unless `compile=`/`default=` give it a
+        deadline — a tight steady-state `step` deadline cannot
+        false-trip on compilation."""
+        self._supervise_cfg = {"data": data, "step": step,
+                               "checkpoint": checkpoint,
+                               "validation": validation,
+                               "compile": compile,
+                               "default": default, "policy": policy,
+                               "report_dir": report_dir,
+                               "peer_dir": peer_dir,
+                               "peer_stale": peer_stale,
+                               "poll_interval": poll_interval}
+        return self
+
+    def _build_supervisor(self):
+        """Supervisor per set_supervision + env knobs; None when no phase
+        has a deadline (supervision off — the default)."""
+        cfg = self._supervise_cfg or {}
+        deadlines, env_default = supervision.env_deadlines()
+        for phase in supervision.PHASES:
+            v = cfg.get(phase)
+            if v:
+                deadlines[phase] = float(v)
+            elif v == 0:
+                deadlines.pop(phase, None)  # explicit 0 disarms the knob
+        default = cfg.get("default")
+        if default is None:
+            default = env_default
+        if not deadlines and not default:
+            return None
+        report_dir = cfg.get("report_dir") or self.checkpoint_path
+        peer_dir = cfg.get("peer_dir")
+        rank, world = jax.process_index(), jax.process_count()
+        if peer_dir is None and world > 1 and self.checkpoint_path:
+            peer_dir = file_io._join(
+                file_io._strip_file_scheme(self.checkpoint_path),
+                "heartbeats")
+        return supervision.Supervisor(
+            deadlines, default, report_dir=report_dir,
+            policy=cfg.get("policy"), peer_dir=peer_dir, rank=rank,
+            world=world, peer_stale=cfg.get("peer_stale"),
+            poll_interval=cfg.get("poll_interval"))
 
     # ------------------------------------------------------------------
     # compiled step
@@ -649,10 +724,21 @@ class Optimizer:
                     _signal.SIGTERM, _on_preempt)
             except ValueError:
                 pass  # not the main thread: best-effort handler install
+        # supervision: one watchdog per optimize() call, surviving retry
+        # re-entries (a StallError-triggered recovery is exactly when the
+        # watchdog must stay alive)
+        self._sup = self._build_supervisor()
+        if self._sup is not None:
+            self._sup.beat("data")  # arm the timeline before the thread
+            self._sup.start()
+            supervision.set_active(self._sup)
         try:
             return self._optimize_with_retry(retries, max_retries, window,
                                              last_failure)
         finally:
+            if self._sup is not None:
+                self._sup.stop()
+                self._sup = None
             if old_handlers:
                 import signal as _signal
                 for sig, h in old_handlers.items():
@@ -769,6 +855,10 @@ class Optimizer:
         return False
 
     def _recover_from_checkpoint(self):
+        if self._sup is not None:
+            # recovery IO runs under the 'checkpoint' deadline (usually
+            # unset/long), not the short 'step' one that just fired
+            self._sup.beat("checkpoint")
         # in-flight writes must land before the directory scan; a FAILED
         # write must not abort recovery (older snapshots remain valid, and
         # sync-write errors would have been retried the same way)
@@ -894,6 +984,14 @@ class Optimizer:
                     dict(mesh.shape), len(jax.tree.leaves(params)),
                     type(self.strategy).__name__)
 
+        # phase-tagged liveness heartbeats (no-op without supervision).
+        # The first device step of each attempt holds the XLA compile and
+        # is tagged 'compile' — unwatched unless explicitly given a
+        # deadline — so a tight steady-state 'step' deadline cannot
+        # false-trip on a multi-minute compilation.
+        beat = (self._sup.beat if self._sup is not None
+                else (lambda *_a: None))
+        first_step = True
         pending_loss = None  # device array of the previous iteration's loss
         while not self.end_trigger(state):
             self.dataset.shuffle()
@@ -901,18 +999,29 @@ class Optimizer:
             epoch_records = 0
             data_iter = iter(self.dataset.data(train=True))
             while True:
+                beat("data")
+                # chaos: a deterministic hang in the input pipeline — the
+                # supervisor's 'data' deadline must catch it
+                chaos.fire("data.stall")
                 data_t0 = time.perf_counter()
                 batch = next(data_iter, None)
                 if batch is None or self.end_trigger(state):
                     break
                 # chaos fault point: one count per training minibatch — a
                 # fail@ schedule lands in the retry loop like any transient
-                # data-pipeline failure (the reference's ExceptionTest)
-                chaos.fire("data.batch")
+                # data-pipeline failure (the reference's ExceptionTest); a
+                # corrupt@/nan@ schedule NaN-poisons the batch features,
+                # which the non-finite-loss sentinel must catch
+                batch = chaos.transform("data.batch", batch)
                 data_wait = time.perf_counter() - data_t0
                 self.metrics.add("get batch time average", data_wait)
                 if self._straggler_check(data_wait, state["neval"]):
                     continue
+                beat("compile" if first_step else "step")
+                first_step = False
+                # chaos: a deterministic hang in the device step (lost
+                # RPC / wedged collective) — the 'step' deadline's case
+                chaos.fire("step.stall")
                 iter_start = time.perf_counter()
                 lr = float(optim.get_learning_rate(state))
                 inp, tgt = _put_batch(
@@ -1061,6 +1170,8 @@ class Optimizer:
         if (self.validation_trigger is None or
                 not self.validation_trigger(state)):
             return
+        if self._sup is not None:
+            self._sup.beat("validation")
         results = self._run_validation(params, net_state)
         # observation counter for Trigger.plateau: one validation = one tick
         state["val_obs"] = state.get("val_obs", 0) + 1
@@ -1223,6 +1334,8 @@ class Optimizer:
                           preempt=False):
         """The snapshot write; `preempt` must come from _checkpoint_decision
         so it is rank-consistent."""
+        if self._sup is not None:
+            self._sup.beat("checkpoint")
         # collective gather of process-sharded leaves BEFORE the rank gate
         params = self._host_fetchable(params)
         net_state = self._host_fetchable(net_state)

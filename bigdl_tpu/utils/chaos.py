@@ -16,36 +16,46 @@ Fault points wired into the runtime:
 | ``ckpt.write``  | once per checkpoint blob written (file_io)    | fail/corrupt |
 | ``ckpt.read``   | once per checkpoint blob read (file_io)       | fail/corrupt |
 | ``fs.remote``   | once per remote filesystem op *attempt*       | fail      |
-| ``data.batch``  | once per training minibatch (driver loop)     | fail      |
+| ``data.batch``  | once per training minibatch (driver loop)     | fail/corrupt |
 | ``step.loss_nan``| once per host loss observation (driver loop) | nan       |
+| ``data.record`` | once per record decoded (recordio/seqfile)    | fail/corrupt |
+| ``data.stall``  | once per minibatch fetch (driver loop)        | stall     |
+| ``step.stall``  | once per device step dispatch (driver loop)   | stall     |
 
 Schedules (1-based counts):
 
 - ``FailAt(3, 5)`` — raise on exactly those invocation counts
 - ``FailN(2, start=4)`` — raise on counts 4 and 5 (fail-n-times)
 - ``CorruptAt(2)`` / ``CorruptAt(2, mode="truncate")`` — mutate the
-  payload passing through ``transform`` (bytes: flip/truncate; floats:
-  NaN) on those counts
+  payload passing through ``transform`` (bytes: flip/truncate; floats
+  and float arrays/minibatches: NaN) on those counts
+- ``StallAt(2, seconds=30)`` — BLOCK at those counts (interruptible
+  50ms-sliced sleep, so the supervisor's async ``StallError`` can land;
+  a real wedged C call is the supervisor's hard-exit policy case)
 
 Env/config spec (``BIGDL_TPU_CHAOS``), `;`-separated points::
 
-    ckpt.write=corrupt@3;fs.remote=fail*2@1;data.batch=fail@6
+    ckpt.write=corrupt@3;fs.remote=fail*2@1;data.batch=fail@6;step.stall=stall*30@5
 
 `fail` raises :class:`ChaosFault` (a RuntimeError: the optimizer retry
 loop and the IO retry layer treat it like any transient failure).
+``stall`` blocks for 3600s by default; ``stall*N`` blocks N seconds —
+the deterministic hang the supervision subsystem (utils/supervisor)
+exists to catch.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Iterable, List, Optional
 
-__all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "register",
-           "install", "clear", "reset", "armed", "fire", "transform",
-           "scoped", "counts", "FAULT_POINTS"]
+__all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
+           "register", "install", "clear", "reset", "armed", "fire",
+           "transform", "scoped", "counts", "FAULT_POINTS"]
 
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
-                "step.loss_nan")
+                "step.loss_nan", "data.record", "data.stall", "step.stall")
 
 
 class ChaosFault(RuntimeError):
@@ -95,7 +105,10 @@ class CorruptAt:
     bytes payloads: ``mode="flip"`` XORs a span in the middle (same length
     — a bit-rot tear the CRC frame must catch), ``mode="truncate"`` drops
     the tail (a torn write).  float payloads become NaN regardless of mode
-    (the ``step.loss_nan`` sentinel)."""
+    (the ``step.loss_nan`` sentinel).  Float ndarrays and MiniBatch-like
+    objects (``get_input``/``get_target``) get their float features
+    NaN-poisoned — the ``data.batch`` corruption the non-finite-loss
+    sentinel must catch."""
 
     def __init__(self, *counts: int, mode: str = "flip"):
         if mode not in ("flip", "truncate"):
@@ -105,6 +118,18 @@ class CorruptAt:
 
     def fires(self, count: int) -> bool:
         return count in self.counts
+
+    @staticmethod
+    def _poison_floats(x):
+        """NaN-fill every float array in a (possibly nested) structure;
+        integer arrays pass through (labels stay valid indices)."""
+        import numpy as np
+        if isinstance(x, (list, tuple)):
+            return [CorruptAt._poison_floats(e) for e in x]
+        arr = np.asarray(x)
+        if arr.dtype.kind == "f":
+            return np.full_like(arr, np.nan)
+        return x
 
     def mutate(self, value):
         if isinstance(value, (bytes, bytearray)):
@@ -120,6 +145,13 @@ class CorruptAt:
                     data[mid + span:])
         if isinstance(value, (int, float)):
             return float("nan")
+        if hasattr(value, "get_input") and hasattr(value, "get_target"):
+            # MiniBatch-like: poison the float features, keep targets —
+            # the loss goes NaN and the host sentinel must catch it
+            return type(value)(self._poison_floats(value.get_input()),
+                               value.get_target())
+        if hasattr(value, "dtype") or hasattr(value, "__array__"):
+            return self._poison_floats(value)
         raise TypeError(
             f"CorruptAt cannot mutate {type(value).__name__} payloads")
 
@@ -127,6 +159,36 @@ class CorruptAt:
 
     def __repr__(self):
         return f"CorruptAt({sorted(self.counts)}, mode={self.mode!r})"
+
+
+class StallAt:
+    """BLOCK at the given counts — the silent-hang failure mode (a lost
+    backend RPC, a wedged collective) the supervision subsystem exists to
+    catch.  The sleep runs in 50ms slices so Python bytecode executes
+    between them and the supervisor's async-raised ``StallError`` can
+    land; a genuinely wedged C call (no bytecode) is exactly the
+    supervisor's hard-exit policy case."""
+
+    def __init__(self, *counts: int, seconds: float = 3600.0):
+        self.counts = frozenset(int(c) for c in counts)
+        self.seconds = float(seconds)
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):  # stall schedules never mutate
+        raise AssertionError("StallAt has no payload mutation")
+
+    def block(self) -> None:
+        end = time.monotonic() + self.seconds
+        while time.monotonic() < end:
+            time.sleep(min(0.05, max(end - time.monotonic(), 0.001)))
+
+    is_fail = False
+    is_stall = True
+
+    def __repr__(self):
+        return f"StallAt({sorted(self.counts)}, seconds={self.seconds})"
 
 
 class _Point:
@@ -194,24 +256,31 @@ def _bump(point: str):
 
 
 def fire(point: str) -> None:
-    """Count one invocation; raise ChaosFault if a fail schedule matches.
-    Corrupt schedules are ignored here (no payload to mutate)."""
+    """Count one invocation; raise ChaosFault if a fail schedule matches,
+    block if a stall schedule matches.  Corrupt schedules are ignored here
+    (no payload to mutate)."""
     count, hits = _bump(point)
     for s in hits:
-        if s.is_fail:
+        if getattr(s, "is_stall", False):
+            s.block()
+        elif s.is_fail:
             raise ChaosFault(f"chaos[{point}] injected failure "
                              f"(invocation {count}, {s!r})")
 
 
 def transform(point: str, value):
-    """Count one invocation; raise on fail schedules, else pipe the payload
-    through every matching corrupt schedule."""
+    """Count one invocation; raise on fail schedules, block on stall
+    schedules, else pipe the payload through every matching corrupt
+    schedule."""
     count, hits = _bump(point)
     for s in hits:
-        if s.is_fail:
+        if getattr(s, "is_stall", False):
+            s.block()
+        elif s.is_fail:
             raise ChaosFault(f"chaos[{point}] injected failure "
                              f"(invocation {count}, {s!r})")
-        value = s.mutate(value)
+        else:
+            value = s.mutate(value)
     return value
 
 
@@ -221,13 +290,19 @@ def transform(point: str, value):
 
 def _parse_action(action: str):
     """One schedule from ``fail@3,5`` / ``fail*2@4`` / ``corrupt@2`` /
-    ``truncate@2`` / ``nan@7``."""
+    ``truncate@2`` / ``nan@7`` / ``stall@5`` / ``stall*30@5`` (for stall,
+    ``*N`` is the block duration in SECONDS, not a repeat count)."""
     if "@" not in action:
         raise ValueError(f"chaos spec: missing '@counts' in {action!r}")
     kind, _, at = action.partition("@")
     counts_ = [int(c) for c in at.split(",") if c]
     if not counts_:
         raise ValueError(f"chaos spec: empty counts in {action!r}")
+    if kind.startswith("stall"):
+        seconds = 3600.0
+        if "*" in kind:  # stall*SECONDS@counts
+            seconds = float(kind.split("*", 1)[1])
+        return StallAt(*counts_, seconds=seconds)
     if kind.startswith("fail"):
         if "*" in kind:  # fail*N@start
             n = int(kind.split("*", 1)[1])

@@ -33,6 +33,11 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_CKPT_KEEP_LAST | (net-new: checkpoint retention keep-last-K; 0 = unlimited) | 0 |
 | BIGDL_TPU_CKPT_KEEP_EVERY_EPOCHS | (net-new: mark a keeper snapshot every N epochs) | 0 |
 | BIGDL_TPU_CHAOS | (net-new: fault-injection spec, utils/chaos.py; see docs/robustness.md) | off |
+| BIGDL_TPU_SUPERVISE_DATA / _STEP / _COMPILE / _CHECKPOINT / _VALIDATION | (net-new: per-phase stall deadlines, seconds; utils/supervisor.py — COMPILE covers each attempt's first step, which holds the XLA compile) | 0 (off) |
+| BIGDL_TPU_SUPERVISE_DEADLINE | (net-new: default stall deadline for unlisted phases) | 0 (off) |
+| BIGDL_TPU_SUPERVISE_POLICY | (net-new: stall response — raise StallError or hard-exit) | raise |
+| BIGDL_TPU_SUPERVISE_PEER_STALE | (net-new: multi-host heartbeat staleness threshold, seconds) | 60 |
+| BIGDL_TPU_DATA_SKIP_BUDGET | (net-new: corrupt records quarantined per data pass; utils/recordio.py) | 0 (fail loud) |
 """
 
 from __future__ import annotations

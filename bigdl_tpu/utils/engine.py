@@ -35,6 +35,9 @@ class Engine:
 
     _mesh: Optional[Mesh] = None
     _initialized = False
+    #: outstanding device-discovery probe (thread, result box) after a
+    #: timeout — reused by the next _discover_devices call (see there)
+    _probe = None
 
     #: canonical mesh axis names, in order: data, pipeline(stage), tensor(model),
     #: sequence(context), expert
@@ -161,21 +164,34 @@ class Engine:
         if timeout <= 0:
             return list(jax.devices())
         import threading
-        box = {}
+        # a timed-out probe thread cannot be killed (it is parked inside
+        # native backend init) — but it must not be LEAKED once per call:
+        # keep the outstanding (thread, box) and re-join it on the next
+        # attempt, so at most one probe ever exists and a late-resolving
+        # backend is still harvested instead of racing a second probe
+        prior = cls._probe
+        if prior is not None and prior[0].is_alive():
+            t, box = prior
+        else:
+            box = {}
 
-        def probe():
-            try:
-                box["devices"] = list(jax.devices())
-            except Exception as e:  # noqa: BLE001 — surfaced below
-                box["error"] = e
+            def probe():
+                try:
+                    box["devices"] = list(jax.devices())
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    box["error"] = e
 
-        t = threading.Thread(target=probe, daemon=True)
-        t.start()
+            t = threading.Thread(target=probe, daemon=True,
+                                 name="bigdl-device-probe")
+            t.start()
         t.join(timeout)
         if "devices" in box:
+            cls._probe = None
             return box["devices"]
         if "error" in box:
+            cls._probe = None
             raise box["error"]
+        cls._probe = (t, box)
         raise TimeoutError(
             f"jax.devices() did not return within {timeout:.0f}s "
             "(BIGDL_TPU_DEVICE_TIMEOUT) — the accelerator backend is "
@@ -199,6 +215,7 @@ class Engine:
     def reset(cls) -> None:
         cls._mesh = None
         cls._initialized = False
+        cls._probe = None
 
     # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
 

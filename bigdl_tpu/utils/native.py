@@ -255,6 +255,7 @@ class NativeRecordReader:
     def __init__(self, path: str):
         if lib is None:
             raise RuntimeError("native library not loaded")
+        self._path = path
         self._h = lib.bigdl_record_reader_open(path.encode())
         if not self._h:
             raise IOError(f"cannot open {path!r}")
@@ -269,7 +270,13 @@ class NativeRecordReader:
         if n == -1:
             raise StopIteration
         if n < 0:
-            raise IOError("corrupt record (crc mismatch)")
+            # typed like the Python reader so callers match on ONE error;
+            # non-resumable — the C reader's stream state is undefined
+            # after a frame error (skip-budget reads use the Python path)
+            from .recordio import CorruptRecord
+            raise CorruptRecord(
+                f"corrupt record (crc mismatch) in {self._path!r}",
+                path=self._path, resumable=False)
         return ctypes.string_at(lib.bigdl_record_reader_data(self._h), n)
 
     def close(self) -> None:

@@ -16,19 +16,100 @@ native C++ library (csrc/) when built, with a pure-Python fallback.
 
 Payloads are pickled objects (typically `Sample`s) via `write_records`, or raw
 bytes via the *_bytes variants.
+
+Corruption handling: CRC/framing failures raise the typed
+:class:`CorruptRecord` (sibling of file_io.CorruptCheckpoint; subclasses
+both IOError and ValueError so legacy handlers keep catching) carrying
+the shard path and byte offset.  Readers are fail-loud by default; an
+opt-in :class:`SkipBudget` (``BIGDL_TPU_DATA_SKIP_BUDGET``) lets the data
+path quarantine up to N corrupt records per pass — offset + reason
+logged, counted — instead of killing a multi-day run on one rotten byte.
+The ``data.record`` chaos point (utils/chaos) mutates payload bytes
+BEFORE the CRC check, so injected corruption exercises exactly the real
+detection path.
 """
 
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import pickle
 import struct
-from typing import Any, Iterable, Iterator, List
+from typing import Any, Iterable, Iterator, List, Optional
+
+from . import chaos
+
+logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["write_records", "read_records", "count_records",
-           "write_record_bytes",
-           "read_record_bytes", "masked_crc32c", "crc32c_update"]
+           "write_record_bytes", "read_record_bytes", "masked_crc32c",
+           "crc32c_update", "CorruptRecord", "SkipBudget",
+           "quarantine_stats", "reset_quarantine_stats"]
+
+
+class CorruptRecord(IOError, ValueError):
+    """A data record whose CRC/framing/payload failed verification.
+
+    Carries ``path`` and ``offset`` (byte offset of the record start, or
+    None when unknowable).  ``resumable`` says whether the stream is
+    positioned after the bad record so a skip-budget reader can continue
+    (False for e.g. a corrupt length header — the length itself is
+    untrusted, resync is impossible, the error stays fatal regardless of
+    budget).  Subclasses both IOError (sibling of CorruptCheckpoint) and
+    ValueError (what the seqfile reader historically raised)."""
+
+    def __init__(self, message: str, path: Optional[str] = None,
+                 offset: Optional[int] = None, resumable: bool = True):
+        super().__init__(message)
+        self.path = path
+        self.offset = offset
+        self.resumable = resumable
+
+
+# process-wide quarantine counters (diagnostics / test assertions — the
+# chaos.counts() analog for the corrupt-record path)
+_QUARANTINE_STATS = {"records": 0}
+
+
+def quarantine_stats() -> dict:
+    return dict(_QUARANTINE_STATS)
+
+
+def reset_quarantine_stats() -> None:
+    _QUARANTINE_STATS["records"] = 0
+
+
+class SkipBudget:
+    """Bounded corrupt-record quarantine for one data pass.
+
+    budget=None reads ``BIGDL_TPU_DATA_SKIP_BUDGET`` (default 0 = today's
+    fail-loud).  ``quarantine(exc)`` returns True when the record was
+    absorbed (logged + counted); False means the budget is exhausted (or
+    the error is non-resumable) and the caller must re-raise."""
+
+    def __init__(self, budget: Optional[int] = None):
+        if budget is None:
+            from . import config
+            budget = config.get_int("DATA_SKIP_BUDGET", 0)
+        self.budget = int(budget)
+        self.quarantined: List[tuple] = []  # (path, offset, reason)
+
+    @property
+    def count(self) -> int:
+        return len(self.quarantined)
+
+    def quarantine(self, exc: CorruptRecord) -> bool:
+        if not getattr(exc, "resumable", False):
+            return False
+        if self.count >= self.budget:
+            return False
+        self.quarantined.append((exc.path, exc.offset, str(exc)))
+        _QUARANTINE_STATS["records"] += 1
+        logger.warning(
+            "data: quarantined corrupt record %d/%d in %s at offset %s: %s",
+            self.count, self.budget, exc.path, exc.offset, exc)
+        return True
 
 
 def _table():
@@ -96,18 +177,51 @@ def write_record_bytes(f, payload: bytes) -> None:
     f.write(struct.pack("<I", masked_crc32c(payload)))
 
 
-def read_record_bytes(f) -> bytes:
+def read_record_bytes(f, path: Optional[str] = None) -> bytes:
+    """One framed record; raises the typed :class:`CorruptRecord`
+    (path + byte offset) on any CRC/truncation failure.  A header-CRC
+    failure is non-resumable (the length field itself is untrusted, the
+    stream cannot resync); payload failures leave the stream positioned
+    at the next record, so skip-budget readers can continue."""
+    offset = None
+    try:
+        offset = f.tell()
+    except (OSError, AttributeError):
+        pass
     header = f.read(8)
-    if len(header) < 8:
+    if not header:
         raise EOFError
+    if len(header) < 8:
+        raise CorruptRecord(f"truncated record header in {path!r}",
+                            path=path, offset=offset)
     (length,) = struct.unpack("<Q", header)
-    (hcrc,) = struct.unpack("<I", f.read(4))
+    hcrc_raw = f.read(4)
+    if len(hcrc_raw) < 4:
+        raise CorruptRecord(f"truncated record header crc in {path!r}",
+                            path=path, offset=offset)
+    (hcrc,) = struct.unpack("<I", hcrc_raw)
     if hcrc != masked_crc32c(header):
-        raise IOError("corrupt record header (crc mismatch)")
+        raise CorruptRecord(
+            f"corrupt record header (crc mismatch) in {path!r} at offset "
+            f"{offset}", path=path, offset=offset, resumable=False)
     payload = f.read(length)
-    (pcrc,) = struct.unpack("<I", f.read(4))
+    if len(payload) < length:
+        raise CorruptRecord(
+            f"truncated record payload in {path!r} at offset {offset} "
+            f"(frame declares {length} bytes, file holds {len(payload)})",
+            path=path, offset=offset)
+    pcrc_raw = f.read(4)
+    if len(pcrc_raw) < 4:
+        raise CorruptRecord(f"truncated record payload crc in {path!r} at "
+                            f"offset {offset}", path=path, offset=offset)
+    (pcrc,) = struct.unpack("<I", pcrc_raw)
+    # chaos mutates the payload BEFORE the CRC check: injected corruption
+    # (flip/truncate) trips exactly the verification real bit-rot would
+    payload = chaos.transform("data.record", payload)
     if pcrc != masked_crc32c(payload):
-        raise IOError("corrupt record payload (crc mismatch)")
+        raise CorruptRecord(
+            f"corrupt record payload (crc mismatch) in {path!r} at offset "
+            f"{offset}", path=path, offset=offset)
     return payload
 
 
@@ -134,6 +248,7 @@ class _PyRecordReader:
     """Same iterator interface as native.NativeRecordReader."""
 
     def __init__(self, path: str):
+        self._path = path
         self._f = open(path, "rb")
 
     def __iter__(self):
@@ -141,7 +256,7 @@ class _PyRecordReader:
 
     def __next__(self) -> bytes:
         try:
-            return read_record_bytes(self._f)
+            return read_record_bytes(self._f, path=self._path)
         except EOFError:
             raise StopIteration
 
@@ -181,9 +296,17 @@ def write_records(path: str, records: Iterable[Any],
     return paths
 
 
-def read_records(path: str) -> Iterator[Any]:
+def read_records(path: str, skip: Optional[SkipBudget] = None
+                 ) -> Iterator[Any]:
     """Read one shard file, a glob pattern, or a `base` written with shards>1.
-    Uses the native C++ reader (csrc/recordio.cc) when built."""
+    Uses the native C++ reader (csrc/recordio.cc) when built.
+
+    `skip` (a :class:`SkipBudget`) opts into bounded corrupt-record
+    quarantine: resumable :class:`CorruptRecord` failures (payload CRC,
+    truncation, unpicklable payload) are logged + counted and the read
+    continues, until the budget is exhausted.  Skipping (and the
+    ``data.record`` chaos point) forces the pure-Python reader — the
+    native reader can neither resync nor inject."""
     from . import native
 
     paths = sorted(glob.glob(path)) or sorted(glob.glob(path + "-*-of-*"))
@@ -191,12 +314,34 @@ def read_records(path: str) -> Iterator[Any]:
         paths = [path]
     if not paths:
         raise FileNotFoundError(path)
-    opener = (native.NativeRecordReader if native.is_native_loaded()
-              else _PyRecordReader)
+    use_native = (native.is_native_loaded()
+                  and (skip is None or skip.budget <= 0)
+                  and not chaos.armed("data.record"))
+    opener = native.NativeRecordReader if use_native else _PyRecordReader
     for p in paths:
         with opener(p) as reader:
-            for payload in reader:
-                yield pickle.loads(payload)
+            it = iter(reader)
+            while True:
+                try:
+                    payload = next(it)
+                except StopIteration:
+                    break
+                except CorruptRecord as e:
+                    if skip is not None and skip.quarantine(e):
+                        continue
+                    raise
+                try:
+                    rec = pickle.loads(payload)
+                except Exception as e:  # noqa: BLE001 — any unpickle
+                    # failure on a CRC-clean payload is still a corrupt
+                    # record (e.g. a writer torn mid-object)
+                    ce = CorruptRecord(
+                        f"unreadable record payload in {p!r} "
+                        f"({type(e).__name__}: {e})", path=p)
+                    if skip is not None and skip.quarantine(ce):
+                        continue
+                    raise ce from e
+                yield rec
 
 
 def count_records(path: str) -> int:

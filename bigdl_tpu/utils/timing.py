@@ -40,15 +40,25 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _progress(progress) -> None:
+    """One measurement heartbeat: the caller's callback (if any) PLUS the
+    process-default supervisor (utils/supervisor.notify) — tunneled-TPU
+    benches get stall coverage for free, with no handle threading."""
+    if progress:
+        progress()
+    from . import supervisor
+    supervisor.notify()
+
+
 def measure_chain(run, n1=4, n2=16, reps=3, progress=None):
     """Differenced chained timing of `run()` (must return a device value that
     depends on all prior `run()` calls, e.g. the loss of a step that threads
     its params).  Returns (seconds_per_run, details dict).  `progress` (no
     args, no output) is called after every rep so a caller's stall watchdog
-    sees a heartbeat at least once per chain instead of one long silence."""
+    sees a heartbeat at least once per chain instead of one long silence;
+    the active supervisor (utils/supervisor) is beaten either way."""
     fetch_scalar(run())  # drain queue + any lazy backend state
-    if progress:
-        progress()
+    _progress(progress)
     times = {}
     for n in (n1, n2):
         best = float("inf")
@@ -59,8 +69,7 @@ def measure_chain(run, n1=4, n2=16, reps=3, progress=None):
                 out = run()
             fetch_scalar(out)
             best = min(best, time.perf_counter() - t0)
-            if progress:
-                progress()
+            _progress(progress)
         times[n] = best
     dt = (times[n2] - times[n1]) / (n2 - n1)
     overhead = max(times[n1] - n1 * dt, 0.0)
@@ -71,15 +80,16 @@ def measure_chain(run, n1=4, n2=16, reps=3, progress=None):
 
 def measure_sync(run, iters=6, progress=None) -> float:
     """Median per-call timing with a host fetch per call (upper-bounds the
-    true step time by one tunnel round-trip)."""
+    true step time by one tunnel round-trip).  Heartbeats like
+    measure_chain: per-rep callback + active-supervisor notify."""
     fetch_scalar(run())
+    _progress(progress)
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
         fetch_scalar(run())
         ts.append(time.perf_counter() - t0)
-        if progress:
-            progress()
+        _progress(progress)
     ts.sort()
     return ts[len(ts) // 2]
 

@@ -385,3 +385,113 @@ def test_nan_loss_without_checkpoint_fails_fast():
                .set_end_when(Trigger.max_epoch(1)))
         with pytest.raises(NonFiniteLossError):
             opt.optimize()
+
+
+# ---------------------------------------------------------------------------
+# data.batch corruption (the batch now routes through chaos.transform)
+# ---------------------------------------------------------------------------
+
+def test_corrupt_at_poisons_minibatch_floats_keeps_labels():
+    from bigdl_tpu.dataset import MiniBatch
+    batch = MiniBatch(np.ones((4, 3), np.float32),
+                      np.arange(4, dtype=np.int32))
+    with chaos.scoped("data.batch=nan@1"):
+        out = chaos.transform("data.batch", batch)
+    assert np.isnan(out.get_input()).all()          # features poisoned
+    np.testing.assert_array_equal(out.get_target(), np.arange(4))
+    assert out.get_target().dtype.kind == "i"       # labels untouched
+    assert np.isfinite(batch.get_input()).all()     # original not mutated
+
+
+def test_poisoned_batch_caught_by_loss_sentinel_and_recovers(tmp_path):
+    """data.batch=nan@N NaN-poisons the training features; the host-side
+    non-finite-loss sentinel must catch the poisoned step and recovery
+    must complete the run with finite weights."""
+    import jax
+    with chaos.scoped("data.batch=nan@3"):
+        opt = _optimizer(tmp_path, max_epoch=2)
+        trained = opt.optimize()
+        assert chaos.counts()["data.batch"] > 3  # training continued
+    assert all(np.all(np.isfinite(np.asarray(leaf)))
+               for leaf in jax.tree.leaves(trained.params))
+
+
+def test_poisoned_batch_without_checkpoint_fails_fast():
+    with chaos.scoped("data.batch=nan@2"):
+        model = nn.Sequential().add(nn.Linear(6, 2))
+        opt = (Optimizer(model, _dataset(), nn.CrossEntropyCriterion())
+               .set_end_when(Trigger.max_epoch(1)))
+        with pytest.raises(NonFiniteLossError):
+            opt.optimize()
+
+
+# ---------------------------------------------------------------------------
+# stall schedules (the supervision chaos points)
+# ---------------------------------------------------------------------------
+
+def test_stall_schedule_spec_parse_and_block():
+    import time as _time
+    with chaos.scoped("step.stall=stall*0.2@2"):
+        t0 = _time.monotonic()
+        chaos.fire("step.stall")                      # 1: no stall
+        assert _time.monotonic() - t0 < 0.15
+        t0 = _time.monotonic()
+        chaos.fire("step.stall")                      # 2: blocks ~0.2s
+        assert _time.monotonic() - t0 >= 0.18
+    with pytest.raises(ValueError):
+        chaos.install("step.stall=stall")             # no counts
+
+
+def test_stall_default_duration_and_repr():
+    s = chaos._parse_action("stall@7")
+    assert isinstance(s, chaos.StallAt)
+    assert s.seconds == 3600.0 and s.fires(7) and not s.fires(6)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke: 5-step LeNet fit over a corrupt BDRecord shard
+# ---------------------------------------------------------------------------
+
+def _lenet_record_stream(tmp_path, skip_budget):
+    from bigdl_tpu.utils.recordio import write_records
+    rng = np.random.default_rng(0)
+    images = rng.normal(0.0, 0.1, size=(120, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, size=120)
+    samples = [Sample(images[i], np.int32(labels[i])) for i in range(120)]
+    shard = str(tmp_path / "lenet.bd")
+    write_records(shard, samples)
+    return DataSet.record_stream([shard], skip_budget=skip_budget) \
+        .transform(SampleToMiniBatch(16, drop_last=True))
+
+
+def test_lenet_fit_with_record_corruption_and_skip_budget(tmp_path):
+    """5-step LeNet fit with data.record corruption + skip budget 2:
+    the run completes and exactly 2 records were quarantined (logged with
+    offsets, counted process-wide)."""
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.utils import recordio
+
+    ds = _lenet_record_stream(tmp_path, skip_budget=2)
+    recordio.reset_quarantine_stats()
+    with chaos.scoped("data.record=truncate@10,30"):
+        opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+               .set_optim_method(Adam(1e-3))
+               .set_end_when(Trigger.max_iteration(5)))
+        trained = opt.optimize()
+    assert trained.params is not None
+    assert recordio.quarantine_stats()["records"] == 2
+
+
+def test_lenet_fit_record_corruption_budget_zero_fails_loud(tmp_path):
+    """Same corruption with the default budget 0: fail loud with the
+    typed CorruptRecord (today's semantics preserved)."""
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.utils.recordio import CorruptRecord
+
+    ds = _lenet_record_stream(tmp_path, skip_budget=0)
+    with chaos.scoped("data.record=truncate@10"):
+        opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+               .set_optim_method(Adam(1e-3))
+               .set_end_when(Trigger.max_iteration(5)))
+        with pytest.raises(CorruptRecord):
+            opt.optimize()

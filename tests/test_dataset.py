@@ -397,3 +397,85 @@ class TestStreamingRecordDataSet:
         # shard-granular shuffle mixes less than record-level, so allow
         # a couple more epochs than the in-memory path needs
         assert opt.optim_method.hyper["loss"] < 1.0
+
+
+class TestCorruptRecordQuarantine:
+    """Corrupt-record tolerance on the BDRecord streaming path: typed
+    CorruptRecord with path+offset, opt-in bounded skip budget
+    (BIGDL_TPU_DATA_SKIP_BUDGET / skip_budget=), default fail-loud."""
+
+    def _shard(self, tmp_path, n=20):
+        from bigdl_tpu.utils.recordio import write_records
+        p = str(tmp_path / "c.bd")
+        write_records(p, list(range(n)))
+        return p
+
+    def test_chaos_corruption_skip_budget(self, tmp_path):
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+        from bigdl_tpu.utils import chaos
+        from bigdl_tpu.utils import recordio
+
+        p = self._shard(tmp_path)
+        recordio.reset_quarantine_stats()
+        with chaos.scoped("data.record=truncate@4,9"):
+            ds = StreamingRecordDataSet([p], skip_budget=2)
+            out = list(ds.data(train=False))
+        assert len(out) == 18
+        assert ds.last_quarantined == 2
+        assert recordio.quarantine_stats()["records"] == 2
+
+    def test_chaos_corruption_default_fails_loud(self, tmp_path):
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+        from bigdl_tpu.utils import chaos
+        from bigdl_tpu.utils.recordio import CorruptRecord
+
+        p = self._shard(tmp_path)
+        with chaos.scoped("data.record=truncate@4"):
+            ds = StreamingRecordDataSet([p])
+            with pytest.raises(CorruptRecord) as ei:
+                list(ds.data(train=False))
+        assert ei.value.path == p and ei.value.offset is not None
+
+    def test_on_disk_bitflip_quarantined_with_offset(self, tmp_path):
+        """Real bit-rot: one flipped byte mid-payload is caught by the
+        frame CRC, quarantined under budget with its byte offset."""
+        from bigdl_tpu.utils.recordio import (CorruptRecord, SkipBudget,
+                                              write_records, read_records)
+
+        # fat payloads so a mid-record flip lands in PAYLOAD bytes (a
+        # flipped length header is untrusted-length, fatal by design)
+        p = str(tmp_path / "c.bd")
+        write_records(p, ["x" * 64] * 19 + ["y" * 64])
+        data = bytearray(open(p, "rb").read())
+        data[30] ^= 0xFF  # inside the first record's payload
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(CorruptRecord):
+            list(read_records(p))
+        skip = SkipBudget(1)
+        out = list(read_records(p, skip=skip))
+        assert len(out) == 19 and skip.count == 1
+        path_, offset, reason = skip.quarantined[0]
+        assert path_ == p and offset is not None and "crc" in reason
+
+    def test_budget_exhaustion_reraises(self, tmp_path):
+        from bigdl_tpu.utils import chaos
+        from bigdl_tpu.utils.recordio import (CorruptRecord, SkipBudget,
+                                              read_records)
+
+        p = self._shard(tmp_path)
+        with chaos.scoped("data.record=truncate@2,5,8"):
+            skip = SkipBudget(2)
+            with pytest.raises(CorruptRecord):
+                list(read_records(p, skip=skip))
+        assert skip.count == 2  # absorbed two, the third was over budget
+
+    def test_env_knob_default(self, tmp_path, monkeypatch):
+        from bigdl_tpu.dataset import StreamingRecordDataSet
+        from bigdl_tpu.utils import chaos
+
+        monkeypatch.setenv("BIGDL_TPU_DATA_SKIP_BUDGET", "1")
+        p = self._shard(tmp_path)
+        with chaos.scoped("data.record=truncate@3"):
+            ds = StreamingRecordDataSet([p])  # budget from the env knob
+            out = list(ds.data(train=False))
+        assert len(out) == 19 and ds.last_quarantined == 1

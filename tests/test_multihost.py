@@ -232,3 +232,52 @@ def test_two_process_zero_checkpoint(tmp_path):
         # bulk eval returned complete per-host results on both ranks
         assert o["eval_n"] == 128 and o["eval_acc"] > 0.5, o
     assert by_rank[0]["eval_acc"] == pytest.approx(by_rank[1]["eval_acc"])
+
+
+def test_multihost_stale_peer_heartbeat_files_memory_store():
+    """Multi-host liveness (utils/supervisor): every rank publishes a
+    heartbeat file through file_io; when one rank stops beating — a dead
+    host whose collectives would hang everyone forever — the survivors'
+    supervisors flag it by rank and age, and the crash report / stall
+    message carry the actionable "host N last seen Xs ago" line instead
+    of an eternal allgather hang.  Driven on memory:// (the same file_io
+    path a gs:// checkpoint dir would use) with an injected wall clock,
+    so the scenario is deterministic and wall-clock-free."""
+    import os
+    from bigdl_tpu.utils.supervisor import Supervisor
+
+    peer_dir = f"memory://mh_hb_{os.getpid()}"
+    wall = {"now": 5000.0}
+    sups = [Supervisor({"step": 60.0}, peer_dir=peer_dir, rank=r, world=3,
+                       peer_stale=30.0, wall_clock=lambda: wall["now"],
+                       publish_interval=0.0) for r in range(3)]
+    try:
+        for s in sups:
+            s.beat("step")
+            s._publish_heartbeat()
+        # everyone fresh: no rank flags anyone
+        assert all(s.check_peers() == {} for s in sups)
+
+        # rank 2 dies (its supervised thread stops beating; in a real run
+        # its monitor would keep publishing the STALE last-beat time)
+        wall["now"] = 5094.0
+        for s in sups[:2]:
+            s.beat("step")
+            s._publish_heartbeat()
+        for survivor in sups[:2]:
+            stale = survivor.check_peers()
+            assert list(stale) == [2], stale
+            assert stale[2] == pytest.approx(94.0)
+        # the dead rank's own view flags the survivors as fresh
+        assert sups[2].check_peers() == {}
+
+        # the survivors' crash report names the host and its age
+        report = sups[0].crash_report("step", 70.0, 60.0,
+                                      sups[0].check_peers())
+        assert report["stale_peers"] == {"2": 94.0}
+    finally:
+        import fsspec
+        try:
+            fsspec.filesystem("memory").rm("/", recursive=True)
+        except Exception:
+            pass

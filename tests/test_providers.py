@@ -101,3 +101,99 @@ def test_labeled_text_tarball_dot_prefixed_members(tmp_path):
         tf.add(src, arcname="./corpus")
     docs, cats = load_labeled_text_dir(str(tar_path))
     assert cats == ["x"] and docs == [("hello", 0)]
+
+
+# ---------------------------------------------------------------------------
+# fetch_file: the maybe_download role, on file_io's retry/backoff layer
+# ---------------------------------------------------------------------------
+
+def _memory_fixture(path, payload):
+    import fsspec
+    fsspec.filesystem("memory").pipe_file(path, payload)
+
+
+def _zero_cost_retries():
+    from bigdl_tpu.utils import file_io
+    return file_io.set_retry_timebase(lambda: 0.0, lambda d: None)
+
+
+def test_fetch_file_verifies_size_and_sha256(tmp_path):
+    import hashlib
+    from bigdl_tpu.dataset.providers import fetch_file
+
+    payload = b"mnist-bytes" * 200
+    _memory_fixture("/prov_f/a.bin", payload)
+    dest = str(tmp_path / "a.bin")
+    got = fetch_file("memory://prov_f/a.bin", dest,
+                     expected_size=len(payload),
+                     expected_sha256=hashlib.sha256(payload).hexdigest())
+    assert got == dest
+    assert open(dest, "rb").read() == payload
+    # cached copy passing verification is reused (no tmp leftovers)
+    fetch_file("memory://prov_f/a.bin", dest, expected_size=len(payload))
+    assert not os.path.exists(dest + ".tmp")
+
+
+def test_fetch_file_checksum_mismatch_fails_loud(tmp_path):
+    from bigdl_tpu.dataset.providers import (DownloadIntegrityError,
+                                             fetch_file)
+    from bigdl_tpu.utils import file_io
+    import pytest
+
+    _memory_fixture("/prov_g/b.bin", b"payload")
+    prev = _zero_cost_retries()
+    try:
+        with pytest.raises(DownloadIntegrityError, match="sha256 mismatch"):
+            fetch_file("memory://prov_g/b.bin", str(tmp_path / "b.bin"),
+                       expected_sha256="0" * 64)
+    finally:
+        file_io.set_retry_timebase(*prev)
+    # a failed fetch must not leave a half-written destination behind
+    assert not os.path.exists(str(tmp_path / "b.bin"))
+
+
+def test_fetch_file_absorbs_transient_remote_faults(tmp_path):
+    """Two injected fs.remote faults are retried below fetch_file — the
+    reference's maybe_download never had backoff; this one rides
+    file_io's."""
+    import hashlib
+    from bigdl_tpu.dataset.providers import fetch_file
+    from bigdl_tpu.utils import chaos, file_io
+
+    payload = b"flaky-store" * 50
+    _memory_fixture("/prov_h/c.bin", payload)
+    prev = _zero_cost_retries()
+    try:
+        with chaos.scoped("fs.remote=fail*2@1"):
+            fetch_file("memory://prov_h/c.bin", str(tmp_path / "c.bin"),
+                       expected_sha256=hashlib.sha256(payload).hexdigest())
+    finally:
+        file_io.set_retry_timebase(*prev)
+    assert open(str(tmp_path / "c.bin"), "rb").read() == payload
+
+
+def test_load_mnist_fetches_missing_files_from_source(tmp_path):
+    """load_mnist(source=...) pulls the standard idx.gz names through
+    fetch_file into the local directory, then parses as usual."""
+    import hashlib
+    import io
+
+    r = np.random.default_rng(1)
+    imgs = r.integers(0, 256, size=(6, 28, 28)).astype(np.uint8)
+    labels = r.integers(0, 10, size=6).astype(np.uint8)
+    buf_i, buf_l = io.BytesIO(), io.BytesIO()
+    _write_idx_images(buf_i, imgs, gz=True)
+    _write_idx_labels(buf_l, labels, gz=True)
+    names = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    blobs = dict(zip(names, (buf_i.getvalue(), buf_l.getvalue())))
+    for name, blob in blobs.items():
+        _memory_fixture("/prov_mnist/" + name, blob)
+    checksums = {n: hashlib.sha256(b).hexdigest()
+                 for n, b in blobs.items()}
+    samples = load_mnist(str(tmp_path), "train",
+                         source="memory://prov_mnist",
+                         checksums=checksums)
+    assert len(samples) == 6
+    assert int(samples[2].label) == int(labels[2])
+    # the files landed locally: a second call parses without the source
+    assert len(load_mnist(str(tmp_path), "train")) == 6

@@ -190,6 +190,72 @@ def test_shard_striding_and_cap(tmp_path):
     assert len(list(ds1.data(train=False))) == 4
 
 
+def test_chaos_corrupt_record_skip_budget_two(tmp_path):
+    """Injected corrupt records (chaos data.record, truncate mode — the
+    detectable corruption: SequenceFiles carry no CRC) with skip budget
+    2: the pass completes with exactly 2 quarantined records counted."""
+    from bigdl_tpu.utils import chaos
+
+    p = str(tmp_path / "c.seq")
+    write_seq_file(p, _images(12, seed=7))
+    with chaos.scoped("data.record=truncate@3,8"):
+        ds = SeqFileDataSet([p], skip_budget=2)
+        out = list(ds.data(train=False))
+    assert len(out) == 10
+    assert ds.last_quarantined == 2
+
+
+def test_chaos_corrupt_record_budget_zero_fails_loud(tmp_path):
+    """Default budget 0 keeps today's fail-loud semantics, now with the
+    typed CorruptRecord carrying path + byte offset."""
+    from bigdl_tpu.utils import chaos
+    from bigdl_tpu.utils.recordio import CorruptRecord
+
+    p = str(tmp_path / "c0.seq")
+    write_seq_file(p, _images(6, seed=8))
+    with chaos.scoped("data.record=truncate@2"):
+        with pytest.raises(CorruptRecord) as ei:
+            list(read_byte_records(p))
+    assert ei.value.path == p and ei.value.offset is not None
+    # CorruptRecord stays catchable as the historical types
+    assert isinstance(ei.value, (IOError, ValueError))
+
+
+def test_on_disk_truncated_record_quarantined(tmp_path):
+    """Real corruption (file torn mid-final-record): structural
+    validation catches the short value; budget 1 absorbs it, budget 0
+    raises."""
+    from bigdl_tpu.utils.recordio import CorruptRecord, SkipBudget
+
+    p = str(tmp_path / "torn.seq")
+    write_seq_file(p, _images(8, seed=9))
+    data = open(p, "rb").read()
+    open(p, "wb").write(data[:len(data) - 40])  # tear the last record
+    with pytest.raises(CorruptRecord):
+        list(read_byte_records(p))
+    skip = SkipBudget(1)
+    out = list(read_byte_records(p, skip=skip))
+    assert len(out) == 7 and skip.count == 1
+    assert skip.quarantined[0][0] == p  # (path, offset, reason) logged
+
+
+def test_corrupt_sync_marker_fatal_regardless_of_budget(tmp_path):
+    """Framing-level corruption cannot be resynced past: stays fatal even
+    with budget (the record lengths themselves are untrusted)."""
+    from bigdl_tpu.utils.recordio import CorruptRecord, SkipBudget
+
+    p = str(tmp_path / "sync.seq")
+    write_seq_file(p, _images(10, seed=10), sync_interval=4)
+    data = bytearray(open(p, "rb").read())
+    # find the first sync escape (-1 int32) and corrupt the marker after it
+    esc = struct.pack(">i", -1)
+    i = data.index(esc, 100)
+    data[i + 4] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(CorruptRecord, match="sync marker"):
+        list(read_byte_records(p, skip=SkipBudget(100)))
+
+
 def test_class_filter_respects_equal_step_cap(tmp_path):
     """class_num filtering must feed the FILTERED counts into the
     distributed cap, or ranks would take unequal step counts into the

@@ -1,0 +1,293 @@
+"""Training-run supervision: stall watchdog, crash reports, multi-host
+liveness (bigdl_tpu.utils.supervisor).
+
+The failure mode under test is the one PR-1's checkpoint lineage cannot
+reach: a hang raises no exception, so nothing recovers.  The supervisor
+turns phase-tagged heartbeat silence into (1) a JSON crash report with
+all-thread stacks + the heartbeat timeline and (2) a typed StallError
+async-raised into the supervised thread, which the optimizer's existing
+retry machinery converts into checkpoint-lineage recovery.  Chaos
+``step.stall`` schedules make the whole loop deterministic.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import Adam, Optimizer, Trigger
+from bigdl_tpu.utils import chaos, file_io
+from bigdl_tpu.utils import supervisor as sup_mod
+from bigdl_tpu.utils.supervisor import StallError, Supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_active():
+    chaos.clear()
+    yield
+    chaos.clear()
+    sup_mod.set_active(None)
+    try:
+        import fsspec
+        fsspec.filesystem("memory").rm("/", recursive=True)
+    except Exception:
+        pass
+
+
+def _named_threads(prefix):
+    return [t for t in threading.enumerate() if t.name.startswith(prefix)]
+
+
+# ---------------------------------------------------------------------------
+# the watchdog core
+# ---------------------------------------------------------------------------
+
+def test_deadline_fires_report_written_and_stallerror_raised(tmp_path):
+    """Missed deadline -> crash report JSON (>= 2 thread stacks, heartbeat
+    timeline, chaos counters) + StallError delivered to the supervised
+    thread."""
+    caught = {}
+    sup = Supervisor({"step": 0.2}, report_dir=str(tmp_path),
+                     poll_interval=0.05)
+
+    def worker():
+        sup.beat("data")
+        sup.beat("step")
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:  # the "hung collective"
+                time.sleep(0.01)
+            caught["err"] = None
+        except StallError as e:
+            caught["err"] = e
+
+    t = threading.Thread(target=worker, name="supervised-worker")
+    t.start()
+    sup.start()
+    t.join(10)
+    sup.stop()
+    assert not t.is_alive(), "StallError never landed in the worker"
+    assert isinstance(caught["err"], StallError)
+    assert "'step'" in str(caught["err"])  # names the stalled phase
+
+    reports = glob.glob(str(tmp_path / "crash_report*.json"))
+    assert len(reports) == 1
+    rep = json.load(open(reports[0]))
+    assert len(rep["threads"]) >= 2         # worker + monitor at least
+    assert any("worker" in label for label in rep["threads"])
+    assert rep["timeline"] and rep["timeline"][-1]["phase"] == "step"
+    assert rep["phase"] == "step"
+    assert rep["idle_seconds"] >= rep["deadline_seconds"]
+    assert "chaos_counts" in rep and "platform" in rep
+
+
+def test_healthy_run_no_report_no_stray_threads(tmp_path):
+    sup = Supervisor({"step": 0.3}, report_dir=str(tmp_path),
+                     poll_interval=0.05, name="sup-healthy")
+    sup.start()
+    for _ in range(6):
+        sup.beat("step")
+        time.sleep(0.05)
+    sup.stop()
+    assert glob.glob(str(tmp_path / "crash_report*")) == []
+    assert sup.stalls == 0
+    assert _named_threads("sup-healthy") == []  # monitor joined, not leaked
+
+
+def test_report_written_through_file_io_on_memory_scheme():
+    """Crash reports route through file_io like checkpoints: remote
+    schemes work (the report must land where the checkpoints are, which
+    is gs:// in production)."""
+    dir_ = f"memory://sup_rep_{os.getpid()}"
+    sup = Supervisor({"step": 1.0}, report_dir=dir_)
+    path = sup._write_report("step", 2.0, 1.0, {}, "test stall")
+    assert path is not None and path.startswith("memory://")
+    rep = json.loads(file_io.get_filesystem(path).read_bytes(path))
+    assert rep["reason"] == "test stall" and rep["threads"]
+
+
+def test_exit_policy_validated_and_env_deadlines(monkeypatch):
+    with pytest.raises(ValueError, match="unknown policy"):
+        Supervisor({"step": 1.0}, policy="explode")
+    monkeypatch.setenv("BIGDL_TPU_SUPERVISE_STEP", "12.5")
+    monkeypatch.setenv("BIGDL_TPU_SUPERVISE_DEADLINE", "99")
+    deadlines, default = sup_mod.env_deadlines()
+    assert deadlines == {"step": 12.5} and default == 99.0
+    monkeypatch.delenv("BIGDL_TPU_SUPERVISE_STEP")
+    monkeypatch.delenv("BIGDL_TPU_SUPERVISE_DEADLINE")
+    deadlines, default = sup_mod.env_deadlines()
+    assert deadlines == {} and default is None
+
+
+def test_deadline_lookup_prefix_and_default():
+    sup = Supervisor({"compile": 900.0, "step": 1.0}, 300.0)
+    assert sup.deadline_for("compile:resnet50") == 900.0  # bench stages
+    assert sup.deadline_for("step") == 1.0
+    assert sup.deadline_for("build:lenet") == 300.0
+    sup2 = Supervisor({"step": 1.0})
+    assert sup2.deadline_for("data") is None  # unwatched without default
+
+
+def test_notify_refreshes_active_supervisor_current_phase():
+    sup = Supervisor({"step": 5.0})
+    sup.beat("step")
+    count0 = sup._count
+    sup_mod.set_active(sup)
+    sup_mod.notify()  # the timing.measure_* heartbeat: phase preserved
+    assert sup._count == count0 + 1
+    assert sup._last[0] == "step"
+    sup_mod.set_active(None)
+    sup_mod.notify()  # no active supervisor: must be a no-op
+    assert sup._count == count0 + 1
+
+
+# ---------------------------------------------------------------------------
+# multi-host liveness (heartbeat files)
+# ---------------------------------------------------------------------------
+
+def test_stale_peer_flagged_on_memory_store():
+    """Two ranks share a memory:// heartbeat dir; rank 1 goes silent and
+    rank 0's supervisor names it with its age."""
+    peer_dir = f"memory://sup_hb_{os.getpid()}"
+    wall = {"now": 1000.0}
+    sup0 = Supervisor({"step": 60.0}, peer_dir=peer_dir, rank=0, world=2,
+                      peer_stale=30.0, wall_clock=lambda: wall["now"],
+                      publish_interval=0.0)
+    sup1 = Supervisor({"step": 60.0}, peer_dir=peer_dir, rank=1, world=2,
+                      peer_stale=30.0, wall_clock=lambda: wall["now"],
+                      publish_interval=0.0)
+    sup0.beat("step")
+    sup1.beat("step")
+    sup0._publish_heartbeat()
+    sup1._publish_heartbeat()
+    assert sup0.check_peers() == {}  # both fresh
+
+    wall["now"] = 1094.0  # rank 1 never beats again
+    sup0.beat("step")
+    sup0._publish_heartbeat()
+    stale = sup0.check_peers()
+    assert list(stale) == [1]
+    assert stale[1] == pytest.approx(94.0)
+    # ...and the stall error message carries the actionable line
+    msg_stale = sup0._check_peers(log=False)
+    report = sup0.crash_report("step", 70.0, 60.0, msg_stale)
+    assert report["stale_peers"] == {"1": 94.0}
+
+
+def test_own_heartbeat_and_fresh_peers_not_flagged():
+    peer_dir = f"memory://sup_hb2_{os.getpid()}"
+    wall = {"now": 50.0}
+    sups = [Supervisor({"step": 60.0}, peer_dir=peer_dir, rank=r, world=3,
+                       peer_stale=30.0, wall_clock=lambda: wall["now"],
+                       publish_interval=0.0) for r in range(3)]
+    for s in sups:
+        s.beat("step")
+        s._publish_heartbeat()
+    wall["now"] = 60.0
+    for s in sups:
+        assert s.check_peers() == {}  # nobody stale, self excluded
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: chaos step.stall -> report -> StallError -> lineage recovery
+# ---------------------------------------------------------------------------
+
+def _dataset(n=64, d=6, batch=16):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(d).astype(np.float32),
+                      np.float32(i % 2)) for i in range(n)]
+    return DataSet.array(samples).transform(
+        SampleToMiniBatch(batch, drop_last=True))
+
+
+def test_optimizer_stall_recovers_from_checkpoint_lineage(tmp_path):
+    """The acceptance scenario: injected step.stall at minibatch 5
+    (deterministic chaos) -> crash report JSON written with all-thread
+    stacks + heartbeat timeline, StallError raised into the retry loop,
+    run recovers from the PR-1 checkpoint lineage and completes."""
+    import jax
+    recovered = {}
+    with chaos.scoped("step.stall=stall*30@5"):
+        opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                         nn.CrossEntropyCriterion())
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_epoch(2))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+               .set_supervision(step=0.4))
+        orig = opt._load_snapshot
+
+        def spy(mp, op=None):
+            recovered["path"] = mp
+            return orig(mp, op)
+
+        opt._load_snapshot = spy
+        trained = opt.optimize()
+        assert chaos.counts()["step.stall"] > 5  # training continued past
+    assert trained.params is not None
+    assert all(np.all(np.isfinite(np.asarray(leaf)))
+               for leaf in jax.tree.leaves(trained.params))
+    # recovery actually walked the lineage
+    assert "model." in recovered["path"]
+    reports = sorted(glob.glob(str(tmp_path / "crash_report*.json")))
+    assert reports, "no crash report written next to the checkpoint dir"
+    rep = json.load(open(reports[0]))
+    assert len(rep["threads"]) >= 2
+    assert rep["timeline"], "heartbeat timeline missing"
+    assert {e["phase"] for e in rep["timeline"]} >= {"data", "step"}
+    assert rep["chaos_counts"].get("step.stall") == 5
+    # the supervisor thread did not outlive optimize()
+    assert _named_threads("bigdl-supervisor") == []
+
+
+def test_optimizer_without_supervision_unchanged(tmp_path):
+    """No deadlines configured anywhere -> no supervisor is built, no
+    monitor thread runs (the tier-1 default)."""
+    opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                     nn.CrossEntropyCriterion())
+           .set_optim_method(Adam(1e-2))
+           .set_end_when(Trigger.max_epoch(1)))
+    assert opt._build_supervisor() is None
+    opt.optimize()
+    assert _named_threads("bigdl-supervisor") == []
+
+
+def test_first_step_compile_phase_immune_to_step_deadline(tmp_path):
+    """The first device step holds the XLA compile (~25s for LeNet on a
+    TPU backend) and is tagged 'compile': a slow first step must NOT
+    trip a tight steady-state 'step' deadline."""
+    with chaos.scoped("step.stall=stall*1.2@1"):  # slow FIRST step only
+        opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                         nn.CrossEntropyCriterion())
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_epoch(1))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+               .set_supervision(step=0.4))  # << the 1.2s "compile"
+        opt.optimize()
+    assert glob.glob(str(tmp_path / "crash_report*.json")) == []
+    # an explicit compile deadline DOES watch the first step
+    sup = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                     nn.CrossEntropyCriterion())
+           .set_supervision(step=0.4, compile=60)._build_supervisor())
+    assert sup.deadline_for("compile") == 60
+    assert sup.deadline_for("step") == 0.4
+
+
+def test_data_stall_chaos_caught_by_data_deadline(tmp_path):
+    """data.stall hangs the input pipeline; the 'data' deadline catches
+    it and the run still completes via recovery."""
+    with chaos.scoped("data.stall=stall*30@3"):
+        opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                         nn.CrossEntropyCriterion())
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_epoch(2))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+               .set_supervision(data=0.4))
+        trained = opt.optimize()
+    assert trained.params is not None
+    assert glob.glob(str(tmp_path / "crash_report*.json"))

@@ -77,6 +77,17 @@ if [ -n "$SWEEP_VARIANTS" ]; then
   done
 fi
 
+if [ "$SMOKE" = 1 ]; then
+  # supervision smoke (cpu mode only: proves the stall watchdog -> crash
+  # report -> StallError -> checkpoint recovery loop closes before any
+  # tunnel time is spent; the TPU run carries supervision implicitly via
+  # BIGDL_TPU_SUPERVISE_* knobs when the operator arms them)
+  echo "[runbook] 2c/4 supervise smoke (chaos step.stall -> recovery)" >> "$LOG"
+  timeout 300 python tools/supervise_smoke.py --platform cpu \
+    > /tmp/supervise_smoke.json 2>/tmp/supervise_smoke.log
+  echo "[runbook] supervise rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+fi
+
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
 rm -rf /tmp/xla_cold_pad /tmp/xla_cold_nopad
 BIGDL_TPU_XLA_CACHE_DIR=/tmp/xla_cold_pad timeout "$COLD_TIMEOUT" \
@@ -102,7 +113,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
