@@ -74,8 +74,10 @@ _STALL_STATE = {"results": {}, "errors": {}, "skipped": [], "meta": None}
 # scaling subprocess (own timeout _SCALING_TIMEOUT=420s > the short limit),
 # and timing ("time:*"): per-rep heartbeats bound most silences to one rep,
 # but the fetch of one n2=16 chain is a single blocking call that can pass
-# 300s on slow backends (resnet50 under --platform cpu)
-_LONG_STAGES = ("init", "compile", "trace", "roofline", "scaling", "time")
+# 300s on slow backends (resnet50 under --platform cpu); "e2e" holds the
+# final sync fetch of the end-to-end input-pipeline loop
+_LONG_STAGES = ("init", "compile", "trace", "roofline", "scaling", "time",
+                "e2e")
 _EMIT_LOCK = threading.Lock()
 _EMITTED = [None]  # thread ident of the claimant
 _EMIT_DONE = threading.Event()  # set once the final line is on stdout
@@ -275,6 +277,69 @@ def _make_record(name, batch, dt, timing, compile_s, flops_step,
     return rec
 
 
+def _bench_e2e(name, compiled, box, inp, tgt, data_sh, lr_arr, rng,
+               iters=6):
+    """End-to-end records/s INCLUDING the input pipeline: a host-side
+    source re-collates numpy copies of the batch each iteration (the
+    per-batch memcpy cost a real pipeline pays), the shared background
+    prefetcher (dataset/prefetch.PrefetchIterator) stages each batch onto
+    the device while the previous step runs, and the loop is synced by a
+    final host fetch.  `data_wait_fraction` = consumer time spent waiting
+    on the prefetch queue / total wall — the input-bound vs compute-bound
+    diagnosis the prefetch win is measured by."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.prefetch import PrefetchIterator
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim.optimizer import _put_batch
+
+    inp_np, tgt_np = np.asarray(inp), np.asarray(tgt)
+    batch = int(inp_np.shape[0])
+
+    def source():
+        for _ in range(iters):
+            yield MiniBatch(np.ascontiguousarray(inp_np),
+                            np.ascontiguousarray(tgt_np))
+
+    def stage(b):
+        return _put_batch((b.get_input(), b.get_target()), data_sh)
+
+    pipe = PrefetchIterator(source(), depth=2, transform=stage)
+    data_wait = 0.0
+    loss = None
+    t0 = time.perf_counter()
+    try:
+        while True:
+            _beat()
+            g0 = time.perf_counter()
+            item = next(pipe, None)
+            data_wait += time.perf_counter() - g0
+            if item is None:
+                break
+            di, dt_ = item
+            box["params"], box["net_state"], box["opt_state"], loss = \
+                compiled(box["params"], box["net_state"], box["opt_state"],
+                         di, dt_, lr_arr, rng)
+        if loss is not None:
+            float(loss)  # host fetch: the only true sync on this backend
+    finally:
+        pipe.close()
+    wall = time.perf_counter() - t0
+    frac = data_wait / wall if wall > 0 else 0.0
+    return {
+        "records_per_sec_e2e": round(iters * batch / wall, 2),
+        "data_wait_fraction": round(frac, 4),
+        "pipeline_diagnosis": (
+            f"input-bound (data_wait_fraction {frac:.2f} > 0.5: the host "
+            "pipeline gates the chip — raise prefetch depth/threads)"
+            if frac > 0.5 else
+            f"compute-bound (data_wait_fraction {frac:.2f} <= 0.5: the "
+            "device step sets the pace)"),
+        "input_pipeline": {"depth": 2, "staged": True,
+                           "iterations": iters},
+    }
+
+
 def _bench_config(name, build, peak_flops):
     """Time the REAL compiled train step (Optimizer._build_step) on a 1-chip
     mesh; returns images/sec + flops/step + mfu."""
@@ -334,9 +399,17 @@ def _bench_config(name, build, peak_flops):
     from bigdl_tpu.utils.timing import measure_step_seconds
     dt, timing = measure_step_seconds(
         run, log=lambda m: _log(f"{name}: {m}"), progress=_beat)
+    _beat(f"e2e:{name}")
+    try:
+        e2e = _bench_e2e(name, compiled, box, inp, tgt, data_sh,
+                         lr_arr, rng)
+    except Exception as e:  # noqa: BLE001 — e2e must not kill the config
+        _log(f"{name}: e2e input-pipeline bench failed: "
+             f"{type(e).__name__}: {e}")
+        e2e = {"e2e_error": f"{type(e).__name__}: {e}"}
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
-                        jnp.dtype(policy.compute_dtype).name)
+                        jnp.dtype(policy.compute_dtype).name, **e2e)
 
 
 def _bench_resnet50_bf16_autotune(name, build, peak_flops):
@@ -656,6 +729,12 @@ def main(argv=None):
                     help="force a jax platform (e.g. cpu) for local testing; "
                          "env vars are too late under this image's "
                          "sitecustomize, jax.config still works")
+    ap.add_argument("--data", action="store_true",
+                    help="input-pipeline micro-mode: bench the host data "
+                         "pipeline alone (decode/augment/collate, sync vs "
+                         "prefetch vs MT batcher) and exit — touches no "
+                         "jax backend, so it is immune to the "
+                         "jax.devices() tunnel hang (BENCH_r05.json)")
     ap.add_argument("--roofline-n", type=int, default=8192)
     ap.add_argument("--no-scaling", action="store_true",
                     help="skip the virtual-mesh scaling table")
@@ -682,6 +761,8 @@ def main(argv=None):
                          "robustness machinery exercised; deterministic "
                          "count-based schedules")
     args = ap.parse_args(argv)
+    if args.data:
+        return _data_micro_bench()
     t_start = time.perf_counter()
     _beat("init")
     _start_watchdog(args.stall_seconds, args.compile_stall_seconds)
@@ -803,6 +884,8 @@ def _assemble_and_print(args, results, errors, skipped, table_peak,
            "peak_flops_table": table_peak,
            "peak_flops_measured_roofline": measured_peak,
            "peak_flops_used": peak,
+           "records_per_sec_e2e": primary.get("records_per_sec_e2e"),
+           "data_wait_fraction": primary.get("data_wait_fraction"),
            "device": str(devices[0]),
            "device_kind": getattr(devices[0], "device_kind", "unknown"),
            "configs": results}
@@ -823,6 +906,64 @@ def _assemble_and_print(args, results, errors, skipped, table_peak,
             out["scaling_skipped_budget"] = True
             _log("budget: skipping virtual-mesh scaling table")
     print(json.dumps(out))
+    sys.stdout.flush()
+    _EMIT_DONE.set()
+
+
+def _data_micro_bench(n_images=512, batch=64, hw=48):
+    """`--data`: the input pipeline alone, on the host CPU — no jax import,
+    no backend, no tunnel.  A synthetic image corpus runs the canonical
+    augment chain (crop/flip/normalize/to-sample/batch) three ways: the
+    sequential chain, the chain behind the background prefetcher (the
+    train-loop default), and the MT batcher (parallel augment feeding
+    collation).  Prints ONE JSON line."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.dataset.image import (HFlip, ImgNormalizer, ImgRdmCropper,
+                                         ImgToSample, LabeledImage,
+                                         MTImageToBatch)
+    from bigdl_tpu.dataset.prefetch import PrefetchIterator, prefetch_depth
+
+    rng = np.random.default_rng(0)
+    records = [LabeledImage(
+        rng.standard_normal((hw, hw, 3)).astype(np.float32),
+        float(i % 10)) for i in range(n_images)]
+    aug = (ImgRdmCropper(hw - 8, hw - 8) >> HFlip() >>
+           ImgNormalizer([0.5, 0.5, 0.5], [0.25, 0.25, 0.25]))
+    chain = aug >> ImgToSample() >> SampleToMiniBatch(batch, drop_last=True)
+
+    def timed(run):
+        run()  # warmup (allocator, pools)
+        t0 = time.perf_counter()
+        count = run()
+        return round(count / (time.perf_counter() - t0), 1)
+
+    def run_sync():
+        return sum(b.size() for b in chain(iter(records)))
+
+    def run_prefetch():
+        with PrefetchIterator(chain(iter(records)), depth=2) as pipe:
+            return sum(b.size() for b in pipe)
+
+    mt = MTImageToBatch(batch, transformer=aug, drop_last=True)
+
+    def run_mt():
+        return sum(b.size() for b in mt(iter(records)))
+
+    sync_rps = timed(run_sync)
+    prefetch_rps = timed(run_prefetch)
+    mt_rps = timed(run_mt)
+    print(json.dumps({
+        "metric": "input_pipeline_records_per_sec", "value": mt_rps,
+        "unit": "records/s", "vs_baseline": round(mt_rps / sync_rps, 3),
+        "mode": "data-micro",
+        "sync_records_per_sec": sync_rps,
+        "prefetch_records_per_sec": prefetch_rps,
+        "mt_batcher_records_per_sec": mt_rps,
+        "prefetch_depth": prefetch_depth(),
+        "images": n_images, "batch_size": batch,
+        "image_hw": hw, "num_threads": mt.num_threads}))
     sys.stdout.flush()
     _EMIT_DONE.set()
 

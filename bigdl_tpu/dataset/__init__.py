@@ -29,6 +29,7 @@ logger = logging.getLogger("bigdl_tpu")
 from .sample import Sample, MiniBatch, PaddingParam, FixedLength
 from .transformer import (Transformer, ChainedTransformer, SampleToMiniBatch,
                           MTSampleToMiniBatch, Identity)
+from .prefetch import PrefetchIterator, ThreadedShardReader
 from .text import (SentenceSplitter, SentenceTokenizer, SentenceBiPadding,
                    Dictionary, LabeledSentence, TextToLabeledSentence,
                    LabeledSentenceToSample)
@@ -39,7 +40,8 @@ __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "SampleToMiniBatch", "MTSampleToMiniBatch", "Identity", "SentenceSplitter",
            "SentenceTokenizer", "SentenceBiPadding", "Dictionary",
            "LabeledSentence", "TextToLabeledSentence",
-           "LabeledSentenceToSample", "StreamingRecordDataSet"]
+           "LabeledSentenceToSample", "StreamingRecordDataSet",
+           "PrefetchIterator", "ThreadedShardReader"]
 
 
 class AbstractDataSet:
@@ -184,10 +186,12 @@ class StreamingRecordDataSet(AbstractDataSet):
     for the current shard order, preserving the equal-step invariant the
     per-step collectives require (see DistributedDataSet.data).  Shard
     record counts come from a header-walk (recordio.count_records) — no
-    decoding.  `num_threads` streams through the native prefetcher within
-    each process for TRAINING passes; eval passes always use the
-    sequential reader so output order matches input order (Predictor
-    aligns predictions positionally).
+    decoding.  `num_threads` streams TRAINING passes through the native
+    prefetcher within each process, or through the pure-Python threaded
+    reader (dataset/prefetch.ThreadedShardReader) when the native
+    library is absent — never a silent downgrade to sequential reads;
+    eval passes always use the sequential reader so output order matches
+    input order (Predictor aligns predictions positionally).
 
     Corrupt-record quarantine: `skip_budget` (default: the
     ``BIGDL_TPU_DATA_SKIP_BUDGET`` env knob, 0 = fail loud) bounds how
@@ -283,13 +287,13 @@ class StreamingRecordDataSet(AbstractDataSet):
 
         try:
             if train and self.num_threads > 0 and skip.budget <= 0 and \
-                    not chaos.armed("data.record") and \
-                    type(self)._read_shard is \
-                    StreamingRecordDataSet._read_shard:
+                    not chaos.armed("data.record"):
                 # the native prefetcher speaks the BDRecord codec only,
                 # and can neither resync past corruption nor inject chaos
                 from ..utils import native
-                if native.is_native_loaded() and native.has_prefetch():
+                if type(self)._read_shard is \
+                        StreamingRecordDataSet._read_shard and \
+                        native.is_native_loaded() and native.has_prefetch():
                     with native.NativePrefetchReader(
                             paths, num_threads=self.num_threads) as reader:
                         for payload in reader:
@@ -298,6 +302,21 @@ class StreamingRecordDataSet(AbstractDataSet):
                             emitted += 1
                             yield pickle.loads(payload)
                     return
+                # pure-Python threaded fallback: N reader threads
+                # interleave whole shards into one bounded queue instead
+                # of silently degrading to sequential reads; codec
+                # subclasses (seqfile) get it too, since each thread runs
+                # this instance's _read_shard
+                from .prefetch import ThreadedShardReader
+                with ThreadedShardReader(
+                        paths, self.num_threads,
+                        lambda p: self._read_shard(p, skip=skip)) as reader:
+                    for rec in reader:
+                        if not within_cap():
+                            return
+                        emitted += 1
+                        yield rec
+                return
             for p in paths:
                 for rec in self._read_shard(p, skip=skip):
                     if not within_cap():
@@ -409,8 +428,11 @@ class DataSet:
         under distributed=True, where every process must hold the identical
         list for the seeded permutation + strided slice to partition
         correctly — so distributed mode always uses the deterministic
-        sequential read.  Falls back to the sequential reader when the
-        native library is absent."""
+        sequential read.  When the native library is absent (or predates
+        the prefetch symbols) the load runs through the pure-Python
+        threaded reader instead (dataset/prefetch.ThreadedShardReader) —
+        same interleaved-order contract, never a silent downgrade to
+        sequential reads."""
         import glob as _glob
         from ..utils.recordio import read_records
         paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
@@ -427,6 +449,11 @@ class DataSet:
                     # payloads are pickled by write_records; decode like
                     # read_records does
                     records = [pickle.loads(b) for b in reader]
+            else:
+                from .prefetch import ThreadedShardReader
+                with ThreadedShardReader(paths, num_threads,
+                                         read_records) as reader:
+                    records = list(reader)
         if records is None:
             records = [rec for p in paths for rec in read_records(p)]
         return DataSet.array(records, distributed=distributed, seed=seed)

@@ -9,8 +9,10 @@ Reference: BigDL `dataset/image/` (2,204 LoC) — `LabeledBGRImage`,
 TPU-native re-design: images are numpy float32 HWC arrays (RGB order — the
 reference's BGR was an OpenCV artifact); transformers are numpy-vectorized and
 run on the host CPU feeding the device.  The multi-threaded batcher role
-(MTLabeledBGRImgToBatch) is played by the native prefetcher
-(bigdl_tpu.utils.prefetch).
+(MTLabeledBGRImgToBatch) is :class:`MTImageToBatch` below — parallel
+decode/augment feeding one collation — composing with the shard-level
+native prefetcher (csrc/prefetch.cc) and the batch-level background
+prefetcher (dataset/prefetch.PrefetchIterator).
 """
 
 from __future__ import annotations
@@ -20,13 +22,14 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from .sample import Sample
+from .sample import MiniBatch, Sample
 from .transformer import Transformer
 
 __all__ = ["LabeledImage", "load_image_folder", "LocalImgReader",
            "ImgCropper", "ImgRdmCropper", "RdmResizedCrop", "ImgNormalizer",
            "ImgPixelNormalizer", "HFlip", "ColorJitter", "Lighting",
-           "ImgToSample", "GreyImgNormalizer", "ChannelScaledNormalizer"]
+           "ImgToSample", "GreyImgNormalizer", "ChannelScaledNormalizer",
+           "MTImageToBatch"]
 
 
 class LabeledImage:
@@ -296,6 +299,97 @@ class Lighting(Transformer):
             alpha = self.rng.normal(0, self.alphastd, 3).astype(np.float32)
             noise = (self.EIGVEC * alpha) @ self.EIGVAL
             yield LabeledImage(img.data + noise, img.label)
+
+
+class MTImageToBatch(Transformer):
+    """Multi-threaded image batcher: parallel decode/augment feeding one
+    collation — the `MTLabeledBGRImgToBatch` analog (reference:
+    dataset/image/MTLabeledBGRImgToBatch.scala, parallelism width
+    Engine.coreNumber).
+
+    Each incoming batch-worth of LabeledImages is split into contiguous
+    slices across `num_threads` workers; every worker runs its own CLONE
+    of the per-image `transformer` chain over its slice (the reference
+    clones transformers per thread, Transformer.scala:56) and the
+    transformed images are collated into one MiniBatch with the native
+    parallel gather kernel when built (csrc/hostops.cc).  Images in,
+    MiniBatches out — compose it after a reader:
+    ``LocalImgReader(256) >> MTImageToBatch(128, crop >> flip >> norm)``.
+
+    The per-image transformer must map one image to one image (true of
+    every crop/flip/jitter/normalize transformer here); a count change
+    raises instead of silently emitting wrong-size batches.  Worker
+    clones start from the clone-time RNG state, so augmentation draws
+    depend on the thread count and slice boundaries — like the
+    reference's per-thread transformers, the MT batcher trades exact RNG
+    reproducibility across thread counts for parallelism.  Use the
+    sequential chain + dataset/prefetch.PrefetchIterator when
+    bit-reproducibility matters more than host throughput.
+    """
+
+    def __init__(self, batch_size: int, transformer: Transformer = None,
+                 to_chw: bool = False, num_threads: Optional[int] = None,
+                 drop_last: bool = False, pad_last: bool = False):
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self.to_chw = to_chw
+        self.num_threads = num_threads or min(8, os.cpu_count() or 1)
+        self.drop_last = drop_last
+        self.pad_last = pad_last
+
+    def _slice_task(self, images):
+        tf = (self.transformer.clone_transformer()
+              if self.transformer is not None else None)
+        out = list(tf(iter(images))) if tf is not None else images
+        if len(out) != len(images):
+            raise ValueError(
+                "MTImageToBatch requires a 1:1 image transformer (slice "
+                f"of {len(images)} became {len(out)}); apply filtering "
+                "transformers upstream of the batcher")
+        feats, labels = [], []
+        for img in out:
+            data = img.data
+            if self.to_chw:
+                data = np.transpose(data, (2, 0, 1))
+            feats.append(np.ascontiguousarray(data))
+            labels.append(np.int32(img.label))
+        return feats, labels
+
+    def __call__(self, it):
+        from ..utils.thread_pool import ThreadPool
+
+        pool = ThreadPool(self.num_threads)
+        try:
+            buf = []
+            for img in it:
+                buf.append(img)
+                if len(buf) == self.batch_size:
+                    yield self._assemble(pool, buf)
+                    buf = []
+            if buf and not self.drop_last:
+                valid = len(buf)
+                if self.pad_last:
+                    while len(buf) < self.batch_size:
+                        buf.append(buf[-1])
+                b = self._assemble(pool, buf)
+                b.valid = valid
+                yield b
+        finally:
+            pool.shutdown()
+
+    def _assemble(self, pool, images):
+        from ..utils.native import gather_rows
+        n = max(1, min(self.num_threads, len(images)))
+        per = (len(images) + n - 1) // n
+        slices = [images[i:i + per] for i in range(0, len(images), per)]
+        parts = pool.invoke_and_wait(
+            [lambda s=s: self._slice_task(s) for s in slices])
+        feats = [f for fs, _ in parts for f in fs]
+        labels = [l for _, ls in parts for l in ls]
+        # gather_rows for BOTH, like SampleToMiniBatch._batch — batches are
+        # byte-identical to the sequential ImgToSample >> SampleToMiniBatch
+        # chain (drop-in parity)
+        return MiniBatch(gather_rows(feats), gather_rows(labels))
 
 
 class ImgToSample(Transformer):

@@ -229,6 +229,21 @@ def _local_rows(tree):
     return jax.tree.map(local, tree)
 
 
+def _prefetched_input(data_iter):
+    """Wrap an evaluation-side input iterator in the shared background
+    prefetcher (dataset/prefetch.py) — train and eval use ONE overlap
+    mechanism.  Returns (iterator, pipe-or-None); depth 0 passes the
+    iterator through untouched.  The caller must close the pipe."""
+    from ..dataset.prefetch import PrefetchIterator, prefetch_depth
+    depth = prefetch_depth()
+    if depth <= 0:
+        return iter(data_iter), None
+    pipe = PrefetchIterator(data_iter, depth=depth,
+                            supervisor=supervision.get_active(),
+                            name="bigdl-eval-prefetch")
+    return pipe, pipe
+
+
 def _put_batch(batch, sharding):
     """Host batch -> sharded global device arrays.
 
@@ -298,6 +313,10 @@ class Optimizer:
         # BIGDL_TPU_SUPERVISE_* env knobs
         self._supervise_cfg = None
         self._sup = None
+        # the current epoch's background input pipeline (closed at epoch
+        # end and — via _optimize_with_retry — on ANY exit from
+        # _optimize_impl, so retry re-entries never leak worker threads)
+        self._active_pipe = None
 
     # ------------------------------------------------------------------
     # fluent config (reference: optim/Optimizer.scala:98-255)
@@ -430,8 +449,17 @@ class Optimizer:
         self.warmup_iterations = warmup_iteration
         return self
 
-    def _straggler_check(self, data_wait: float, neval: int) -> bool:
-        """Record this iteration's host data-wait; True -> drop it."""
+    def _straggler_check(self, data_wait: float, neval: int,
+                         queue_depth: Optional[int] = None) -> bool:
+        """Record this iteration's host data-wait; True -> drop it.
+
+        `queue_depth` is the prefetch queue's ready-item count at fetch
+        time (None on the synchronous path): a NON-EMPTY queue means the
+        consumer, not the input pipeline, set this iteration's pace — a
+        slow step or a validation/checkpoint boundary — so the iteration
+        is never dropped, whatever its wall time looked like."""
+        if queue_depth is not None:
+            self.metrics.add("prefetch queue depth", float(queue_depth))
         if self.drop_percentage <= 0:
             return False
         from ..utils.util import kth_largest
@@ -460,6 +488,8 @@ class Optimizer:
         if self._drop_threshold is None:
             return False
         if data_wait <= self._drop_threshold:
+            return False
+        if queue_depth:  # > 0: pipeline was ahead; consumer set the pace
             return False
         if (self._dropped_in_window + 1) / self.threshold_batch_size > \
                 self.max_drop_percentage:
@@ -539,6 +569,53 @@ class Optimizer:
             policy=cfg.get("policy"), peer_dir=peer_dir, rank=rank,
             world=world, peer_stale=cfg.get("peer_stale"),
             poll_interval=cfg.get("poll_interval"))
+
+    # ------------------------------------------------------------------
+    # input pipeline
+    # ------------------------------------------------------------------
+
+    def _open_data_pipeline(self, data_sh):
+        """One epoch's input iterator: `(iterator, pipe-or-None)`.
+
+        Depth 0 (``BIGDL_TPU_PREFETCH_DEPTH=0``) keeps the synchronous
+        path byte-for-byte: the caller runs the chaos hooks and
+        `_put_batch` itself.  Depth > 0 (default 2) moves the entire
+        transformer chain + `data.batch` chaos into a background worker
+        (dataset/prefetch.PrefetchIterator) and — when staging is on —
+        device_puts the NEXT batch under the training sharding while the
+        current step executes, true host->device double-buffering.  Pipe
+        items are ``(host_batch, staged_or_None)``.
+
+        Staging defaults to single-process runs
+        (``BIGDL_TPU_PREFETCH_STAGE`` forces it either way); one worker
+        thread keeps batch order and every per-record RNG draw identical
+        to the synchronous path."""
+        from ..dataset import prefetch as prefetch_mod
+        from ..utils import config
+        src = self.dataset.data(train=True)
+        depth = prefetch_mod.prefetch_depth()
+        if depth <= 0:
+            return iter(src), None
+        stage = config.get_bool("PREFETCH_STAGE", jax.process_count() == 1)
+
+        def produce(batch):
+            # chaos fault point: one count per training minibatch, same
+            # schedules as the sync path — fail@ re-raises at the
+            # consumer's next() into the retry loop; corrupt@/nan@
+            # poisons the features BEFORE staging so the non-finite-loss
+            # sentinel still catches the batch that reaches the device
+            batch = chaos.transform("data.batch", batch)
+            staged = None
+            if stage:
+                staged = _put_batch((batch.get_input(), batch.get_target()),
+                                    data_sh)
+            return batch, staged
+
+        pipe = prefetch_mod.PrefetchIterator(
+            src, depth=depth, transform=produce,
+            pre_fire=lambda: chaos.fire("data.stall"),
+            supervisor=self._sup, phase="data")
+        return pipe, pipe
 
     # ------------------------------------------------------------------
     # compiled step
@@ -744,11 +821,22 @@ class Optimizer:
                 for sig, h in old_handlers.items():
                     _signal.signal(sig, h)
 
+    def _close_data_pipeline(self):
+        """Shut down the current epoch's prefetch worker (idempotent) —
+        joined, not abandoned, so a StallError retry re-entry starts with
+        the same thread count it crashed with."""
+        pipe, self._active_pipe = self._active_pipe, None
+        if pipe is not None:
+            pipe.close()
+
     def _optimize_with_retry(self, retries, max_retries, window,
                              last_failure) -> Module:
         while True:
             try:
-                return self._optimize_impl()
+                try:
+                    return self._optimize_impl()
+                finally:
+                    self._close_data_pipeline()
             except (KeyboardInterrupt, ConfigurationError,
                     TrainingPreempted):
                 raise
@@ -997,25 +1085,38 @@ class Optimizer:
             self.dataset.shuffle()
             epoch_start = time.perf_counter()
             epoch_records = 0
-            data_iter = iter(self.dataset.data(train=True))
+            data_iter, pipe = self._open_data_pipeline(data_sh)
+            self._active_pipe = pipe
             while True:
                 beat("data")
-                # chaos: a deterministic hang in the input pipeline — the
-                # supervisor's 'data' deadline must catch it
-                chaos.fire("data.stall")
+                if pipe is None:
+                    # chaos: a deterministic hang in the input pipeline —
+                    # the supervisor's 'data' deadline must catch it (with
+                    # prefetch on, the worker fires it instead and its
+                    # supervision channel trips the same deadline)
+                    chaos.fire("data.stall")
+                qdepth = pipe.queue_depth() if pipe is not None else None
                 data_t0 = time.perf_counter()
-                batch = next(data_iter, None)
-                if batch is None or self.end_trigger(state):
+                item = next(data_iter, None)
+                if item is None or self.end_trigger(state):
                     break
-                # chaos fault point: one count per training minibatch — a
-                # fail@ schedule lands in the retry loop like any transient
-                # data-pipeline failure (the reference's ExceptionTest); a
-                # corrupt@/nan@ schedule NaN-poisons the batch features,
-                # which the non-finite-loss sentinel must catch
-                batch = chaos.transform("data.batch", batch)
+                if pipe is None:
+                    # chaos fault point: one count per training minibatch
+                    # — a fail@ schedule lands in the retry loop like any
+                    # transient data-pipeline failure (the reference's
+                    # ExceptionTest); a corrupt@/nan@ schedule NaN-poisons
+                    # the batch features, which the non-finite-loss
+                    # sentinel must catch.  The prefetch worker runs the
+                    # same transform (same counts, same order) before
+                    # staging.
+                    batch = chaos.transform("data.batch", item)
+                    staged = None
+                else:
+                    batch, staged = item
                 data_wait = time.perf_counter() - data_t0
                 self.metrics.add("get batch time average", data_wait)
-                if self._straggler_check(data_wait, state["neval"]):
+                if self._straggler_check(data_wait, state["neval"],
+                                         queue_depth=qdepth):
                     continue
                 beat("compile" if first_step else "step")
                 first_step = False
@@ -1024,7 +1125,10 @@ class Optimizer:
                 chaos.fire("step.stall")
                 iter_start = time.perf_counter()
                 lr = float(optim.get_learning_rate(state))
-                inp, tgt = _put_batch(
+                # double-buffered path: the worker already device_put this
+                # batch (under the same sharding) while the previous step
+                # was executing
+                inp, tgt = staged if staged is not None else _put_batch(
                     (batch.get_input(), batch.get_target()), data_sh)
                 rng = next_rng_key()
                 params, net_state, opt_state, loss = step_fn(
@@ -1101,6 +1205,7 @@ class Optimizer:
                         f"{state['neval'] - 1}; resume with "
                         "Optimizer.resume_from or the retry loop of the "
                         "next incarnation")
+            self._close_data_pipeline()
             if pending_loss is not None:
                 state["loss"] = self._observe_loss(float(pending_loss),
                                                    state)
@@ -1562,21 +1667,30 @@ class Evaluator:
                 r = m(out_np, tgt_np)
                 totals[i] = r if totals[i] is None else totals[i] + r
 
-        # 1-deep pipeline: dispatch batch i+1 (async) BEFORE fetching batch
+        # Two-sided overlap: the INPUT side runs the host batching chain in
+        # the shared background prefetcher (_prefetched_input — the same
+        # mechanism the train loop uses); the OUTPUT side keeps the 1-deep
+        # pipeline that dispatches batch i+1 (async) BEFORE fetching batch
         # i's bytes, so device compute overlaps the host metric work — the
-        # device-side analog of the reference's executor fan-out.  Inert in
-        # multi-host runs (_local_rows inside the engine already fetched to
-        # host), so skip the extra liveness there
+        # device-side analog of the reference's executor fan-out.  The
+        # output pipeline is inert in multi-host runs (_local_rows inside
+        # the engine already fetched to host), so skip the extra liveness
+        # there
         pipeline = jax.process_count() == 1
         pending = None
-        for batch in dataset.data(train=False):
-            out, n = self._engine(batch.get_input())
-            if not pipeline:
-                consume(out, n, batch)
-                continue
-            if pending is not None:
-                consume(*pending)
-            pending = (out, n, batch)
+        it, pipe = _prefetched_input(dataset.data(train=False))
+        try:
+            for batch in it:
+                out, n = self._engine(batch.get_input())
+                if not pipeline:
+                    consume(out, n, batch)
+                    continue
+                if pending is not None:
+                    consume(*pending)
+                pending = (out, n, batch)
+        finally:
+            if pipe is not None:
+                pipe.close()
         if pending is not None:
             consume(*pending)
         return list(zip(methods, totals))
@@ -1604,15 +1718,20 @@ class Predictor:
             outs = []
             pipeline = jax.process_count() == 1
             pending = None  # 1-deep pipeline (see Evaluator.test)
-            for batch in dataset.data(train=False):
-                out, n = self._engine(batch.get_input())
-                if not pipeline:
-                    outs.append(np.asarray(out)[:min(batch.valid, n)])
-                    continue
-                if pending is not None:
-                    pout, pn, pvalid = pending
-                    outs.append(np.asarray(pout)[:min(pvalid, pn)])
-                pending = (out, n, batch.valid)
+            it, pipe = _prefetched_input(dataset.data(train=False))
+            try:
+                for batch in it:
+                    out, n = self._engine(batch.get_input())
+                    if not pipeline:
+                        outs.append(np.asarray(out)[:min(batch.valid, n)])
+                        continue
+                    if pending is not None:
+                        pout, pn, pvalid = pending
+                        outs.append(np.asarray(pout)[:min(pvalid, pn)])
+                    pending = (out, n, batch.valid)
+            finally:
+                if pipe is not None:
+                    pipe.close()
             if pending is not None:
                 pout, pn, pvalid = pending
                 outs.append(np.asarray(pout)[:min(pvalid, pn)])

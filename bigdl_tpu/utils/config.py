@@ -38,6 +38,8 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_SUPERVISE_POLICY | (net-new: stall response — raise StallError or hard-exit) | raise |
 | BIGDL_TPU_SUPERVISE_PEER_STALE | (net-new: multi-host heartbeat staleness threshold, seconds) | 60 |
 | BIGDL_TPU_DATA_SKIP_BUDGET | (net-new: corrupt records quarantined per data pass; utils/recordio.py) | 0 (fail loud) |
+| BIGDL_TPU_PREFETCH_DEPTH | (net-new: background input-pipeline depth in batches, dataset/prefetch.py; 0 = synchronous path) | 2 |
+| BIGDL_TPU_PREFETCH_STAGE | (net-new: stage the next batch onto devices from the prefetch worker — host->device double-buffering) | 1 single-process, 0 multi-host |
 """
 
 from __future__ import annotations

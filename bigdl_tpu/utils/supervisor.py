@@ -38,6 +38,14 @@ Core pieces
     emit-partial-results-and-exit watchdog is this supervisor with a
     callback — one liveness mechanism, not two).
 
+- Auxiliary **channels** (:meth:`Supervisor.channel`): background workers
+  of the supervised loop — the input-pipeline prefetch thread
+  (dataset/prefetch.py) — heartbeat their own slot, watched against the
+  same per-phase deadlines.  A stalled worker trips its phase deadline
+  even while the main thread is busy inside a step (and a busy worker
+  can never mask a stalled main loop); the StallError is async-raised
+  into the WORKER, which forwards it to the consumer's ``next()``.
+
 - Multi-host liveness: each process publishes a heartbeat file
   (``<peer_dir>/heartbeat.<rank>``, JSON with the last beat's wall time)
   through ``file_io``; every supervisor flags peers whose heartbeats go
@@ -152,6 +160,24 @@ def notify(phase: Optional[str] = None) -> None:
         sup.beat(phase)
 
 
+class _Channel:
+    """Heartbeat handle for one auxiliary supervised thread (see
+    Supervisor.channel).  beat(None) refreshes the timer without changing
+    the phase; close() retires the slot (idempotent)."""
+
+    __slots__ = ("_sup", "name")
+
+    def __init__(self, sup: "Supervisor", name: str):
+        self._sup = sup
+        self.name = name
+
+    def beat(self, phase: Optional[str] = None) -> None:
+        self._sup._beat_channel(self.name, phase)
+
+    def close(self) -> None:
+        self._sup._close_channel(self.name)
+
+
 def _platform_info() -> dict:
     """Best-effort environment snapshot for the crash report.  Must never
     touch the backend (jax.devices() can hang — it may be WHY we are
@@ -223,6 +249,13 @@ class Supervisor:
         self._count = 0
         self._last = ("init", self.clock())
         self._thread_id = threading.get_ident()
+        # auxiliary supervised threads (e.g. the input-pipeline prefetch
+        # worker): name -> [phase, last_beat, thread_id, beat_count].
+        # Kept OUT of the main slot/timeline so a worker's liveness can
+        # never mask a stalled main loop (and vice versa) — every channel
+        # is checked against the deadlines independently.
+        self._channels: Dict[str, list] = {}
+        self._chan_seq = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_publish = None
@@ -246,6 +279,37 @@ class Supervisor:
             self._timeline.append((phase, self._count, now,
                                    self.wall_clock()))
             self._thread_id = threading.get_ident()
+
+    def channel(self, name: str, phase: str = "data") -> "_Channel":
+        """Register an auxiliary supervised thread (e.g. the prefetch
+        worker, utils/../dataset/prefetch.py) under its own heartbeat
+        slot.  The channel's phase is watched against the same per-phase
+        deadlines as the main slot, and a missed deadline async-raises
+        the StallError into the CHANNEL's thread — which forwards it to
+        the consumer (the prefetcher re-raises at ``next()``), landing in
+        the retry loop exactly like a main-thread stall.  ``close()`` the
+        returned handle when the worker retires, or its silence would
+        read as a stall."""
+        with self._lock:
+            self._chan_seq += 1
+            key = f"{name}#{self._chan_seq}"
+            self._channels[key] = [phase, self.clock(), None, 0]
+        return _Channel(self, key)
+
+    def _beat_channel(self, key: str, phase: Optional[str]) -> None:
+        now = self.clock()
+        with self._lock:
+            st = self._channels.get(key)
+            if st is None:
+                return
+            st[0] = phase if phase is not None else st[0]
+            st[1] = now
+            st[2] = threading.get_ident()
+            st[3] += 1
+
+    def _close_channel(self, key: str) -> None:
+        with self._lock:
+            self._channels.pop(key, None)
 
     def deadline_for(self, phase: str) -> Optional[float]:
         if phase in self.deadlines:
@@ -304,8 +368,32 @@ class Supervisor:
             try:
                 self._publish_heartbeat()
                 self._check_peers(log=True)
+                now = self.clock()
+                # auxiliary channels first: a stalled input-pipeline
+                # worker is the CAUSE of the main thread's stale data
+                # wait, so its raise (forwarded through the prefetcher's
+                # queue) should own the recovery
+                chan_fired_phase = None
+                with self._lock:
+                    chans = [(k, st[0], st[1], st[2])
+                             for k, st in self._channels.items()]
+                for key, phase, t, tid in chans:
+                    deadline = self.deadline_for(phase)
+                    if not deadline or now - t <= deadline:
+                        continue
+                    if self._handle_stall(phase, now - t, deadline,
+                                          channel=key, channel_tid=tid):
+                        return
+                    chan_fired_phase = phase
                 with self._lock:
                     phase, t = self._last
+                    if chan_fired_phase is not None and \
+                            phase.split(":", 1)[0] == chan_fired_phase:
+                        # the main slot's wait is downstream of the
+                        # channel stall just handled — give it a full
+                        # deadline of grace instead of double-raising
+                        self._last = (phase, self.clock())
+                        continue
                 deadline = self.deadline_for(phase)
                 if not deadline:
                     continue
@@ -318,13 +406,16 @@ class Supervisor:
                 # any single broken report write / peer listing
                 logger.exception("supervisor monitor error (non-fatal)")
 
-    def _handle_stall(self, phase: str, idle: float,
-                      deadline: float) -> bool:
+    def _handle_stall(self, phase: str, idle: float, deadline: float,
+                      channel: Optional[str] = None,
+                      channel_tid: Optional[int] = None) -> bool:
         """Deadline missed: report, then act per callback/policy.
         Returns True when monitoring should stop."""
         self.stalls += 1
         stale = self._check_peers(log=False)
-        msg = (f"supervisor[{self.name}]: phase {phase!r} made no progress "
+        where = f"phase {phase!r}" if channel is None else \
+            f"phase {phase!r} (worker channel {channel!r})"
+        msg = (f"supervisor[{self.name}]: {where} made no progress "
                f"for {idle:.1f}s (deadline {deadline:.1f}s)")
         if stale:
             msg += "; stale peers: " + ", ".join(
@@ -338,8 +429,9 @@ class Supervisor:
             stall = {"phase": phase, "idle_seconds": round(idle, 1),
                      "deadline_seconds": deadline, "report": report_path,
                      "stale_peers": stale, "message": msg}
-            with self._lock:  # grace before any re-fire
-                self._last = (phase, self.clock())
+            if channel is not None:
+                stall["channel"] = channel
+            self._reset_timer(phase, channel)  # grace before any re-fire
             return bool(self.on_stall(stall))
         if self.policy == "exit":
             # the supervised thread is presumed wedged in C (Python can't
@@ -354,17 +446,25 @@ class Supervisor:
             except Exception:  # noqa: BLE001
                 pass
             os._exit(86)
+        # reset the timer so recovery (which beats no phases until it
+        # re-enters the loop) gets a full deadline of grace before the
+        # supervisor can declare a second stall
+        self._reset_timer(phase, channel)
         with self._lock:
-            # reset the timer so recovery (which beats no phases until it
-            # re-enters the loop) gets a full deadline of grace before the
-            # supervisor can declare a second stall
-            self._last = (phase, self.clock())
-            tid = self._thread_id
+            tid = (channel_tid if channel_tid is not None
+                   else self._thread_id)
         _LAST_STALL["message"] = msg
         if not _async_raise(tid, StallError):
             logger.error("supervisor: could not deliver StallError to "
                          "thread %s (already exited?)", tid)
         return False
+
+    def _reset_timer(self, phase: str, channel: Optional[str]) -> None:
+        with self._lock:
+            if channel is None:
+                self._last = (phase, self.clock())
+            elif channel in self._channels:
+                self._channels[channel][1] = self.clock()
 
     # -- crash report ---------------------------------------------------
 
@@ -384,6 +484,10 @@ class Supervisor:
             timeline = [{"phase": p, "count": c,
                          "age_seconds": round(now - t, 3), "time": w}
                         for p, c, t, w in self._timeline]
+            channels = {k: {"phase": st[0],
+                            "age_seconds": round(now - st[1], 3),
+                            "beats": st[3]}
+                        for k, st in self._channels.items()}
         return {"reason": reason or f"phase {phase!r} stalled",
                 "phase": phase,
                 "idle_seconds": round(idle, 3),
@@ -391,6 +495,7 @@ class Supervisor:
                 "time": self.wall_clock(),
                 "rank": self.rank, "world": self.world,
                 "timeline": timeline,
+                "channels": channels,
                 "threads": threads,
                 "chaos_counts": chaos.counts(),
                 "stale_peers": {str(r): round(a, 1)

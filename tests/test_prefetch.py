@@ -1,0 +1,387 @@
+"""Asynchronous input pipeline: threaded prefetch + host->device
+double-buffering (dataset/prefetch.py) and its train-loop wiring.
+
+Covers the PR-3 acceptance contracts: deterministic overlap (wall clock
+~= max(data, step), not sum), bit-identical training between
+BIGDL_TPU_PREFETCH_DEPTH=0 and =2, typed exceptions (CorruptRecord,
+chaos fail@, supervisor StallError) re-raised at the consumer's next(),
+data.stall fired inside the worker still tripping the supervisor 'data'
+deadline, no thread leak across a StallError retry re-entry, and the
+straggler detector's queue-depth guard."""
+
+import glob
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import Engine
+from bigdl_tpu.dataset import (DataSet, PrefetchIterator, Sample,
+                               SampleToMiniBatch, ThreadedShardReader)
+from bigdl_tpu.dataset.prefetch import prefetch_depth
+from bigdl_tpu.optim import Adam, Optimizer, Predictor, Trigger
+from bigdl_tpu.utils import chaos
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator core contracts
+# ---------------------------------------------------------------------------
+
+def test_depth_env_knob(monkeypatch):
+    assert prefetch_depth() == 2  # the documented default
+    monkeypatch.setenv("BIGDL_TPU_PREFETCH_DEPTH", "0")
+    assert prefetch_depth() == 0
+    monkeypatch.setenv("BIGDL_TPU_PREFETCH_DEPTH", "5")
+    assert prefetch_depth() == 5
+
+
+def test_order_completeness_and_transform():
+    with PrefetchIterator(iter(range(100)), depth=3,
+                          transform=lambda x: x * 2) as pipe:
+        out = list(pipe)
+    assert out == [2 * i for i in range(100)]
+    assert not pipe._thread.is_alive()
+
+
+def test_exception_reraised_in_order():
+    def source():
+        yield from (0, 1, 2)
+        raise ValueError("boom at item 4")
+
+    pipe = PrefetchIterator(source(), depth=2)
+    try:
+        assert [next(pipe) for _ in range(3)] == [0, 1, 2]
+        with pytest.raises(ValueError, match="boom at item 4"):
+            next(pipe)
+        with pytest.raises(StopIteration):  # terminal after the raise
+            next(pipe)
+    finally:
+        pipe.close()
+
+
+def test_close_unblocks_producer_and_joins():
+    """A worker parked on a FULL queue (infinite source) must observe
+    close() and exit — the no-leaked-threads discipline."""
+    before = threading.active_count()
+
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pipe = PrefetchIterator(forever(), depth=2)
+    assert next(pipe) == 0
+    pipe.close()
+    assert not pipe._thread.is_alive()
+    deadline = time.monotonic() + 2.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_overlap_wallclock_near_single_cost_bound():
+    """THE acceptance bound: 50 ms/batch transformer + 50 ms/step
+    consumer, 20 steps, depth 2 -> wall < 1.6x the single-cost bound
+    (serialized execution would be ~2x)."""
+    n, data_s, step_s = 20, 0.05, 0.05
+
+    def source():
+        for i in range(n):
+            time.sleep(data_s)  # the slow transformer chain
+            yield i
+
+    t0 = time.perf_counter()
+    consumed = 0
+    with PrefetchIterator(source(), depth=2) as pipe:
+        for _ in pipe:
+            time.sleep(step_s)  # the device step the data work hides under
+            consumed += 1
+    wall = time.perf_counter() - t0
+    assert consumed == n
+    bound = n * max(data_s, step_s)
+    assert wall < 1.6 * bound, (
+        f"prefetch failed to overlap: {wall:.2f}s for {n} steps "
+        f"(single-cost bound {bound:.2f}s, serialized ~{2 * bound:.2f}s)")
+
+
+# ---------------------------------------------------------------------------
+# training determinism: depth 0 vs depth 2 bit-identical
+# ---------------------------------------------------------------------------
+
+class _LossCapture:
+    def __init__(self):
+        self.losses = []
+
+    def add_scalar(self, name, value, step):
+        if name == "Loss":
+            self.losses.append(value)
+
+
+def _mnist_samples(n=192, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(0.0, 0.1, size=(n, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, size=n)
+    return [Sample.from_ndarray(images[i], np.int32(labels[i]))
+            for i in range(n)]
+
+
+def test_training_bit_identical_depth0_vs_depth2(monkeypatch):
+    """The sync path is preserved behind BIGDL_TPU_PREFETCH_DEPTH=0 and
+    the prefetched (staged) path produces the SAME loss sequence and the
+    SAME final params on the LeNet smoke — batch order, RNG draws, and
+    device placement are all bit-identical."""
+    from bigdl_tpu.common import set_seed
+    from bigdl_tpu.models.lenet import LeNet5
+
+    Engine.init()
+    samples = _mnist_samples()
+
+    def train(depth):
+        monkeypatch.setenv("BIGDL_TPU_PREFETCH_DEPTH", depth)
+        set_seed(11)
+        model = LeNet5(10)
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(32, drop_last=True))
+        cap = _LossCapture()
+        opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+               .set_optim_method(Adam(1e-3))
+               .set_end_when(Trigger.max_iteration(5))
+               .set_log_interval(1)
+               .set_train_summary(cap))
+        opt.optimize()
+        import jax
+        return cap.losses, [np.asarray(l) for l in
+                            jax.tree.leaves(model.params)]
+
+    losses_sync, params_sync = train("0")
+    losses_pre, params_pre = train("2")
+    assert len(losses_sync) == 5
+    assert losses_sync == losses_pre  # exact float equality, not allclose
+    for a, b in zip(params_sync, params_pre):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_predictor_prefetch_equivalence(monkeypatch):
+    Engine.init()
+    from bigdl_tpu.models.lenet import LeNet5
+    model = LeNet5(10).build()
+    x = np.random.default_rng(0).normal(size=(50, 28, 28)).astype(np.float32)
+    ds = DataSet.array([Sample.from_ndarray(x[i]) for i in range(50)])
+
+    monkeypatch.setenv("BIGDL_TPU_PREFETCH_DEPTH", "0")
+    probs_sync = Predictor(model, batch_size=16).predict(ds)
+    monkeypatch.setenv("BIGDL_TPU_PREFETCH_DEPTH", "2")
+    probs_pre = Predictor(model, batch_size=16).predict(ds)
+    np.testing.assert_array_equal(probs_sync, probs_pre)
+
+
+# ---------------------------------------------------------------------------
+# robustness contracts through the worker thread
+# ---------------------------------------------------------------------------
+
+def _record_stream(tmp_path, skip_budget, n=60):
+    from bigdl_tpu.utils.recordio import write_records
+    shard = str(tmp_path / "recs.bd")
+    write_records(shard, list(range(n)))
+    return DataSet.record_stream([shard], skip_budget=skip_budget)
+
+
+def test_corrupt_record_skip_budget_through_worker(tmp_path):
+    """data.record corruption with budget 1, consumed THROUGH the
+    prefetch worker: the pass completes, exactly one record is
+    quarantined, and the dataset's accounting (set in the generator's
+    finally, running on the worker) is intact."""
+    ds = _record_stream(tmp_path, skip_budget=1)
+    with chaos.scoped("data.record=truncate@5"):
+        with PrefetchIterator(ds.data(train=True), depth=2) as pipe:
+            got = list(pipe)
+    assert len(got) == 59
+    assert ds.last_quarantined == 1
+
+
+def test_corrupt_record_budget_zero_raises_at_consumer(tmp_path):
+    from bigdl_tpu.utils.recordio import CorruptRecord
+    ds = _record_stream(tmp_path, skip_budget=0)
+    with chaos.scoped("data.record=truncate@5"):
+        with PrefetchIterator(ds.data(train=True), depth=2) as pipe:
+            with pytest.raises(CorruptRecord):
+                list(pipe)
+
+
+def _linear_dataset(n=64, d=6):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(d).astype(np.float32),
+                      np.float32(i % 2)) for i in range(n)]
+    return DataSet.array(samples).transform(
+        SampleToMiniBatch(16, drop_last=True))
+
+
+def test_data_stall_in_worker_trips_data_deadline_no_thread_leak(tmp_path):
+    """data.stall fires INSIDE the prefetch worker; the worker's
+    supervision channel must trip the 'data' deadline, the StallError
+    must land in the retry loop (forwarded through the queue), the run
+    must complete via checkpoint recovery — and the retry re-entry must
+    not leak the stalled worker thread (threading.active_count check)."""
+    before = threading.active_count()
+    with chaos.scoped("data.stall=stall*8@3"):
+        opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)),
+                         _linear_dataset(), nn.CrossEntropyCriterion())
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_epoch(2))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+               .set_supervision(data=0.4, poll_interval=0.1))
+        trained = opt.optimize()
+    assert trained.params is not None
+    reports = glob.glob(str(tmp_path / "crash_report*.json"))
+    assert reports
+    # the report names the worker channel as the stalled party
+    blob = json.loads(open(reports[0]).read())
+    assert "worker channel" in blob["reason"], blob["reason"]
+    # every pipeline/supervisor thread joined after optimize()
+    deadline = time.monotonic() + 3.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
+def test_chaos_fail_through_worker_reaches_retry_loop(tmp_path):
+    """A data.batch fail@ schedule (run by the worker now) must land in
+    the retry loop at the same batch position as the sync path."""
+    with chaos.scoped("data.batch=fail@6"):
+        opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)),
+                         _linear_dataset(), nn.CrossEntropyCriterion())
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_epoch(3))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1)))
+        trained = opt.optimize()
+        assert chaos.counts()["data.batch"] > 6  # continued past the fault
+    assert trained.params is not None
+
+
+# ---------------------------------------------------------------------------
+# straggler detector: queue-depth guard
+# ---------------------------------------------------------------------------
+
+def test_straggler_skips_drop_when_queue_nonempty():
+    opt = Optimizer(nn.Sequential().add(nn.Linear(6, 2)), [],
+                    nn.CrossEntropyCriterion())
+    opt.set_drop_module_property(0.05, 0.5, batch_size=20,
+                                 warmup_iteration=0)
+    for i in range(30):
+        assert opt._straggler_check(0.01, i + 1) is False
+    # a clear straggler wait, but the queue had items ready: the consumer
+    # (not the pipeline) set the pace — never dropped
+    assert opt._straggler_check(1.0, 31, queue_depth=2) is False
+    # same magnitude with an EMPTY queue: genuine pipeline straggler
+    assert opt._straggler_check(2.0, 32, queue_depth=0) is True
+
+
+# ---------------------------------------------------------------------------
+# ThreadedShardReader: the pure-Python native-prefetch fallback
+# ---------------------------------------------------------------------------
+
+def _shards(tmp_path, k=3, per=20):
+    from bigdl_tpu.utils.recordio import write_records
+    paths = []
+    for s in range(k):
+        p = str(tmp_path / f"shard{s}.bd")
+        write_records(p, [s * per + i for i in range(per)])
+        paths.append(p)
+    return paths
+
+
+def test_threaded_shard_reader_yields_everything(tmp_path):
+    from bigdl_tpu.utils.recordio import read_records
+    paths = _shards(tmp_path)
+    with ThreadedShardReader(paths, 2, read_records) as reader:
+        got = list(reader)
+    assert sorted(got) == list(range(60))
+
+
+def test_threaded_shard_reader_surfaces_corruption(tmp_path):
+    from bigdl_tpu.utils.recordio import CorruptRecord, read_records
+    paths = _shards(tmp_path)
+    data = open(paths[1], "rb").read()
+    open(paths[1], "wb").write(data[:-3])  # torn tail
+    with pytest.raises(CorruptRecord):
+        with ThreadedShardReader(paths, 2, read_records) as reader:
+            list(reader)
+
+
+def test_record_files_python_threaded_fallback(tmp_path, monkeypatch):
+    """num_threads>0 with no native prefetch symbols must use the
+    threaded Python reader, not silently degrade to sequential reads
+    (dataset/__init__ record_files + StreamingRecordDataSet)."""
+    from bigdl_tpu.utils import native
+    monkeypatch.setattr(native, "has_prefetch", lambda: False)
+    paths = _shards(tmp_path)
+    used = {"threaded": False}
+    from bigdl_tpu.dataset import prefetch as prefetch_mod
+    orig = prefetch_mod.ThreadedShardReader
+
+    class Spy(orig):
+        def __init__(self, *a, **kw):
+            used["threaded"] = True
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(prefetch_mod, "ThreadedShardReader", Spy)
+    ds = DataSet.record_files(paths, num_threads=2)
+    assert used["threaded"] and sorted(ds.records) == list(range(60))
+
+    used["threaded"] = False
+    stream = DataSet.record_stream(paths, num_threads=2)
+    got = sorted(stream.data(train=True))
+    assert used["threaded"] and got == list(range(60))
+    # eval passes stay sequential (order must match input order)
+    used["threaded"] = False
+    assert list(stream.data(train=False)) == list(range(60))
+    assert not used["threaded"]
+
+
+# ---------------------------------------------------------------------------
+# MTImageToBatch: the MTLabeledBGRImgToBatch analog
+# ---------------------------------------------------------------------------
+
+def test_mt_image_batcher_matches_sequential():
+    from bigdl_tpu.dataset.image import (ImgToSample, LabeledImage,
+                                         MTImageToBatch)
+    rng = np.random.default_rng(0)
+    images = [LabeledImage(rng.standard_normal((8, 8, 3)).astype(np.float32),
+                           float(i % 10)) for i in range(70)]
+    seq = list((ImgToSample() >> SampleToMiniBatch(16))(iter(images)))
+    mt = list(MTImageToBatch(16, num_threads=3)(iter(images)))
+    assert len(mt) == len(seq) == 5  # 4 full + 1 partial (drop_last off)
+    for a, b in zip(seq, mt):
+        np.testing.assert_array_equal(a.get_input(), b.get_input())
+        np.testing.assert_array_equal(a.get_target(), b.get_target())
+
+
+def test_mt_image_batcher_rejects_filtering_transformer():
+    from bigdl_tpu.dataset.image import LabeledImage, MTImageToBatch
+    from bigdl_tpu.dataset import Transformer
+
+    class DropHalf(Transformer):
+        def __call__(self, it):
+            for i, img in enumerate(it):
+                if i % 2 == 0:
+                    yield img
+
+    images = [LabeledImage(np.zeros((4, 4, 3), np.float32), 0.0)
+              for _ in range(16)]
+    mt = MTImageToBatch(16, transformer=DropHalf(), num_threads=2)
+    with pytest.raises(ValueError, match="1:1"):
+        list(mt(iter(images)))
+
+
+def test_mt_image_batcher_pad_last_and_valid():
+    from bigdl_tpu.dataset.image import LabeledImage, MTImageToBatch
+    images = [LabeledImage(np.full((4, 4, 3), float(i), np.float32),
+                           float(i)) for i in range(10)]
+    batches = list(MTImageToBatch(8, num_threads=2,
+                                  pad_last=True)(iter(images)))
+    assert [b.size() for b in batches] == [8, 8]
+    assert batches[0].valid == 8 and batches[1].valid == 2

@@ -86,6 +86,18 @@ if [ "$SMOKE" = 1 ]; then
   timeout 300 python tools/supervise_smoke.py --platform cpu \
     > /tmp/supervise_smoke.json 2>/tmp/supervise_smoke.log
   echo "[runbook] supervise rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+
+  # input-pipeline smokes (cpu mode only; no backend touched): the
+  # prefetch overlap proof (wall ~= max(data, step), not sum) and the
+  # pipeline-alone micro-bench (bench.py --data) — both immune to the
+  # jax.devices() tunnel hang
+  echo "[runbook] 2d/4 input-pipeline overlap smoke (prefetch)" >> "$LOG"
+  timeout 120 python tools/input_bench.py \
+    > /tmp/input_bench.json 2>/tmp/input_bench.log
+  echo "[runbook] input_bench rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+  timeout 300 python bench.py --data \
+    > /tmp/bench_data_micro.json 2>/tmp/bench_data_micro.log
+  echo "[runbook] bench --data rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -113,7 +125,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
