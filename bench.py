@@ -135,7 +135,20 @@ def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _flush_trace():
+    """Best-effort final flush of the run tracer (--trace): every bench
+    exit path calls this so a partial trace is still loadable."""
+    try:
+        from bigdl_tpu.utils import telemetry
+        tr = telemetry.get_active()
+        if tr is not None:
+            tr.flush()
+    except Exception:  # noqa: BLE001 — telemetry must never fail the bench
+        pass
+
+
 def _fail(err, stage):
+    _flush_trace()
     if not _claim_emit():
         # another thread claimed the final line (possibly the watchdog
         # emitting a VALID partial-results record with exit 0) — give it a
@@ -291,7 +304,9 @@ def _bench_e2e(name, compiled, box, inp, tgt, data_sh, lr_arr, rng,
 
     from bigdl_tpu.dataset.prefetch import PrefetchIterator
     from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim.metrics import Metrics
     from bigdl_tpu.optim.optimizer import _put_batch
+    from bigdl_tpu.utils import telemetry
 
     inp_np, tgt_np = np.asarray(inp), np.asarray(tgt)
     batch = int(inp_np.shape[0])
@@ -305,7 +320,9 @@ def _bench_e2e(name, compiled, box, inp, tgt, data_sh, lr_arr, rng,
         return _put_batch((b.get_input(), b.get_target()), data_sh)
 
     pipe = PrefetchIterator(source(), depth=2, transform=stage)
-    data_wait = 0.0
+    # the SAME Metrics counter shape the train loop keeps (one source for
+    # the epoch log, the bench record, and telemetry — Metrics.snapshot)
+    metrics = Metrics()
     loss = None
     t0 = time.perf_counter()
     try:
@@ -313,18 +330,26 @@ def _bench_e2e(name, compiled, box, inp, tgt, data_sh, lr_arr, rng,
             _beat()
             g0 = time.perf_counter()
             item = next(pipe, None)
-            data_wait += time.perf_counter() - g0
+            dw = time.perf_counter() - g0
+            metrics.add("get batch time average", dw)
+            telemetry.complete("data", dw)
             if item is None:
                 break
             di, dt_ = item
+            s0 = time.perf_counter()
             box["params"], box["net_state"], box["opt_state"], loss = \
                 compiled(box["params"], box["net_state"], box["opt_state"],
                          di, dt_, lr_arr, rng)
+            step_s = time.perf_counter() - s0
+            metrics.add("computing time average", step_s)
+            telemetry.complete("step", step_s)
+            telemetry.counter("bench_e2e", data_wait_s=dw, step_s=step_s)
         if loss is not None:
             float(loss)  # host fetch: the only true sync on this backend
     finally:
         pipe.close()
     wall = time.perf_counter() - t0
+    data_wait = metrics.get("get batch time average")[0]
     frac = data_wait / wall if wall > 0 else 0.0
     return {
         "records_per_sec_e2e": round(iters * batch / wall, 2),
@@ -335,6 +360,7 @@ def _bench_e2e(name, compiled, box, inp, tgt, data_sh, lr_arr, rng,
             if frac > 0.5 else
             f"compute-bound (data_wait_fraction {frac:.2f} <= 0.5: the "
             "device step sets the pace)"),
+        "metrics": metrics.snapshot(),
         "input_pipeline": {"depth": 2, "staged": True,
                            "iterations": iters},
     }
@@ -735,6 +761,11 @@ def main(argv=None):
                          "prefetch vs MT batcher) and exit — touches no "
                          "jax backend, so it is immune to the "
                          "jax.devices() tunnel hang (BENCH_r05.json)")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="emit a run trace (Chrome trace-event JSON, "
+                         "bigdl_tpu.utils.telemetry) into DIR for ANY "
+                         "bench mode; inspect with tools/trace_report.py "
+                         "or load trace.<rank>.json in Perfetto")
     ap.add_argument("--roofline-n", type=int, default=8192)
     ap.add_argument("--no-scaling", action="store_true",
                     help="skip the virtual-mesh scaling table")
@@ -761,6 +792,12 @@ def main(argv=None):
                          "robustness machinery exercised; deterministic "
                          "count-based schedules")
     args = ap.parse_args(argv)
+    if args.trace:
+        # arm run telemetry for this process (and, via the env knob, any
+        # subprocess stages): every bench mode emits trace.<rank>.json
+        os.environ["BIGDL_TPU_TRACE"] = args.trace
+        from bigdl_tpu.utils import telemetry
+        telemetry.maybe_start()
     if args.data:
         return _data_micro_bench()
     t_start = time.perf_counter()
@@ -835,7 +872,9 @@ def main(argv=None):
                         else _bench_resnet50_bf16_autotune
                         if name == "resnet50_bf16"
                         else _bench_config)
-            results[name] = bench_fn(name, CONFIGS[name], peak)
+            from bigdl_tpu.utils import telemetry
+            with telemetry.span(f"bench:{name}", cat="bench"):
+                results[name] = bench_fn(name, CONFIGS[name], peak)
         except Exception as e:  # noqa: BLE001 — recorded per config
             errors[name] = f"{type(e).__name__}: {e}"
             _log(f"config {name} failed: {errors[name]}")
@@ -905,6 +944,7 @@ def _assemble_and_print(args, results, errors, skipped, table_peak,
         else:
             out["scaling_skipped_budget"] = True
             _log("budget: skipping virtual-mesh scaling table")
+    _flush_trace()
     print(json.dumps(out))
     sys.stdout.flush()
     _EMIT_DONE.set()
@@ -933,11 +973,14 @@ def _data_micro_bench(n_images=512, batch=64, hw=48):
            ImgNormalizer([0.5, 0.5, 0.5], [0.25, 0.25, 0.25]))
     chain = aug >> ImgToSample() >> SampleToMiniBatch(batch, drop_last=True)
 
-    def timed(run):
+    from bigdl_tpu.utils import telemetry
+
+    def timed(run, label):
         run()  # warmup (allocator, pools)
-        t0 = time.perf_counter()
-        count = run()
-        return round(count / (time.perf_counter() - t0), 1)
+        with telemetry.span(f"bench:data:{label}", cat="bench"):
+            t0 = time.perf_counter()
+            count = run()
+            return round(count / (time.perf_counter() - t0), 1)
 
     def run_sync():
         return sum(b.size() for b in chain(iter(records)))
@@ -951,9 +994,9 @@ def _data_micro_bench(n_images=512, batch=64, hw=48):
     def run_mt():
         return sum(b.size() for b in mt(iter(records)))
 
-    sync_rps = timed(run_sync)
-    prefetch_rps = timed(run_prefetch)
-    mt_rps = timed(run_mt)
+    sync_rps = timed(run_sync, "sync")
+    prefetch_rps = timed(run_prefetch, "prefetch")
+    mt_rps = timed(run_mt, "mt_batcher")
     print(json.dumps({
         "metric": "input_pipeline_records_per_sec", "value": mt_rps,
         "unit": "records/s", "vs_baseline": round(mt_rps / sync_rps, 3),
@@ -965,6 +1008,7 @@ def _data_micro_bench(n_images=512, batch=64, hw=48):
         "images": n_images, "batch_size": batch,
         "image_hw": hw, "num_threads": mt.num_threads}))
     sys.stdout.flush()
+    _flush_trace()
     _EMIT_DONE.set()
 
 

@@ -49,9 +49,10 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, Optional
 
-from ..utils import config
+from ..utils import config, telemetry
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -109,17 +110,23 @@ class PrefetchIterator:
 
     def _run(self) -> None:
         kind, payload = _DONE, None
+        # telemetry: the worker owns its own named thread track — per-item
+        # produce spans land there, separate from the consumer's data_wait
+        telemetry.thread_name(self._thread.name)
         try:
             while not self._stop.is_set():
                 self._beat()
                 if self._pre_fire is not None:
                     self._pre_fire()
+                t0 = time.perf_counter()
                 try:
                     item = next(self._source)
                 except StopIteration:
                     break
                 if self._transform is not None:
                     item = self._transform(item)
+                telemetry.complete("prefetch.item",
+                                   time.perf_counter() - t0)
                 if not self._put((_ITEM, item)):
                     return  # consumer closed while the queue was full
         except BaseException as e:  # noqa: BLE001 — forwarded, including a

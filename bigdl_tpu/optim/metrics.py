@@ -43,9 +43,19 @@ class Metrics:
         c = self._counts[name]
         return self._sums[name] / c if c else 0.0
 
+    def snapshot(self) -> dict:
+        """All counters as ``{name: {mean, count, total}}`` — ONE exportable
+        source for the epoch log, bench records, and telemetry consumers
+        (replaces the ad-hoc per-caller counter paths)."""
+        return {k: {"mean": self.mean(k), "count": self._counts[k],
+                    "total": self._sums[k]} for k in sorted(self._sums)}
+
     def summary(self, unit_scale: float = 1.0) -> str:
-        """(Metrics.scala:103)."""
-        parts = [f"{k} : {self._sums[k] * unit_scale:.6g}"
+        """Driver-log pretty-print: name, mean, count, total per counter
+        (Metrics.scala:103 role, printed at DistriOptimizer.scala:298)."""
+        parts = [f"{k}: mean {self.mean(k) * unit_scale:.6g} "
+                 f"(count {self._counts[k]}, "
+                 f"total {self._sums[k] * unit_scale:.6g})"
                  for k in sorted(self._sums)]
         return "[" + ", ".join(parts) + "]"
 

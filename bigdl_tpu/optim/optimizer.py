@@ -52,7 +52,7 @@ from ..dataset.sample import Sample
 from ..nn.module import Criterion, Module
 from ..parallel.sharding import DataParallel, ShardingStrategy
 from ..utils.engine import Engine
-from ..utils import chaos, file_io
+from ..utils import chaos, file_io, telemetry
 from ..utils import supervisor as supervision
 from .method import OptimMethod, SGD
 from .metrics import Metrics
@@ -809,10 +809,18 @@ class Optimizer:
             self._sup.beat("data")  # arm the timeline before the thread
             self._sup.start()
             supervision.set_active(self._sup)
+        # run telemetry (BIGDL_TPU_TRACE): env-gated span tracer, one
+        # trace.<rank>.json per process.  Only the call that CREATED the
+        # tracer closes it — a bench/tool that armed tracing around this
+        # optimize() keeps ownership.  close() flushes, so the finally
+        # below is also the flush-on-crash path for any raising exit.
+        owned_tracer = telemetry.maybe_start(rank=jax.process_index())
         try:
             return self._optimize_with_retry(retries, max_retries, window,
                                              last_failure)
         finally:
+            if owned_tracer is not None:
+                owned_tracer.close()
             if self._sup is not None:
                 self._sup.stop()
                 self._sup = None
@@ -1115,6 +1123,8 @@ class Optimizer:
                     batch, staged = item
                 data_wait = time.perf_counter() - data_t0
                 self.metrics.add("get batch time average", data_wait)
+                telemetry.complete("data", data_wait,
+                                   neval=state["neval"])
                 if self._straggler_check(data_wait, state["neval"],
                                          queue_depth=qdepth):
                     continue
@@ -1156,10 +1166,22 @@ class Optimizer:
                         "throughput %.1f records/s",
                         state["epoch"], neval, lossf, lr, n / max(dt, 1e-9))
                     if self.train_summary is not None:
+                        # reference parity: Loss + LearningRate + Throughput
+                        # every logged iteration (TrainSummary.scala tags,
+                        # written at DistriOptimizer.scala:345-363)
                         self.train_summary.add_scalar("Loss", lossf, neval)
                         self.train_summary.add_scalar("LearningRate", lr, neval)
                         self.train_summary.add_scalar(
                             "Throughput", n / max(dt, 1e-9), neval)
+                # per-step telemetry: the host-side step span (dispatch,
+                # plus the loss fetch on logged iterations) and the counter
+                # track the trace_report phase breakdown reads
+                step_dur = time.perf_counter() - iter_start
+                telemetry.complete("step", step_dur, neval=neval)
+                telemetry.counter(
+                    "train", data_wait_s=data_wait, step_s=step_dur,
+                    records_per_sec=n / max(step_dur, 1e-9),
+                    prefetch_queue_depth=float(qdepth or 0))
                 # per-parameter histograms when a "Parameters" trigger is set
                 # (reference: DistriOptimizer.saveSummary :426-456 — off by
                 # default because it pulls every weight to host)
@@ -1225,9 +1247,10 @@ class Optimizer:
                     f"samples over {jax.process_count()} process(es)). "
                     "Lower the batch size, add samples, or use "
                     "pad_last=True")
-            logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s)",
-                        state["epoch"], epoch_records, wall,
-                        epoch_records / max(wall, 1e-9))
+            logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s) "
+                        "%s", state["epoch"], epoch_records, wall,
+                        epoch_records / max(wall, 1e-9),
+                        self.metrics.summary())
             state["epoch"] += 1
             # every_epoch triggers observe the epoch increment (state-only
             # predicate, Trigger.scala:37): fire validation/checkpoint now
@@ -1277,7 +1300,8 @@ class Optimizer:
             return
         if self._sup is not None:
             self._sup.beat("validation")
-        results = self._run_validation(params, net_state)
+        with telemetry.span("validation", neval=state["neval"]):
+            results = self._run_validation(params, net_state)
         # observation counter for Trigger.plateau: one validation = one tick
         state["val_obs"] = state.get("val_obs", 0) + 1
         for method, res in results:
@@ -1441,6 +1465,13 @@ class Optimizer:
         so it is rank-consistent."""
         if self._sup is not None:
             self._sup.beat("checkpoint")
+        with telemetry.span("checkpoint", neval=state["neval"] - 1,
+                            preempt=preempt):
+            self._write_checkpoint_impl(params, net_state, state, opt_state,
+                                        preempt)
+
+    def _write_checkpoint_impl(self, params, net_state, state, opt_state,
+                               preempt):
         # collective gather of process-sharded leaves BEFORE the rank gate
         params = self._host_fetchable(params)
         net_state = self._host_fetchable(net_state)
@@ -1680,14 +1711,18 @@ class Evaluator:
         pending = None
         it, pipe = _prefetched_input(dataset.data(train=False))
         try:
-            for batch in it:
-                out, n = self._engine(batch.get_input())
-                if not pipeline:
-                    consume(out, n, batch)
-                    continue
-                if pending is not None:
-                    consume(*pending)
-                pending = (out, n, batch)
+            with telemetry.span("evaluate"):
+                for batch in it:
+                    t0 = time.perf_counter()
+                    out, n = self._engine(batch.get_input())
+                    if not pipeline:
+                        consume(out, n, batch)
+                    else:
+                        if pending is not None:
+                            consume(*pending)
+                        pending = (out, n, batch)
+                    telemetry.complete("eval.batch",
+                                       time.perf_counter() - t0)
         finally:
             if pipe is not None:
                 pipe.close()
@@ -1720,15 +1755,21 @@ class Predictor:
             pending = None  # 1-deep pipeline (see Evaluator.test)
             it, pipe = _prefetched_input(dataset.data(train=False))
             try:
-                for batch in it:
-                    out, n = self._engine(batch.get_input())
-                    if not pipeline:
-                        outs.append(np.asarray(out)[:min(batch.valid, n)])
-                        continue
-                    if pending is not None:
-                        pout, pn, pvalid = pending
-                        outs.append(np.asarray(pout)[:min(pvalid, pn)])
-                    pending = (out, n, batch.valid)
+                with telemetry.span("predict"):
+                    for batch in it:
+                        t0 = time.perf_counter()
+                        out, n = self._engine(batch.get_input())
+                        if not pipeline:
+                            outs.append(
+                                np.asarray(out)[:min(batch.valid, n)])
+                        else:
+                            if pending is not None:
+                                pout, pn, pvalid = pending
+                                outs.append(
+                                    np.asarray(pout)[:min(pvalid, pn)])
+                            pending = (out, n, batch.valid)
+                        telemetry.complete("predict.batch",
+                                           time.perf_counter() - t0)
             finally:
                 if pipe is not None:
                     pipe.close()

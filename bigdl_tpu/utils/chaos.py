@@ -255,11 +255,23 @@ def _bump(point: str):
         return p.count, [s for s in p.schedules if s.fires(p.count)]
 
 
+def _trace_hits(point: str, count: int, hits) -> None:
+    """Mark each schedule hit as an instant event on the run timeline
+    (utils/telemetry) — injected faults become visible right next to the
+    retries/stalls/NaNs they cause.  Only runs when a schedule actually
+    fired, so unarmed points stay free."""
+    from . import telemetry
+    telemetry.instant(f"chaos:{point}", cat="chaos", count=count,
+                      schedules=[repr(s) for s in hits])
+
+
 def fire(point: str) -> None:
     """Count one invocation; raise ChaosFault if a fail schedule matches,
     block if a stall schedule matches.  Corrupt schedules are ignored here
     (no payload to mutate)."""
     count, hits = _bump(point)
+    if hits:
+        _trace_hits(point, count, hits)
     for s in hits:
         if getattr(s, "is_stall", False):
             s.block()
@@ -273,6 +285,8 @@ def transform(point: str, value):
     schedules, else pipe the payload through every matching corrupt
     schedule."""
     count, hits = _bump(point)
+    if hits:
+        _trace_hits(point, count, hits)
     for s in hits:
         if getattr(s, "is_stall", False):
             s.block()

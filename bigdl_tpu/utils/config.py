@@ -40,6 +40,9 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_DATA_SKIP_BUDGET | (net-new: corrupt records quarantined per data pass; utils/recordio.py) | 0 (fail loud) |
 | BIGDL_TPU_PREFETCH_DEPTH | (net-new: background input-pipeline depth in batches, dataset/prefetch.py; 0 = synchronous path) | 2 |
 | BIGDL_TPU_PREFETCH_STAGE | (net-new: stage the next batch onto devices from the prefetch worker — host->device double-buffering) | 1 single-process, 0 multi-host |
+| BIGDL_TPU_TRACE | (net-new: run-telemetry trace output dir, utils/telemetry.py; empty = tracing off) | off |
+| BIGDL_TPU_TRACE_RING | (net-new: max buffered trace events; oldest dropped beyond this) | 65536 |
+| BIGDL_TPU_TRACE_FLUSH_EVERY | (net-new: trace events between automatic file flushes) | 4096 |
 """
 
 from __future__ import annotations

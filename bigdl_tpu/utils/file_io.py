@@ -58,7 +58,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from . import chaos, config
+from . import chaos, config, telemetry
 from .recordio import crc32c_update
 
 logger = logging.getLogger("bigdl_tpu")
@@ -215,6 +215,12 @@ class RetryPolicy:
                 if not ok or attempt > self.retries:
                     raise
                 d = self.delay(attempt)
+                # the retry is visible on the run timeline next to the
+                # checkpoint/data spans it delays (telemetry no-ops when
+                # tracing is off)
+                telemetry.instant("io.retry", cat="io", op=describe,
+                                  attempt=attempt,
+                                  error=f"{type(e).__name__}: {e}")
                 if self.clock() - start + d > self.deadline:
                     logger.warning("remote IO %s: deadline %.1fs exhausted "
                                    "after %d attempts", describe,
@@ -454,32 +460,34 @@ def save(obj: Any, path: str, overwrite: bool = True) -> None:
     Remote writes verify by reading the bytes back; a mismatch (torn
     write) retries the write under the IO RetryPolicy."""
     path = _strip_file_scheme(path)
-    fs = get_filesystem(path)
-    # check order matters: exists() can be a remote round-trip, skip it
-    # entirely in the default overwrite=True case
-    if not overwrite and fs.exists(path):
-        raise FileExistsError(path)
-    obj = _to_numpy(obj)
-    if hasattr(fs, "write_pickle") and not chaos.armed("ckpt.write"):
-        fs.write_pickle(path, obj)  # local: stream, no whole-blob copy
-        return
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    # chaos mutates the FRAMED bytes: a corrupt@ schedule lands a file
-    # whose CRC verification must fail at read time
-    data = chaos.transform("ckpt.write", frame_bytes(payload))
-    if hasattr(fs, "write_pickle"):  # local path with chaos armed
-        fs.write_bytes(path, data)
-        return
-    def write_and_verify():
-        fs.write_bytes(path, data)
-        back = fs.read_bytes(path)
-        if back != data:
-            raise CorruptCheckpoint(
-                f"{path}: remote readback mismatch (wrote {len(data)} "
-                f"bytes, read {len(back)} back)")
-    # readback mismatch IS retriable here — the fix is another write
-    RetryPolicy().run(write_and_verify, describe=f"save({path})",
-                      retriable=lambda e: True)
+    with telemetry.span("ckpt.write", cat="io", path=path):
+        fs = get_filesystem(path)
+        # check order matters: exists() can be a remote round-trip, skip it
+        # entirely in the default overwrite=True case
+        if not overwrite and fs.exists(path):
+            raise FileExistsError(path)
+        obj = _to_numpy(obj)
+        if hasattr(fs, "write_pickle") and not chaos.armed("ckpt.write"):
+            fs.write_pickle(path, obj)  # local: stream, no whole-blob copy
+            return
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        # chaos mutates the FRAMED bytes: a corrupt@ schedule lands a file
+        # whose CRC verification must fail at read time
+        data = chaos.transform("ckpt.write", frame_bytes(payload))
+        if hasattr(fs, "write_pickle"):  # local path with chaos armed
+            fs.write_bytes(path, data)
+            return
+
+        def write_and_verify():
+            fs.write_bytes(path, data)
+            back = fs.read_bytes(path)
+            if back != data:
+                raise CorruptCheckpoint(
+                    f"{path}: remote readback mismatch (wrote {len(data)} "
+                    f"bytes, read {len(back)} back)")
+        # readback mismatch IS retriable here — the fix is another write
+        RetryPolicy().run(write_and_verify, describe=f"save({path})",
+                          retriable=lambda e: True)
 
 
 def load(path: str) -> Any:
@@ -489,11 +497,12 @@ def load(path: str) -> Any:
     mismatch, truncation, or an unreadable payload.  Files without the
     frame magic (pre-frame snapshots) load as plain pickles."""
     path = _strip_file_scheme(path)
-    fs = get_filesystem(path)
-    if hasattr(fs, "read_pickle") and not chaos.armed("ckpt.read"):
-        return fs.read_pickle(path)
-    data = chaos.transform("ckpt.read", fs.read_bytes(path))
-    return _loads_payload(unframe_bytes(data, path), path)
+    with telemetry.span("ckpt.read", cat="io", path=path):
+        fs = get_filesystem(path)
+        if hasattr(fs, "read_pickle") and not chaos.armed("ckpt.read"):
+            return fs.read_pickle(path)
+        data = chaos.transform("ckpt.read", fs.read_bytes(path))
+        return _loads_payload(unframe_bytes(data, path), path)
 
 
 def save_checkpoint(path: str, neval: int, model_blob: Any,
@@ -628,17 +637,18 @@ def prune_checkpoints(path: str, keep_last: int, keep=()) -> list:
     fs = get_filesystem(path)
     keep = set(keep)
     pruned = []
-    for i, (mp, op, n) in enumerate(checkpoint_lineage(path)):
-        if i < keep_last or n in keep:
-            continue
-        try:
-            fs.remove(mp)
-            fs.remove(op)
-            pruned.append(n)
-        except Exception as e:  # noqa: BLE001 — retention is best-effort:
-            # a failed delete must never take down training
-            logger.warning("retention: could not prune snapshot %d in %s: "
-                           "%s", n, path, e)
+    with telemetry.span("ckpt.retention", cat="io", keep_last=keep_last):
+        for i, (mp, op, n) in enumerate(checkpoint_lineage(path)):
+            if i < keep_last or n in keep:
+                continue
+            try:
+                fs.remove(mp)
+                fs.remove(op)
+                pruned.append(n)
+            except Exception as e:  # noqa: BLE001 — retention is
+                # best-effort: a failed delete must never take down training
+                logger.warning("retention: could not prune snapshot %d in "
+                               "%s: %s", n, path, e)
     if pruned:
         logger.info("retention: pruned snapshots %s from %s (keep_last=%d, "
                     "keepers=%s)", sorted(pruned), path, keep_last,

@@ -488,21 +488,40 @@ class Supervisor:
                             "age_seconds": round(now - st[1], 3),
                             "beats": st[3]}
                         for k, st in self._channels.items()}
-        return {"reason": reason or f"phase {phase!r} stalled",
-                "phase": phase,
-                "idle_seconds": round(idle, 3),
-                "deadline_seconds": deadline,
-                "time": self.wall_clock(),
-                "rank": self.rank, "world": self.world,
-                "timeline": timeline,
-                "channels": channels,
-                "threads": threads,
-                "chaos_counts": chaos.counts(),
-                "stale_peers": {str(r): round(a, 1)
-                                for r, a in (stale or {}).items()},
-                "platform": _platform_info()}
+        report = {"reason": reason or f"phase {phase!r} stalled",
+                  "phase": phase,
+                  "idle_seconds": round(idle, 3),
+                  "deadline_seconds": deadline,
+                  "time": self.wall_clock(),
+                  "rank": self.rank, "world": self.world,
+                  "timeline": timeline,
+                  "channels": channels,
+                  "threads": threads,
+                  "chaos_counts": chaos.counts(),
+                  "stale_peers": {str(r): round(a, 1)
+                                  for r, a in (stale or {}).items()},
+                  "platform": _platform_info()}
+        # run telemetry (utils/telemetry): the recent span/event tail shows
+        # what the run was DOING in the seconds before the hang — embedded
+        # here so the diagnosis survives even if the trace file is lost
+        from . import telemetry
+        tracer = telemetry.get_active()
+        if tracer is not None:
+            report["trace_tail"] = tracer.events_tail(64)
+        return report
 
     def _write_report(self, phase, idle, deadline, stale, msg):
+        # flush-on-crash: the trace file on storage must include the
+        # events leading into the stall, not just the last periodic flush
+        from . import telemetry
+        tracer = telemetry.get_active()
+        if tracer is not None:
+            try:
+                tracer.instant("stall", cat="supervisor", phase=phase,
+                               idle_seconds=round(idle, 1))
+                tracer.flush()
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
         report = self.crash_report(phase, idle, deadline, stale, msg)
         data = json.dumps(report, indent=2, default=str).encode()
         if not self.report_dir:

@@ -1,0 +1,519 @@
+"""Unified run telemetry: span tracer + Chrome-trace JSON + cross-host merge.
+
+Reference gap this closes: the reference's driver printed ``Metrics.summary``
+every iteration (DistriOptimizer.scala:298 — BigDL, arXiv:1804.05839 §3)
+because a synchronous Spark job made every phase visible in the driver log.
+Our compiled async pipeline hides everything between host dispatch and result
+fetch, and the MLPerf TPU-pod work (arXiv:1909.09756) shows input-pipeline
+and straggler diagnosis at scale needs a per-step, per-host timeline — not a
+scrolling log.
+
+Core pieces
+-----------
+- :class:`Tracer`: a process-wide tracer producing **nested spans**
+  ("X" complete events), **instant events** ("i" — chaos fault injections
+  land here) and **counter tracks** ("C" — data_wait / step seconds /
+  records/s / prefetch queue depth) in Chrome trace-event JSON, loadable
+  directly in Perfetto / ``chrome://tracing``.  Events live in a bounded
+  in-memory ring (oldest dropped, drop count recorded) and flush
+  periodically through ``file_io`` — local dirs, ``memory://`` and any
+  fsspec remote scheme all work — to ``trace.<rank>.json`` (one file per
+  process, ``pid`` = rank, so multi-host traces merge by concatenation).
+- Module-level ``span()/complete()/instant()/counter()/thread_name()``
+  helpers that no-op against a shared singleton when no tracer is active:
+  instrumented code pays one attribute load + ``is None`` check when
+  tracing is off — no events, no allocation, and the tracer has **no
+  thread at all** (flushing is inline, count-triggered).
+- Timestamps are wall-clock-anchored (epoch micros, advanced by the
+  monotonic clock) so traces from different hosts line up on one timeline
+  after :func:`merge_traces`; the clock pair is injectable for tests.
+- :func:`merge_traces` + :func:`phase_breakdown` + :func:`format_report`
+  are the analysis core behind ``tools/trace_report.py``: merge
+  ``trace.*.json`` of all ranks, compute per-phase p50/p95/max, the
+  ``data_wait_fraction`` (input-bound vs compute-bound diagnosis, same
+  definition as bench.py's e2e stage) and straggler ranks.
+
+Who emits what (all through the module-level helpers, so everything is
+inert until a tracer is active):
+
+- the Optimizer train loop: ``data``/``step``/``checkpoint``/
+  ``validation`` spans + a per-step counter track;
+- the prefetch worker (dataset/prefetch.py): its own named thread track
+  with per-item ``prefetch.item`` spans;
+- file_io: ``ckpt.write``/``ckpt.read`` spans (write+verify),
+  ``ckpt.retention`` spans, and an ``io.retry`` instant per remote-IO
+  retry attempt;
+- chaos (utils/chaos.py): one ``chaos:<point>`` instant per schedule hit,
+  so injected faults are visible on the same timeline as their fallout;
+- the supervisor (utils/supervisor.py): embeds the active tracer's
+  recent-event tail in stall crash reports and flushes the trace file
+  before writing the report (flush-on-crash).
+
+Knobs (utils/config tier):
+
+| env var | meaning | default |
+|---|---|---|
+| ``BIGDL_TPU_TRACE`` | trace output dir (any file_io scheme); empty = tracing off | off |
+| ``BIGDL_TPU_TRACE_RING`` | max buffered events (ring; oldest dropped) | 65536 |
+| ``BIGDL_TPU_TRACE_FLUSH_EVERY`` | events between automatic flushes | 4096 |
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import config
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["Tracer", "enabled", "trace_dir", "maybe_start", "set_active",
+           "get_active", "span", "complete", "instant", "counter",
+           "thread_name", "merge_traces", "phase_breakdown",
+           "format_report", "TRACE_FILE_RE"]
+
+#: the train loop's phase spans — the names phase_breakdown() ranks first
+PHASE_NAMES = ("data", "step", "checkpoint", "validation")
+
+TRACE_FILE_RE = r"trace\.(\d+)\.json"
+
+
+class _NullSpan:
+    """Shared no-op context manager: what ``span()`` hands out when no
+    tracer is active — one singleton, zero allocation per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "name", "cat", "args", "_t0")
+
+    def __init__(self, tr: "Tracer", name: str, cat: str, args):
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = self._tr._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        self._tr._emit_complete(self.name, self.cat, self._t0,
+                                self._tr._now_us() - self._t0, self.args)
+        return False
+
+
+class Tracer:
+    """Chrome-trace-event tracer with a bounded ring and file_io flush.
+
+    ``out_dir`` accepts any file_io scheme (local path, ``memory://``,
+    ``gs://``); each flush rewrites ``trace.<rank>.json`` with the current
+    ring contents, so the newest events are always on storage — a crashed
+    or stalled run's trace survives up to its last flush (the supervisor
+    forces one before writing a crash report).  No background thread:
+    flushing happens inline every ``flush_every`` appended events and on
+    ``flush()``/``close()``."""
+
+    def __init__(self, out_dir: str, rank: int = 0, *,
+                 ring: Optional[int] = None,
+                 flush_every: Optional[int] = None,
+                 clock=None, wall_clock=None):
+        self.out_dir = str(out_dir)
+        self.rank = int(rank)
+        self.ring = (config.get_int("TRACE_RING", 65536)
+                     if ring is None else int(ring))
+        self.flush_every = (config.get_int("TRACE_FLUSH_EVERY", 4096)
+                            if flush_every is None else int(flush_every))
+        self._clock = clock or time.perf_counter
+        wall = wall_clock or time.time
+        # wall-anchored monotonic micros: cross-host merge needs a shared
+        # timebase (epoch), in-process ordering needs monotonicity
+        self._base_us = wall() * 1e6
+        self._base_perf = self._clock()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._meta: List[dict] = []   # process/thread names: never evicted
+        self._tids: Dict[int, int] = {}
+        self.dropped = 0
+        self._since_flush = 0
+        self._closed = False
+        import socket
+        self._host = socket.gethostname()
+        self._meta.append({"ph": "M", "name": "process_name",
+                           "pid": self.rank, "tid": 0,
+                           "args": {"name": f"rank {self.rank} "
+                                            f"({self._host})"}})
+
+    # -- clocks / ids ---------------------------------------------------
+
+    def _now_us(self) -> float:
+        return self._base_us + (self._clock() - self._base_perf) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+            self._emit_meta("thread_name", tid,
+                            threading.current_thread().name)
+        return tid
+
+    def _emit_meta(self, kind: str, tid: int, name: str) -> None:
+        with self._lock:
+            self._meta.append({"ph": "M", "name": kind, "pid": self.rank,
+                               "tid": tid, "args": {"name": name}})
+
+    def thread_name(self, name: str) -> None:
+        """(Re)label the calling thread's track (the prefetch worker names
+        itself at startup)."""
+        self._emit_meta("thread_name", self._tid(), name)
+
+    # -- event emission -------------------------------------------------
+
+    def _append(self, ev: dict) -> None:
+        flush_now = False
+        with self._lock:
+            if self._closed:
+                return
+            self._events.append(ev)
+            if len(self._events) > self.ring:
+                del self._events[0]
+                self.dropped += 1
+            self._since_flush += 1
+            if self.flush_every > 0 and \
+                    self._since_flush >= self.flush_every:
+                self._since_flush = 0
+                flush_now = True
+        if flush_now:
+            self.flush()
+
+    def span(self, name: str, cat: str = "phase", **args) -> _Span:
+        """Context manager emitting one "X" complete event on exit; nested
+        ``with`` blocks nest in Perfetto by time containment."""
+        return _Span(self, name, cat, args or None)
+
+    def _emit_complete(self, name, cat, ts_us, dur_us, args) -> None:
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": round(ts_us, 1),
+              "dur": round(max(dur_us, 0.0), 1), "pid": self.rank,
+              "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def complete(self, name: str, dur_s: float, cat: str = "phase",
+                 **args) -> None:
+        """Record a span that just ENDED and lasted ``dur_s`` seconds —
+        for code that already measured a duration (the train loop's
+        data_wait) without restructuring it into a ``with`` block."""
+        now = self._now_us()
+        self._emit_complete(name, cat, now - dur_s * 1e6, dur_s * 1e6, args)
+
+    def instant(self, name: str, cat: str = "event", **args) -> None:
+        ev = {"name": name, "cat": cat, "ph": "i", "ts":
+              round(self._now_us(), 1), "s": "t", "pid": self.rank,
+              "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, track: str, **values) -> None:
+        """One sample on counter track ``track`` (Perfetto renders each
+        arg key as a series)."""
+        self._append({"name": track, "ph": "C",
+                      "ts": round(self._now_us(), 1), "pid": self.rank,
+                      "tid": 0, "args": {k: round(float(v), 6)
+                                         for k, v in values.items()}})
+
+    # -- inspection / persistence --------------------------------------
+
+    def events_tail(self, n: int = 64) -> List[dict]:
+        """The newest n events (the supervisor embeds this in stall crash
+        reports so the timeline leading into a hang is preserved even if
+        the trace file itself is lost)."""
+        with self._lock:
+            return [dict(e) for e in self._events[-n:]]
+
+    @property
+    def path(self) -> str:
+        from . import file_io
+        base = file_io._strip_file_scheme(self.out_dir)
+        return file_io._join(base, f"trace.{self.rank}.json")
+
+    def flush(self) -> str:
+        """Rewrite ``trace.<rank>.json`` with the current ring contents.
+        Returns the path; a broken trace store must never take down the
+        traced run (logged, not raised)."""
+        from . import file_io
+        with self._lock:
+            payload = {"traceEvents": self._meta + self._events,
+                       "displayTimeUnit": "ms",
+                       "otherData": {"rank": self.rank, "host": self._host,
+                                     "dropped_events": self.dropped}}
+            self._since_flush = 0
+        path = self.path
+        try:
+            base = file_io._strip_file_scheme(self.out_dir)
+            fs = file_io.get_filesystem(base)
+            fs.makedirs(base)
+            fs.write_bytes(path, json.dumps(payload).encode())
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+            logger.warning("telemetry: trace flush to %s failed: %s",
+                           path, e)
+        return path
+
+    def close(self) -> None:
+        """Final flush + detach (idempotent); clears the active slot if
+        this tracer holds it."""
+        if not self._closed:
+            self.flush()
+            self._closed = True
+        if get_active() is self:
+            set_active(None)
+
+
+# ---------------------------------------------------------------------------
+# process-wide active tracer + zero-overhead module helpers
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[Tracer] = None
+
+
+def set_active(tr: Optional[Tracer]) -> None:
+    global _ACTIVE
+    _ACTIVE = tr
+
+
+def get_active() -> Optional[Tracer]:
+    return _ACTIVE
+
+
+def trace_dir() -> str:
+    """The ``BIGDL_TPU_TRACE`` knob: the trace output dir ('' = off)."""
+    return config.get_str("TRACE", "").strip()
+
+
+def enabled() -> bool:
+    return bool(trace_dir())
+
+
+def maybe_start(rank: int = 0) -> Optional[Tracer]:
+    """Start (and make active) a Tracer per the env knobs.  Returns the
+    NEW tracer only when this call created one — None when tracing is off
+    or another tracer already owns the process slot — so the caller that
+    gets a handle back is the one that must ``close()`` it."""
+    if _ACTIVE is not None or not enabled():
+        return None
+    tr = Tracer(trace_dir(), rank=rank)
+    set_active(tr)
+    return tr
+
+
+def span(name: str, cat: str = "phase", **args):
+    """Module-level span against the active tracer; the shared no-op
+    singleton when tracing is off (no allocation, no event)."""
+    tr = _ACTIVE
+    if tr is None:
+        return _NULL_SPAN
+    return tr.span(name, cat, **args)
+
+
+def complete(name: str, dur_s: float, cat: str = "phase", **args) -> None:
+    tr = _ACTIVE
+    if tr is not None:
+        tr.complete(name, dur_s, cat, **args)
+
+
+def instant(name: str, cat: str = "event", **args) -> None:
+    tr = _ACTIVE
+    if tr is not None:
+        tr.instant(name, cat, **args)
+
+
+def counter(track: str, **values) -> None:
+    tr = _ACTIVE
+    if tr is not None:
+        tr.counter(track, **values)
+
+
+def thread_name(name: str) -> None:
+    tr = _ACTIVE
+    if tr is not None:
+        tr.thread_name(name)
+
+
+# ---------------------------------------------------------------------------
+# cross-host merge + phase breakdown (the trace_report core)
+# ---------------------------------------------------------------------------
+
+def merge_traces(trace_dir_: str) -> dict:
+    """Merge every ``trace.<rank>.json`` under ``trace_dir_`` (any file_io
+    scheme) into one Chrome-trace object on a shared timeline: events are
+    already wall-clock-anchored and pid-tagged by rank, so the merge is a
+    concatenation + time sort.  Raises FileNotFoundError when no trace
+    files exist."""
+    import re
+    from . import file_io
+    base = file_io._strip_file_scheme(str(trace_dir_))
+    fs = file_io.get_filesystem(base)
+    try:
+        names = fs.listdir(base)
+    except Exception as e:  # noqa: BLE001 — uniform error for a bad dir
+        raise FileNotFoundError(f"{trace_dir_}: cannot list trace dir "
+                                f"({type(e).__name__}: {e})") from e
+    ranks, events, other = [], [], {}
+    for name in sorted(names):
+        m = re.fullmatch(TRACE_FILE_RE, name)
+        if not m:
+            continue
+        blob = json.loads(fs.read_bytes(file_io._join(base, name)))
+        ranks.append(int(m.group(1)))
+        events.extend(blob.get("traceEvents", []))
+        other[m.group(1)] = blob.get("otherData", {})
+    if not ranks:
+        raise FileNotFoundError(
+            f"{trace_dir_}: no trace.<rank>.json files found")
+    # metadata events (ph=M) first, then time order — Perfetto wants names
+    # declared before use and meta events carry no ts
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"ranks": sorted(ranks), "per_rank": other}}
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q * (len(sorted_vals) - 1) + 0.5),
+                           len(sorted_vals) - 1)]
+
+
+def phase_breakdown(merged: dict) -> dict:
+    """Per-phase stats + the input-bound-vs-compute-bound diagnosis from a
+    merged trace.
+
+    - ``phases``: per span name — count, total seconds, p50/p95/max ms
+      (the optimizer's ``data``/``step``/``checkpoint``/``validation``
+      first, then every other span name seen);
+    - ``ranks``: per rank — wall seconds (first span start to last span
+      end), ``data_wait_fraction`` (sum of ``data`` span time / wall: the
+      same numerator/denominator bench.py's e2e stage reports), mean step
+      seconds;
+    - ``data_wait_fraction`` overall + ``diagnosis``;
+    - ``straggler_ranks``: ranks whose mean ``step`` span runs > 1.5x the
+      median rank's (the one-slow-host signal);
+    - ``instants``: count per instant-event name (chaos injections show up
+      here)."""
+    spans = [e for e in merged.get("traceEvents", [])
+             if e.get("ph") == "X" and "dur" in e]
+    by_name: Dict[str, List[float]] = {}
+    per_rank: Dict[int, dict] = {}
+    for e in spans:
+        dur_s = e["dur"] / 1e6
+        by_name.setdefault(e["name"], []).append(dur_s)
+        r = per_rank.setdefault(int(e.get("pid", 0)),
+                                {"start": e["ts"], "end": e["ts"] + e["dur"],
+                                 "data": 0.0, "step": [], "spans": 0})
+        r["start"] = min(r["start"], e["ts"])
+        r["end"] = max(r["end"], e["ts"] + e["dur"])
+        r["spans"] += 1
+        if e["name"] == "data":
+            r["data"] += dur_s
+        elif e["name"] == "step":
+            r["step"].append(dur_s)
+    phases = {}
+    order = [n for n in PHASE_NAMES if n in by_name] + \
+        sorted(n for n in by_name if n not in PHASE_NAMES)
+    for name in order:
+        vals = sorted(by_name[name])
+        phases[name] = {"count": len(vals),
+                        "total_s": round(sum(vals), 6),
+                        "p50_ms": round(_pct(vals, 0.50) * 1e3, 3),
+                        "p95_ms": round(_pct(vals, 0.95) * 1e3, 3),
+                        "max_ms": round(vals[-1] * 1e3, 3)}
+    ranks = {}
+    total_data = total_wall = 0.0
+    step_means = {}
+    for rank, r in sorted(per_rank.items()):
+        wall = max((r["end"] - r["start"]) / 1e6, 1e-9)
+        frac = min(r["data"] / wall, 1.0)
+        total_data += r["data"]
+        total_wall += wall
+        mean_step = (sum(r["step"]) / len(r["step"])) if r["step"] else None
+        if mean_step is not None:
+            step_means[rank] = mean_step
+        ranks[str(rank)] = {"wall_s": round(wall, 6),
+                            "spans": r["spans"],
+                            "data_wait_fraction": round(frac, 4),
+                            "step_mean_s": (round(mean_step, 6)
+                                            if mean_step is not None
+                                            else None)}
+    stragglers = []
+    if len(step_means) > 1:
+        means = sorted(step_means.values())
+        # lower median: with an even rank count the SLOWER of the middle
+        # pair must not become the yardstick (2 ranks would never flag)
+        median = means[(len(means) - 1) // 2]
+        stragglers = [{"rank": rk, "step_mean_s": round(v, 6),
+                       "x_median": round(v / max(median, 1e-12), 2)}
+                      for rk, v in sorted(step_means.items())
+                      if v > 1.5 * median]
+    frac = min(total_data / total_wall, 1.0) if total_wall > 0 else 0.0
+    instants: Dict[str, int] = {}
+    for e in merged.get("traceEvents", []):
+        if e.get("ph") == "i":
+            instants[e["name"]] = instants.get(e["name"], 0) + 1
+    return {"phases": phases, "ranks": ranks,
+            "data_wait_fraction": round(frac, 4),
+            "diagnosis": ("input-bound (data_wait_fraction "
+                          f"{frac:.2f} > 0.5: the host pipeline gates the "
+                          "chip)" if frac > 0.5 else
+                          f"compute-bound (data_wait_fraction {frac:.2f} "
+                          "<= 0.5: the device step sets the pace)"),
+            "straggler_ranks": stragglers,
+            "instants": instants}
+
+
+def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
+    """Human-readable phase breakdown (the trace_report CLI's output)."""
+    lines = []
+    if merged is not None:
+        meta = merged.get("otherData", {})
+        lines.append(f"ranks: {meta.get('ranks', '?')}  events: "
+                     f"{len(merged.get('traceEvents', []))}")
+    lines.append(f"{'phase':<16}{'count':>8}{'total_s':>12}{'p50_ms':>10}"
+                 f"{'p95_ms':>10}{'max_ms':>10}")
+    for name, st in breakdown["phases"].items():
+        lines.append(f"{name:<16}{st['count']:>8}{st['total_s']:>12.3f}"
+                     f"{st['p50_ms']:>10.2f}{st['p95_ms']:>10.2f}"
+                     f"{st['max_ms']:>10.2f}")
+    lines.append(f"data_wait_fraction: {breakdown['data_wait_fraction']} "
+                 f"— {breakdown['diagnosis']}")
+    for rank, st in breakdown["ranks"].items():
+        lines.append(f"  rank {rank}: wall {st['wall_s']:.3f}s, "
+                     f"data_wait_fraction {st['data_wait_fraction']}, "
+                     f"step mean "
+                     f"{st['step_mean_s'] if st['step_mean_s'] is not None else '-'}")
+    if breakdown["straggler_ranks"]:
+        for s in breakdown["straggler_ranks"]:
+            lines.append(f"STRAGGLER rank {s['rank']}: step mean "
+                         f"{s['step_mean_s']}s = {s['x_median']}x the "
+                         "median rank")
+    else:
+        lines.append("stragglers: none")
+    if breakdown["instants"]:
+        lines.append("instant events: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))
+    return "\n".join(lines)

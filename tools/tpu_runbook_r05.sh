@@ -98,6 +98,25 @@ if [ "$SMOKE" = 1 ]; then
   timeout 300 python bench.py --data \
     > /tmp/bench_data_micro.json 2>/tmp/bench_data_micro.log
   echo "[runbook] bench --data rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+
+  # telemetry smoke (cpu only): a traced training run (supervise_smoke
+  # under BIGDL_TPU_TRACE — its stall + recovery also proves the crash
+  # report embeds the trace tail) must yield a Perfetto-loadable
+  # trace.<rank>.json whose trace_report phase breakdown is NON-EMPTY
+  # (data/step/checkpoint spans + a data_wait_fraction line)
+  echo "[runbook] 2e/4 run-telemetry smoke (trace + trace_report)" >> "$LOG"
+  rm -rf /tmp/r05_trace
+  BIGDL_TPU_TRACE=/tmp/r05_trace timeout 300 python tools/supervise_smoke.py \
+    --platform cpu > /tmp/trace_smoke.json 2>/tmp/trace_smoke.log
+  echo "[runbook] trace smoke rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+  timeout 60 python tools/trace_report.py /tmp/r05_trace \
+    > /tmp/trace_report.txt 2>&1
+  TR_RC=$?
+  if [ "$TR_RC" = 0 ] && grep -q "data_wait_fraction" /tmp/trace_report.txt; then
+    echo "[runbook] trace_report OK (non-empty phase breakdown) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] trace_report FAILED rc=$TR_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -125,7 +144,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi

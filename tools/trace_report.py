@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Merge multi-rank run traces and print the phase breakdown.
+
+Every traced process (``BIGDL_TPU_TRACE=<dir>`` or ``bench.py --trace``)
+writes ``trace.<rank>.json`` (Chrome trace-event JSON,
+``bigdl_tpu.utils.telemetry``).  This tool merges all ranks onto one
+wall-clock timeline and prints the diagnosis a TensorBoard-less operator
+needs: per-phase p50/p95/max, the ``data_wait_fraction`` (input-bound vs
+compute-bound — same definition as bench.py's e2e stage), and straggler
+ranks (one slow host's ``step`` spans stand out against the median).
+
+Usage::
+
+    python tools/trace_report.py <trace-dir> [--out merged.json] [--json]
+
+``--out`` writes the merged timeline (loadable in Perfetto as one file);
+``--json`` prints the breakdown as machine-readable JSON instead of the
+table.  Exit status is non-zero when the dir holds no trace files or the
+breakdown is empty (no spans) — the runbook's smoke stage asserts on it.
+
+The heavy lifting (merge + breakdown + formatting) lives in
+``bigdl_tpu.utils.telemetry`` so tests exercise it directly; this file is
+the CLI shell, like tools/supervise_smoke.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python tools/trace_report.py` from the repo root: sys.path[0]
+# is tools/, so add the repo root (same dance as supervise_smoke.py)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir",
+                    help="dir holding trace.<rank>.json files (any file_io "
+                         "scheme: local, memory://, gs://, ...)")
+    ap.add_argument("--out", default=None, metavar="MERGED_JSON",
+                    help="also write the merged single-timeline trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the breakdown as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.utils import telemetry
+
+    try:
+        merged = telemetry.merge_traces(args.trace_dir)
+    except FileNotFoundError as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"merged trace -> {args.out}", file=sys.stderr)
+    breakdown = telemetry.phase_breakdown(merged)
+    if args.json:
+        print(json.dumps(breakdown))
+    else:
+        print(telemetry.format_report(breakdown, merged))
+    if not breakdown["phases"]:
+        print("trace_report: trace holds no spans (empty breakdown)",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
