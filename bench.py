@@ -761,6 +761,16 @@ def main(argv=None):
                          "prefetch vs MT batcher) and exit — touches no "
                          "jax backend, so it is immune to the "
                          "jax.devices() tunnel hang (BENCH_r05.json)")
+    ap.add_argument("--serve", action="store_true",
+                    help="online-serving mode: closed- and open-loop load "
+                         "against the serve/ subsystem (dynamic batcher + "
+                         "replica pool) on the LeNet forward — reports "
+                         "requests/s, latency p50/p95/p99, batch fill and "
+                         "shed rate as ONE JSON line")
+    ap.add_argument("--serve-clients", type=int, default=8,
+                    help="--serve closed-loop concurrent clients")
+    ap.add_argument("--serve-requests", type=int, default=200,
+                    help="--serve total closed-loop requests")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="emit a run trace (Chrome trace-event JSON, "
                          "bigdl_tpu.utils.telemetry) into DIR for ANY "
@@ -800,6 +810,10 @@ def main(argv=None):
         telemetry.maybe_start()
     if args.data:
         return _data_micro_bench()
+    if args.serve:
+        return _serve_bench(platform=args.platform,
+                            clients=args.serve_clients,
+                            requests=args.serve_requests)
     t_start = time.perf_counter()
     _beat("init")
     _start_watchdog(args.stall_seconds, args.compile_stall_seconds)
@@ -1010,6 +1024,150 @@ def _data_micro_bench(n_images=512, batch=64, hw=48):
     sys.stdout.flush()
     _flush_trace()
     _EMIT_DONE.set()
+
+
+def _percentiles(latencies):
+    """p50/p95/p99 (ms) from a list of per-request latency seconds."""
+    if not latencies:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    xs = sorted(latencies)
+    pick = lambda q: xs[min(int(q * (len(xs) - 1) + 0.5), len(xs) - 1)]
+    return {"p50_ms": round(pick(0.50) * 1e3, 2),
+            "p95_ms": round(pick(0.95) * 1e3, 2),
+            "p99_ms": round(pick(0.99) * 1e3, 2)}
+
+
+def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
+    """`--serve`: online-serving load bench (bigdl_tpu.serve).
+
+    Two load shapes against the LeNet forward, ONE JSON line:
+      closed loop — `clients` threads issue back-to-back requests (the
+        batcher's coalescing sets throughput; nothing is shed), reporting
+        requests/s + latency p50/p95/p99 + realized batch fill;
+      open loop — requests arrive at a fixed rate ~2x the closed-loop
+        throughput against a deliberately small queue + tight deadline,
+        so admission (ServerOverloaded) and deadline (RequestTimeout)
+        shedding actually engage — the shed rate and served-tail latency
+        are the report.  The record lands alongside the e2e training
+        records in the bench JSON family (runbook stage 2f)."""
+    import numpy as np
+
+    if platform:
+        import jax as _jax
+        try:
+            _jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            pass
+    import jax
+
+    from bigdl_tpu.serve import (InferenceServer, RequestTimeout,
+                                 ServerOverloaded)
+    from bigdl_tpu.utils.engine import Engine
+
+    _beat("init")
+    Engine.reset()
+    Engine.init()
+    if model_builder is None:
+        from bigdl_tpu.models.lenet import LeNet5
+        model = LeNet5(10).build(jax.random.key(0))
+        sample = np.zeros((28, 28, 1), np.float32)
+    else:
+        model, sample = model_builder()
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=sample.shape).astype(np.float32)
+          for _ in range(16)]
+
+    # -- closed loop ----------------------------------------------------
+    latencies, errors = [], []
+    lock = threading.Lock()
+    per_client = max(requests // max(clients, 1), 1)
+    with InferenceServer(model, example=sample) as server:
+        _beat("serve:closed")
+
+        def client(cid):
+            for i in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    server.predict(xs[(cid + i) % len(xs)], timeout=120)
+                    with lock:
+                        latencies.append(time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001 — recorded
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        closed_wall = time.perf_counter() - t0
+        closed_stats = server.stats()
+    served = len(latencies)
+    closed_rps = round(served / closed_wall, 1) if closed_wall > 0 else 0.0
+    closed = {"clients": clients, "requests": served,
+              "requests_per_sec": closed_rps,
+              **_percentiles(latencies),
+              "batches": closed_stats["batches"],
+              "batch_fill": closed_stats["batch_fill"],
+              "errors": errors[:5]}
+
+    # -- open loop ------------------------------------------------------
+    _beat("serve:open")
+    target_rps = max(closed_rps * 2.0, 10.0)
+    interval = 1.0 / target_rps
+    n_open = min(max(served, 20), int(target_rps * 2) or 20)
+    open_lat, handles = [], []
+    shed_overload = 0
+    deadline_ms = max(_percentiles(latencies)["p95_ms"] or 50.0, 5.0)
+    with InferenceServer(model, queue_limit=16,
+                         deadline_ms=deadline_ms,
+                         example=sample) as server:
+        next_t = time.perf_counter()
+        for i in range(n_open):
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += interval
+            try:
+                handles.append((time.perf_counter(),
+                                server.submit(xs[i % len(xs)])))
+            except ServerOverloaded:
+                shed_overload += 1
+        shed_timeout = 0
+        for t0, h in handles:
+            try:
+                h.result(120)
+                open_lat.append(time.perf_counter() - t0)
+            except RequestTimeout:
+                shed_timeout += 1
+            except Exception:  # noqa: BLE001 — counted as shed
+                shed_timeout += 1
+        open_stats = server.stats()
+    shed = shed_overload + shed_timeout
+    open_loop = {"offered_rps": round(target_rps, 1),
+                 "offered": n_open, "served": len(open_lat),
+                 "deadline_ms": round(deadline_ms, 1),
+                 "shed_overload": shed_overload,
+                 "shed_timeout": shed_timeout,
+                 "shed_rate": round(shed / n_open, 4) if n_open else 0.0,
+                 **_percentiles(open_lat),
+                 "batch_fill": open_stats["batch_fill"]}
+
+    out = {"metric": "serve_requests_per_sec", "value": closed_rps,
+           "unit": "req/s", "vs_baseline": None, "mode": "serve",
+           "model": type(model).__name__,
+           "max_batch": server.max_batch,
+           "buckets": list(server.batcher.buckets),
+           "replicas": server.replicas,
+           "closed_loop": closed, "open_loop": open_loop,
+           "device": str(jax.devices()[0])}
+    _flush_trace()
+    print(json.dumps(out))
+    sys.stdout.flush()
+    _EMIT_DONE.set()
+    return out
 
 
 def _start_watchdog(stall_seconds, compile_stall_seconds):

@@ -26,3 +26,5 @@ from . import models
 from . import parallel
 from . import quantize as quantization
 from .quantize import quantize
+from . import serve
+from .serve import InferenceServer

@@ -1,0 +1,22 @@
+"""Online inference serving: dynamic batching, replica pool, hot swap.
+
+The training stack's online counterpart (ROADMAP north star: "serves
+heavy traffic"): concurrent single requests are coalesced into padded
+fixed-shape batches (serve/batcher.py) and drained by a pool of replica
+worker threads running the same mesh-sharded forward as bulk
+`Predictor.predict` (serve/server.py).  Bounded queue + per-request
+deadlines give typed load shedding (`ServerOverloaded`,
+`RequestTimeout`) instead of latency collapse; `swap()` hot-loads a new
+checkpoint version (optionally int8-quantized) with zero dropped
+requests.  See docs/serving.md.
+"""
+
+from .batcher import (DynamicBatcher, PendingRequest, RequestTimeout,
+                      ServeError, ServerClosed, ServerOverloaded,
+                      default_buckets, pad_rows, predict_in_fixed_batches)
+from .server import InferenceServer, ModelVersion
+
+__all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
+           "PendingRequest", "ServeError", "ServerOverloaded",
+           "ServerClosed", "RequestTimeout", "default_buckets",
+           "pad_rows", "predict_in_fixed_batches"]

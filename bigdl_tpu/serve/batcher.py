@@ -1,0 +1,304 @@
+"""Dynamic request batching: coalesce single requests into fixed shapes.
+
+Reference gap this closes: the reference serves models either as bulk
+Spark jobs (optim/Predictor.scala — whole-RDD inference) or as one
+synchronous UDF call per query (example/udfpredictor/); neither shape
+survives online traffic on an XLA backend, where every distinct batch
+shape is a fresh compile and every single-row forward wastes the MXU.
+The MLPerf TPU-pod work (arXiv:1909.09756) shows the discipline that
+keeps compiled accelerators saturated: a small, fixed set of padded
+batch shapes, filled as full as latency allows.
+
+This module is the host-side half of the serving subsystem
+(bigdl_tpu/serve): a bounded request queue plus the coalescing policy.
+
+- :class:`DynamicBatcher` — concurrent producers ``submit()`` single
+  samples; replica workers ``collect()`` batches.  A batch flushes when
+  ``max_batch`` requests are waiting OR the oldest request has waited
+  ``max_wait_s`` (the latency-vs-fill knob).  Batch sizes are drawn from
+  a fixed ``buckets`` ladder (default: powers of two up to ``max_batch``)
+  and padded up to the bucket, so the device only ever sees shapes it
+  has already compiled (warmed up at server start).
+- **Backpressure**: the queue is bounded (``queue_limit``); admission
+  past the bound raises :class:`ServerOverloaded` immediately — typed
+  rejection instead of unbounded latency collapse.
+- **Deadlines**: a request carries an optional absolute deadline; one
+  dequeued past it is shed with :class:`RequestTimeout` and never
+  reaches the device (a request already executing completes normally).
+- The trailing-chunk padding trick UDFPredictor (serving.py) uses for
+  bulk DataFrame calls lives here too (:func:`pad_rows`,
+  :func:`predict_in_fixed_batches`) — one padding implementation for
+  offline UDFs and online requests.
+
+Everything is clock-injectable and wall-clock-free under test.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import chaos, telemetry
+
+__all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
+           "RequestTimeout", "PendingRequest", "DynamicBatcher",
+           "default_buckets", "pad_rows", "predict_in_fixed_batches"]
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving rejections."""
+
+
+class ServerOverloaded(ServeError):
+    """Admission rejected: the bounded request queue is full.  The caller
+    should back off / retry against another replica pool — queueing more
+    would only grow everyone's latency (docs/serving.md decision tree)."""
+
+
+class RequestTimeout(ServeError, TimeoutError):
+    """The request's deadline passed while it was still queued; it was
+    shed before reaching the device.  Distinct from ServerOverloaded:
+    admission succeeded but service was too slow — raise the deadline or
+    add replicas, not queue depth."""
+
+
+class ServerClosed(ServeError):
+    """submit() after shutdown began (stop() was called)."""
+
+
+class PendingRequest:
+    """Future-like handle for one submitted sample.
+
+    ``result(timeout)`` blocks until a replica resolves the request and
+    returns the per-sample output row, or raises the typed error the
+    server recorded (RequestTimeout / ServerOverloaded at dequeue /
+    ChaosFault / StallError...)."""
+
+    __slots__ = ("payload", "enqueued", "deadline", "version", "latency_s",
+                 "_event", "_result", "_error")
+
+    def __init__(self, payload, enqueued: float,
+                 deadline: Optional[float] = None):
+        self.payload = payload
+        self.enqueued = enqueued
+        self.deadline = deadline
+        self.version = None      # model version id that answered
+        self.latency_s = None    # enqueue -> resolve
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _resolve(self, result=None, error=None, version=None,
+                 now: Optional[float] = None) -> None:
+        if self._event.is_set():  # first resolution wins (idempotent)
+            return
+        self._result = result
+        self._error = error
+        self.version = version
+        if now is not None:
+            self.latency_s = max(now - self.enqueued, 0.0)
+            telemetry.complete(
+                "serve.request", self.latency_s, cat="serve",
+                status=type(error).__name__ if error is not None else "ok")
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serve: no response within {timeout}s (request still "
+                "queued or executing — not shed)")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+def default_buckets(max_batch: int) -> tuple:
+    """The fixed batch-shape ladder: powers of two up to ``max_batch``
+    (``max_batch`` itself always included).  Small enough to warm every
+    shape at startup, dense enough that a half-full flush wastes at most
+    half the pad rows."""
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+def pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad the batch dim up to ``n`` rows by repeating the last row — the
+    fixed-shape trick that keeps jit from ever seeing a new shape (no
+    per-remainder recompiles).  Shared by the online batcher and the
+    offline UDF chunker."""
+    short = n - len(arr)
+    if short <= 0:
+        return arr
+    return np.concatenate([arr, np.repeat(arr[-1:], short, axis=0)])
+
+
+def predict_in_fixed_batches(forward: Callable, feats: np.ndarray,
+                             batch_size: int) -> np.ndarray:
+    """Chunk ``feats`` host-side into full ``batch_size`` batches (one XLA
+    call per batch, never one giant buffer), padding the trailing chunk
+    with :func:`pad_rows`, and concatenate the trimmed outputs.  The bulk
+    (UDFPredictor) counterpart of the online batcher's bucket padding."""
+    outs = []
+    for i in range(0, len(feats), batch_size):
+        chunk = feats[i:i + batch_size]
+        outs.append(np.asarray(forward(pad_rows(chunk, batch_size)))
+                    [:len(chunk)])
+    return np.concatenate(outs, axis=0)
+
+
+class DynamicBatcher:
+    """Bounded request queue + coalescing policy (see module docstring).
+
+    Thread contract: any number of producer threads call :meth:`submit`;
+    any number of replica workers call :meth:`collect`.  ``close(drain=
+    True)`` lets workers finish the queue before :meth:`collect` returns
+    None; ``drain=False`` fails everything still queued with
+    :class:`ServerClosed`."""
+
+    #: wait-slice so idle workers keep heartbeating their supervisor
+    #: channel (a parked worker must never read as a stalled one)
+    _SLICE = 0.05
+
+    def __init__(self, max_batch: int, max_wait_s: float,
+                 queue_limit: int, buckets: Optional[Sequence[int]] = None,
+                 clock=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.queue_limit = int(queue_limit)
+        self.buckets = tuple(sorted(buckets)) if buckets else \
+            default_buckets(self.max_batch)
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(f"largest bucket {self.buckets[-1]} < "
+                             f"max_batch {self.max_batch}")
+        self.clock = clock or time.monotonic
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+        # shed counters (read under the cond lock via stats())
+        self.submitted = 0
+        self.shed_overload = 0
+        self.shed_timeout = 0
+
+    # -- producers ------------------------------------------------------
+
+    def submit(self, payload, deadline: Optional[float] = None
+               ) -> PendingRequest:
+        """Enqueue one sample; raises :class:`ServerOverloaded` when the
+        bounded queue is full, :class:`ServerClosed` after shutdown.
+        ``deadline`` is absolute (this batcher's clock)."""
+        chaos.fire("serve.request")  # admission-path fault point
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("serve: server is shutting down")
+            if len(self._q) >= self.queue_limit:
+                self.shed_overload += 1
+                raise ServerOverloaded(
+                    f"serve: request queue full ({self.queue_limit} "
+                    "waiting) — shedding at admission")
+            req = PendingRequest(payload, self.clock(), deadline)
+            self._q.append(req)
+            self.submitted += 1
+            depth = len(self._q)
+            self._cond.notify_all()
+        telemetry.counter("serve", queue_depth=depth)
+        return req
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # -- workers --------------------------------------------------------
+
+    def collect(self, heartbeat: Optional[Callable] = None
+                ) -> Optional[List[PendingRequest]]:
+        """Block until a batch is ready, the coalesce window expires, or
+        shutdown.  Returns up to ``max_batch`` live requests (may be []
+        when every dequeued request had expired — the caller just loops),
+        or None when the batcher is closed and (if draining) empty.
+        ``heartbeat`` is called on every wait slice so the worker's
+        supervisor channel stays live while parked."""
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    return None
+                self._cond.wait(self._SLICE)
+                if heartbeat is not None:
+                    heartbeat()
+            # coalesce: from the OLDEST waiting request's enqueue time,
+            # hold the flush up to max_wait_s hoping to fill the batch —
+            # the configurable latency-for-fill trade
+            flush_at = self._q[0].enqueued + self.max_wait_s
+            while len(self._q) < self.max_batch and not self._closed:
+                remaining = flush_at - self.clock()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, self._SLICE))
+                if heartbeat is not None:
+                    heartbeat()
+            reqs = [self._q.popleft()
+                    for _ in range(min(len(self._q), self.max_batch))]
+        # deadline shedding happens at dequeue, outside the lock: an
+        # expired request never reaches the device
+        now = self.clock()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                with self._cond:
+                    self.shed_timeout += 1
+                r._resolve(error=RequestTimeout(
+                    f"serve: deadline exceeded after "
+                    f"{now - r.enqueued:.3f}s in queue"), now=now)
+            else:
+                live.append(r)
+        return live
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n is capped at max_batch by collect)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    # -- shutdown -------------------------------------------------------
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions.  drain=True lets workers finish the queue;
+        drain=False fails everything still queued with ServerClosed."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            pending = []
+            if not drain:
+                while self._q:
+                    pending.append(self._q.popleft())
+            self._cond.notify_all()
+        now = self.clock()
+        for r in pending:
+            r._resolve(error=ServerClosed(
+                "serve: server stopped before this request ran"), now=now)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"queue_depth": len(self._q),
+                    "submitted": self.submitted,
+                    "shed_overload": self.shed_overload,
+                    "shed_timeout": self.shed_timeout}

@@ -1,0 +1,382 @@
+"""Online inference server: replica pool, deadline shedding, hot model swap.
+
+The device-side half of the serving subsystem (see serve/batcher.py for
+the host-side queue/coalescing).  Composes pieces the training stack
+already has into an online server:
+
+- each **replica** is a worker thread draining the shared
+  :class:`~bigdl_tpu.serve.batcher.DynamicBatcher` and running padded
+  fixed-shape batches through the same mesh-sharded forward engine
+  Predictor/Evaluator use (`optim.optimizer._ShardedForward`) — online
+  answers are the SAME arithmetic as bulk `Predictor.predict`;
+- replicas heartbeat their own supervisor **channel**
+  (`utils.supervisor.Supervisor.channel`, phase ``serve``), so a wedged
+  replica trips a stall with a crash report instead of hanging its
+  callers silently;
+- a **model version** bundles (module, params, engine); ``swap()`` loads
+  a new version through the existing checkpoint-lineage/`file_io` path
+  (CRC-verified, retried remote IO), optionally int8-quantizes it
+  (`bigdl_tpu.quantize`), warms its batch shapes, then flips one
+  reference — in-flight batches finish on the old version, queued
+  requests run on the new one, zero requests dropped;
+- everything is instrumented: per-batch ``serve.batch`` spans, a
+  ``serve`` counter track (queue depth / batch fill), ``serve.swap``
+  instants, and the ``serve.request``/``serve.batch`` chaos points for
+  fault drills (a ChaosFault in a batch surfaces as a typed per-request
+  error; the server keeps serving).
+
+Knobs (utils/config tier; constructor args override):
+
+| env var | meaning | default |
+|---|---|---|
+| ``BIGDL_TPU_SERVE_MAX_BATCH`` | max requests coalesced per device batch | 8 |
+| ``BIGDL_TPU_SERVE_MAX_WAIT_MS`` | flush deadline: max ms the oldest request waits for fill | 5 |
+| ``BIGDL_TPU_SERVE_QUEUE_LIMIT`` | bounded queue; admission past it -> ServerOverloaded | 64 |
+| ``BIGDL_TPU_SERVE_REPLICAS`` | worker threads draining the shared queue | 1 |
+| ``BIGDL_TPU_SERVE_DEADLINE_MS`` | default per-request deadline (0 = none) | 0 |
+| ``BIGDL_TPU_SERVE_STALL_SECONDS`` | per-replica supervision deadline (0 = unwatched) | 0 |
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..nn.module import Module
+from ..utils import chaos, config, telemetry
+from ..utils.supervisor import StallError, Supervisor
+from .batcher import (DynamicBatcher, PendingRequest, ServeError,
+                      default_buckets, pad_rows)
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["ModelVersion", "InferenceServer"]
+
+
+class ModelVersion:
+    """One servable (module, params, engine) bundle.  Immutable once
+    built; the server flips between versions by replacing one reference."""
+
+    def __init__(self, vid: int, module: Module, label: str,
+                 strategy=None):
+        from ..optim.optimizer import _ShardedForward
+        if module.params is None:
+            module.build()
+        self.id = int(vid)
+        self.label = label
+        self.module = module
+        self._engine = _ShardedForward(module, strategy)
+
+    def predict(self, batch: np.ndarray) -> np.ndarray:
+        """Forward one padded fixed-shape batch; returns host rows (the
+        engine pads to the mesh's data-axis multiple internally — the
+        same program bulk Predictor.predict runs)."""
+        out, n = self._engine(batch)
+        return np.asarray(out)[:len(batch)]
+
+
+def _clone_with(module: Module, params, state) -> Module:
+    """A structural clone of `module` serving different weights: modules
+    carry no authoritative pytrees below the top (nn/module.py Container
+    note), so a shallow copy + attach is a full new version while the
+    original keeps serving its own params untouched."""
+    import copy
+    clone = copy.copy(module)
+    clone.attach(params, state)
+    return clone
+
+
+class InferenceServer:
+    """Online serving facade over a trained Module (see module docstring).
+
+    Usage::
+
+        server = InferenceServer(model, example=x0).start()
+        y = server.predict(x)                  # blocking convenience
+        h = server.submit(x, deadline_ms=50)   # async handle
+        ...
+        server.swap("/ckpts/run1")             # newest lineage snapshot
+        server.stop()                          # graceful drain
+
+    Also a context manager (``with InferenceServer(...) as s:``)."""
+
+    def __init__(self, model: Module, *,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_limit: Optional[int] = None,
+                 replicas: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 example: Optional[np.ndarray] = None,
+                 strategy=None,
+                 supervisor: Optional[Supervisor] = None,
+                 stall_seconds: Optional[float] = None,
+                 report_dir: Optional[str] = None,
+                 clock=None):
+        self.max_batch = int(max_batch if max_batch is not None
+                             else config.get_int("SERVE_MAX_BATCH", 8))
+        wait_ms = (max_wait_ms if max_wait_ms is not None
+                   else config.get_float("SERVE_MAX_WAIT_MS", 5.0))
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else config.get_int("SERVE_QUEUE_LIMIT", 64))
+        self.replicas = int(replicas if replicas is not None
+                            else config.get_int("SERVE_REPLICAS", 1))
+        self.default_deadline_ms = (
+            deadline_ms if deadline_ms is not None
+            else config.get_float("SERVE_DEADLINE_MS", 0.0))
+        self._strategy = strategy
+        self.batcher = DynamicBatcher(self.max_batch, wait_ms / 1000.0,
+                                      self.queue_limit, buckets=buckets,
+                                      clock=clock)
+        self._example = None if example is None else np.asarray(example)
+        self._version = ModelVersion(1, model, "initial", strategy)
+        self._lock = threading.Lock()      # stats + swap serialization
+        self._threads: list = []
+        self._stats = {"batches": 0, "batch_rows": 0, "batch_errors": 0,
+                       "bucket_rows": 0, "swaps": 0}
+        # supervision: an embedder-owned Supervisor, or our own from the
+        # SERVE_STALL_SECONDS knob — each replica heartbeats a channel
+        # under phase 'serve' so a wedged one trips a stall+crash report
+        self._sup = supervisor
+        self._own_sup = False
+        if self._sup is None:
+            d = (stall_seconds if stall_seconds is not None
+                 else config.get_float("SERVE_STALL_SECONDS", 0.0))
+            if d > 0:
+                self._sup = Supervisor({"serve": d}, report_dir=report_dir,
+                                       name="bigdl-serve-supervisor")
+                self._own_sup = True
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._threads:
+            return self
+        if self.batcher.closed:
+            raise ServeError("serve: cannot restart a stopped server")
+        if self._own_sup:
+            self._sup.start()
+        if self._example is not None:
+            self.warmup()
+        for i in range(self.replicas):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True,
+                                 name=f"bigdl-serve-replica-{i}")
+            t.start()
+            self._threads.append(t)
+        logger.info("serve: started %d replica(s), max_batch=%d, "
+                    "buckets=%s, queue_limit=%d", self.replicas,
+                    self.max_batch, self.batcher.buckets, self.queue_limit)
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Shut down.  drain=True (graceful) answers everything already
+        queued before workers exit; drain=False fails queued requests
+        with ServerClosed.  Idempotent; joins every replica thread."""
+        # with no workers running there is nobody to drain the queue —
+        # draining would strand queued requests' result() forever
+        self.batcher.close(drain=drain and bool(self._threads))
+        for t in self._threads:
+            t.join(timeout=timeout)
+        leaked = [t.name for t in self._threads if t.is_alive()]
+        self._threads = []
+        if self._own_sup:
+            self._sup.stop()
+        if leaked:
+            raise ServeError(f"serve: replica thread(s) did not exit "
+                             f"within {timeout}s: {leaked}")
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- request path ---------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None
+               ) -> PendingRequest:
+        """Enqueue one sample (NOT a batch — the batcher owns batching);
+        returns a handle whose ``result()`` is the per-sample output row.
+        Raises ServerOverloaded / ServerClosed at admission."""
+        x = np.asarray(x)
+        if self._example is None:
+            # remember the sample shape so later swaps can warm up the
+            # new version's batch shapes before taking traffic
+            self._example = np.zeros_like(x)
+        ms = (deadline_ms if deadline_ms is not None
+              else self.default_deadline_ms)
+        deadline = (self.batcher.clock() + ms / 1000.0) if ms and ms > 0 \
+            else None
+        return self.batcher.submit(x, deadline)
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: submit + wait."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    # -- replica workers ------------------------------------------------
+
+    def _worker(self, idx: int) -> None:
+        telemetry.thread_name(f"serve replica {idx}")
+        chan = (self._sup.channel(f"serve-replica-{idx}", phase="serve")
+                if self._sup is not None else None)
+        beat = chan.beat if chan is not None else None
+        try:
+            while True:
+                try:
+                    if beat is not None:
+                        beat()
+                    reqs = self.batcher.collect(heartbeat=beat)
+                    if reqs is None:
+                        return
+                    if reqs:
+                        self._execute(reqs, beat)
+                except StallError:
+                    # the supervisor async-raised into this replica while
+                    # it was between batches (a stall DURING a batch is
+                    # caught by _execute and fails that batch typed);
+                    # the crash report is already written — keep serving
+                    logger.warning("serve: replica %d received a stall "
+                                   "notice between batches; continuing",
+                                   idx)
+        finally:
+            if chan is not None:
+                chan.close()
+
+    def _execute(self, reqs, beat) -> None:
+        version = self._version  # one snapshot: a swap mid-batch cannot
+        # split the batch across versions (no misrouted requests)
+        n = len(reqs)
+        bucket = self.batcher.bucket_for(n)
+        batch = pad_rows(np.stack([r.payload for r in reqs]), bucket)
+        try:
+            with telemetry.span("serve.batch", cat="serve", size=n,
+                                bucket=bucket, version=version.id):
+                chaos.fire("serve.batch")
+                out = version.predict(batch)
+        except Exception as e:  # noqa: BLE001 — typed per-request error
+            # (ChaosFault, StallError, backend error...): the batch fails
+            # loudly to its callers, the replica and queue survive
+            now = self.batcher.clock()
+            for r in reqs:
+                r._resolve(error=e, now=now)
+            with self._lock:
+                self._stats["batch_errors"] += 1
+            logger.warning("serve: batch of %d failed: %s: %s", n,
+                           type(e).__name__, e)
+            return
+        now = self.batcher.clock()
+        for i, r in enumerate(reqs):
+            r._resolve(result=out[i], version=version.id, now=now)
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["batch_rows"] += n
+            self._stats["bucket_rows"] += bucket
+        telemetry.counter("serve", queue_depth=self.batcher.depth(),
+                          batch_fill=n / bucket)
+        if beat is not None:
+            beat()
+
+    # -- warmup ---------------------------------------------------------
+
+    def warmup(self, example: Optional[np.ndarray] = None) -> None:
+        """Compile every bucket shape on the CURRENT version before (or
+        between) traffic, so steady state never recompiles."""
+        ex = np.asarray(example) if example is not None else self._example
+        if ex is None:
+            raise ValueError("serve: warmup needs an example sample "
+                             "(pass example= here or at construction)")
+        self._example = ex
+        self._warm_version(self._version, ex)
+
+    def _warm_version(self, version: ModelVersion, ex: np.ndarray) -> None:
+        with telemetry.span("serve.warmup", cat="serve",
+                            version=version.id):
+            for b in self.batcher.buckets:
+                version.predict(np.stack([ex] * b))
+
+    # -- hot swap -------------------------------------------------------
+
+    def swap(self, source, *, quantized: bool = False,
+             state=None) -> int:
+        """Install a new model version with ZERO dropped requests.
+
+        source: a checkpoint DIRECTORY (newest lineage snapshot via
+        file_io.latest_checkpoint — CRC-verified, quarantine-aware), a
+        snapshot/module FILE path, a params pytree, or a built Module.
+        quantized=True additionally int8-quantizes the loaded weights
+        (bigdl_tpu.quantize) before serving them.
+
+        The new version is fully built — loaded, (optionally) quantized,
+        engine constructed, batch shapes warmed — BEFORE one reference
+        flip makes it live: in-flight batches finish on the old version,
+        every queued/new request runs on the new one."""
+        with self._lock:  # serialize concurrent swaps, not the data path
+            vid = self._version.id + 1
+            module, label = self._load_module(source, state)
+            if quantized:
+                from ..quantize import quantize
+                module = quantize(module)
+                label += "+int8"
+            version = ModelVersion(vid, module, label, self._strategy)
+            if self._example is not None:
+                self._warm_version(version, self._example)
+            self._version = version  # the atomic flip
+            self._stats["swaps"] += 1
+        telemetry.instant("serve.swap", cat="serve", version=vid,
+                          label=label)
+        logger.info("serve: hot-swapped to version %d (%s)", vid, label)
+        return vid
+
+    def _load_module(self, source, state):
+        from ..utils import file_io
+        arch = self._version.module
+        if isinstance(source, Module):
+            if source.params is None:
+                source.build()
+            return source, f"module:{type(source).__name__}"
+        if isinstance(source, str):
+            latest = file_io.latest_checkpoint(source)
+            if latest is not None:  # checkpoint directory: newest snapshot
+                mp, _op, neval = latest
+                blob = file_io.load(mp)
+                return (_clone_with(arch, blob["params"], blob["state"]),
+                        f"ckpt:{source}@{neval}")
+            blob = file_io.load(source)
+            if isinstance(blob, dict) and \
+                    blob.get("format") == "bigdl_tpu-module-v1":
+                m = blob["module"]
+                m.attach(blob["params"], blob["state"])
+                return m, f"file:{source}"
+            if isinstance(blob, dict) and "params" in blob:
+                return (_clone_with(arch, blob["params"],
+                                    blob.get("state")), f"file:{source}")
+            raise ValueError(f"serve: {source!r} is neither a checkpoint "
+                             "directory, a model snapshot, nor a module "
+                             "file")
+        # params pytree swapped in directly (e.g. from a live Optimizer)
+        return _clone_with(arch, source, state), "params"
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def version(self) -> ModelVersion:
+        return self._version
+
+    def stats(self) -> dict:
+        """One merged counter snapshot: admission/shed counts (batcher),
+        batch counts/fill, swaps, current version."""
+        out = self.batcher.stats()
+        with self._lock:
+            out.update(self._stats)
+            out["version"] = self._version.id
+            out["version_label"] = self._version.label
+        out["batch_fill"] = (round(out["batch_rows"] /
+                                   max(out["bucket_rows"], 1), 4))
+        out["replicas"] = self.replicas
+        return out

@@ -12,6 +12,12 @@ mesh-sharded through `optim.Predictor`, so it drops into
 `df[udf(df["text"]) == k]` filters, `DataFrame.assign`, or any row-wise
 serving loop.  `TextClassifierUDF` packages the reference example's text
 pipeline (tokenize -> dictionary lookup -> pad/crop -> embed).
+
+Batching/padding is shared with the ONLINE serving subsystem
+(bigdl_tpu/serve): `serve.batcher.predict_in_fixed_batches` owns the
+fixed-shape chunking + trailing-pad discipline for both the bulk UDF
+path here and the dynamic batcher's request coalescing — one
+implementation, one compile-shape contract.
 """
 
 from __future__ import annotations
@@ -22,6 +28,7 @@ import numpy as np
 
 from .nn.module import Module
 from .optim.optimizer import Predictor
+from .serve.batcher import predict_in_fixed_batches
 
 __all__ = ["UDFPredictor", "TextClassifierUDF"]
 
@@ -46,25 +53,23 @@ class UDFPredictor:
     def __call__(self, rows) -> np.ndarray:
         if hasattr(rows, "to_numpy"):  # pandas Series
             rows = rows.to_numpy()
-        if len(rows) == 0:  # empty filter result: empty predictions
-            return np.empty((0,), np.int64)
+        if len(rows) == 0:
+            # empty filter result: the empty answer must carry the
+            # POSTPROCESS's dtype/shape (a float- or vector-returning
+            # postprocess makes a hardcoded int64 (0,) wrong), so derive
+            # it by running postprocess on a zero-row output stack —
+            # no device call, shapes stay static under jit
+            return np.asarray(self.postprocess(np.empty((0, 1), np.float32)))
         feats = (np.stack([np.asarray(self.preprocess(r), np.float32)
                            for r in rows])
                  if self.preprocess is not None
                  else np.asarray(rows, np.float32))
-        bs = self._predictor.batch_size
-        # chunk host-side (one XLA call per batch, never one giant buffer),
-        # padding the trailing chunk to the full batch shape so jit never
-        # sees a new shape (no per-remainder recompiles)
-        outs = []
-        for i in range(0, len(feats), bs):
-            chunk = feats[i:i + bs]
-            n = len(chunk)
-            if n < bs:
-                chunk = np.concatenate(
-                    [chunk, np.repeat(chunk[-1:], bs - n, axis=0)])
-            outs.append(np.asarray(self._predictor.predict(chunk))[:n])
-        return self.postprocess(np.concatenate(outs, axis=0))
+        # fixed-shape chunking + trailing pad shared with the online
+        # dynamic batcher (serve/batcher.py) — one XLA call per batch,
+        # jit never sees a new shape (no per-remainder recompiles)
+        outs = predict_in_fixed_batches(self._predictor.predict, feats,
+                                        self._predictor.batch_size)
+        return self.postprocess(outs)
 
     def register(self, namespace: dict, name: str) -> "UDFPredictor":
         """Install the UDF under `name` (the Spark `udf.register` analog —

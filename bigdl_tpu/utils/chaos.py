@@ -21,6 +21,8 @@ Fault points wired into the runtime:
 | ``data.record`` | once per record decoded (recordio/seqfile)    | fail/corrupt |
 | ``data.stall``  | once per minibatch fetch (driver loop)        | stall     |
 | ``step.stall``  | once per device step dispatch (driver loop)   | stall     |
+| ``serve.request``| once per request admitted (serve/batcher)    | fail      |
+| ``serve.batch`` | once per online device batch (serve/server)   | fail/stall |
 
 Schedules (1-based counts):
 
@@ -55,7 +57,8 @@ __all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
            "transform", "scoped", "counts", "FAULT_POINTS"]
 
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
-                "step.loss_nan", "data.record", "data.stall", "step.stall")
+                "step.loss_nan", "data.record", "data.stall", "step.stall",
+                "serve.request", "serve.batch")
 
 
 class ChaosFault(RuntimeError):

@@ -43,6 +43,12 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_TRACE | (net-new: run-telemetry trace output dir, utils/telemetry.py; empty = tracing off) | off |
 | BIGDL_TPU_TRACE_RING | (net-new: max buffered trace events; oldest dropped beyond this) | 65536 |
 | BIGDL_TPU_TRACE_FLUSH_EVERY | (net-new: trace events between automatic file flushes) | 4096 |
+| BIGDL_TPU_SERVE_MAX_BATCH | (net-new: online serving — max requests coalesced per device batch, serve/) | 8 |
+| BIGDL_TPU_SERVE_MAX_WAIT_MS | (net-new: flush deadline — max ms the oldest queued request waits for batch fill) | 5 |
+| BIGDL_TPU_SERVE_QUEUE_LIMIT | (net-new: bounded request queue; admission past it raises ServerOverloaded) | 64 |
+| BIGDL_TPU_SERVE_REPLICAS | (net-new: replica worker threads draining the shared serve queue) | 1 |
+| BIGDL_TPU_SERVE_DEADLINE_MS | (net-new: default per-request deadline; expired queued requests shed with RequestTimeout; 0 = none) | 0 |
+| BIGDL_TPU_SERVE_STALL_SECONDS | (net-new: per-replica supervision deadline — a wedged replica trips a stall + crash report; 0 = unwatched) | 0 |
 """
 
 from __future__ import annotations

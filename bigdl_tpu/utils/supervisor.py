@@ -87,8 +87,10 @@ __all__ = ["StallError", "Supervisor", "PHASES", "notify", "set_active",
 #: the optimizer loop's heartbeat phases.  "compile" tags the FIRST step
 #: of each attempt (it holds the XLA compile — ~25s for LeNet on a TPU
 #: backend — and must not false-trip a tight steady-state "step"
-#: deadline); it is unwatched unless given its own deadline.
-PHASES = ("data", "step", "compile", "checkpoint", "validation")
+#: deadline); it is unwatched unless given its own deadline.  "serve" is
+#: the online inference subsystem's replica-worker phase
+#: (serve/server.py — each replica heartbeats its own channel).
+PHASES = ("data", "step", "compile", "checkpoint", "validation", "serve")
 
 # PyThreadState_SetAsyncExc raises the exception CLASS with no args in the
 # target thread; the class pulls its message from here so the StallError

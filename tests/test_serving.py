@@ -29,6 +29,37 @@ def test_udf_predictor_on_arrays_and_series():
     assert mask[0]
 
 
+def test_udf_empty_input_respects_postprocess():
+    """The empty fast path must carry the POSTPROCESS dtype/shape — a
+    float- or vector-returning postprocess used to get a hardcoded
+    int64 (0,) back."""
+    model = nn.Sequential().add(nn.Linear(4, 3)).build(jax.random.key(0))
+    # default postprocess (argmax): empty int class ids
+    out = UDFPredictor(model)([])
+    assert out.shape == (0,) and out.dtype.kind == "i"
+    # float-returning postprocess: empty FLOAT result
+    udf_f = UDFPredictor(model, postprocess=lambda o: o.mean(axis=-1))
+    out = udf_f([])
+    assert out.shape == (0,) and out.dtype.kind == "f"
+    # non-empty path still postprocesses normally
+    x = np.zeros((2, 4), np.float32)
+    assert udf_f(x).shape == (2,) and udf_f(x).dtype.kind == "f"
+
+
+def test_udf_batching_shared_with_serve():
+    """UDFPredictor chunks through the serving subsystem's shared
+    fixed-shape batching (serve.batcher.predict_in_fixed_batches): a
+    non-multiple row count gives the same answer as whole-array
+    prediction, with the trailing chunk padded not recompiled."""
+    from bigdl_tpu.optim import Predictor
+
+    model = nn.Sequential().add(nn.Linear(4, 3)).build(jax.random.key(0))
+    x = np.random.default_rng(2).normal(size=(10, 4)).astype(np.float32)
+    udf = UDFPredictor(model, batch_size=4)  # 10 = 4 + 4 + 2 (padded)
+    np.testing.assert_array_equal(
+        udf(x), np.argmax(Predictor(model).predict(x), axis=-1))
+
+
 def test_udf_register_namespace():
     model = nn.Sequential().add(nn.Linear(2, 2)).build(jax.random.key(1))
     registry = {}

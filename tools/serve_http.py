@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Stdlib HTTP front end for the online inference server (bigdl_tpu.serve).
+
+A real request path over the dynamic batcher / replica pool — no web
+framework, just ``http.server.ThreadingHTTPServer`` (one thread per
+connection blocking on its request's handle, while the server's replica
+pool batches across connections).  Endpoints:
+
+    POST /v1/predict   {"inputs": <sample or list of samples>}
+                       -> {"outputs": ..., "version": N, "latency_ms": x}
+    POST /v1/swap      {"source": "<ckpt dir | snapshot | module file>",
+                        "quantized": false}  -> {"version": N}
+    GET  /v1/stats     -> server.stats()
+    GET  /healthz      -> {"ok": true, "version": N}
+
+Typed shedding maps onto status codes: 429 ServerOverloaded (back off),
+504 RequestTimeout (deadline passed in queue), 503 ServerClosed.
+
+Usage:
+    python tools/serve_http.py --model lenet --port 8000
+    python tools/serve_http.py --checkpoint /ckpts/run1 --model lenet \
+        --replicas 2 --max-batch 16
+    curl -s localhost:8000/v1/predict -d '{"inputs": [[...28x28...]]}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# runnable as `python tools/serve_http.py` from the repo root
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def build_model(name: str):
+    """Built (randomly initialized) architecture + example sample shape;
+    real weights come from --checkpoint / POST /v1/swap."""
+    import jax
+    import numpy as np
+
+    if name == "lenet":
+        from bigdl_tpu.models.lenet import LeNet5
+        return (LeNet5(10).build(jax.random.key(0)),
+                np.zeros((28, 28, 1), np.float32))
+    if name == "linear":
+        import bigdl_tpu.nn as nn
+        return (nn.Sequential().add(nn.Linear(4, 3)).build(
+            jax.random.key(0)), np.zeros((4,), np.float32))
+    raise SystemExit(f"unknown --model {name!r} (lenet|linear)")
+
+
+def make_handler(server):
+    import numpy as np
+
+    from bigdl_tpu.serve import (RequestTimeout, ServerClosed,
+                                 ServerOverloaded)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet; stats has the counters
+            pass
+
+        def _reply(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            return json.loads(raw.decode() or "{}")
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"ok": True,
+                                  "version": server.version.id})
+            elif self.path == "/v1/stats":
+                self._reply(200, server.stats())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                body = self._body()
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._reply(400, {"error": f"bad JSON: {e}"})
+            if self.path == "/v1/predict":
+                return self._predict(body)
+            if self.path == "/v1/swap":
+                return self._swap(body)
+            self._reply(404, {"error": f"no route {self.path}"})
+
+        def _predict(self, body):
+            if "inputs" not in body:
+                return self._reply(400, {"error": "missing 'inputs'"})
+            x = np.asarray(body["inputs"], np.float32)
+            batched = x.ndim > server.sample_ndim
+            rows = x if batched else x[None]
+            deadline = body.get("deadline_ms")
+            try:
+                # submit every row FIRST (they coalesce into one bucket),
+                # then wait — a row-at-a-time predict() would serialize
+                handles = [server.submit(r, deadline_ms=deadline)
+                           for r in rows]
+                outs = [h.result(timeout=body.get("timeout_s", 120))
+                        for h in handles]
+            except ServerOverloaded as e:
+                return self._reply(429, {"error": str(e),
+                                         "type": "ServerOverloaded"})
+            except RequestTimeout as e:
+                return self._reply(504, {"error": str(e),
+                                         "type": "RequestTimeout"})
+            except ServerClosed as e:
+                return self._reply(503, {"error": str(e),
+                                         "type": "ServerClosed"})
+            except Exception as e:  # noqa: BLE001 — typed per-request
+                return self._reply(500, {"error": str(e),
+                                         "type": type(e).__name__})
+            out = np.stack(outs)
+            lat = max(h.latency_s or 0.0 for h in handles)
+            self._reply(200, {
+                "outputs": (out if batched else out[0]).tolist(),
+                "version": handles[-1].version,
+                "latency_ms": round(lat * 1e3, 3)})
+
+        def _swap(self, body):
+            src = body.get("source") or body.get("checkpoint")
+            if not src:
+                return self._reply(400, {"error": "missing 'source'"})
+            try:
+                vid = server.swap(src,
+                                  quantized=bool(body.get("quantized")))
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                return self._reply(500, {"error": str(e),
+                                         "type": type(e).__name__})
+            self._reply(200, {"version": vid})
+
+    return Handler
+
+
+def serve_forever(server, host: str, port: int):
+    """Returns the started ThreadingHTTPServer (tests call shutdown())."""
+    # the sample rank lets /v1/predict tell one sample from a batch
+    server.sample_ndim = server._example.ndim if server._example is not None \
+        else 1
+    httpd = ThreadingHTTPServer((host, port), make_handler(server))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="bigdl-serve-http")
+    t.start()
+    return httpd
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lenet", help="lenet|linear")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint dir / snapshot / module file to load "
+                         "as the initial weights (swap path)")
+    ap.add_argument("--quantized", action="store_true",
+                    help="int8-quantize the initial checkpoint load")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--queue-limit", type=int, default=None)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+
+    from bigdl_tpu.serve import InferenceServer
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+    model, sample = build_model(args.model)
+    server = InferenceServer(
+        model, example=sample, replicas=args.replicas,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_limit=args.queue_limit, deadline_ms=args.deadline_ms)
+    server.start()
+    if args.checkpoint:
+        server.swap(args.checkpoint, quantized=args.quantized)
+    httpd = serve_forever(server, args.host, args.port)
+    print(json.dumps({"serving": f"http://{args.host}:{args.port}",
+                      "model": args.model,
+                      "version": server.version.id,
+                      "stats": "/v1/stats"}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,19 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] trace_report FAILED rc=$TR_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # online-serving smoke (cpu only): concurrent requests through the
+  # dynamic batcher / replica pool must coalesce (batches < requests),
+  # hold the p95 bound, and survive a mid-traffic hot swap with zero
+  # dropped requests; then the bench --serve record (closed+open loop,
+  # latency percentiles + shed rate) lands beside the other bench JSONs
+  echo "[runbook] 2f/4 online-serving smoke (serve_smoke + bench --serve)" >> "$LOG"
+  timeout 300 python tools/serve_smoke.py --platform cpu \
+    > /tmp/serve_smoke.json 2>/tmp/serve_smoke.log
+  echo "[runbook] serve_smoke rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+  timeout 420 python bench.py --serve --platform cpu \
+    > /tmp/bench_serve.json 2>/tmp/bench_serve.log
+  echo "[runbook] bench --serve rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -144,7 +157,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
