@@ -149,7 +149,13 @@ def predict_in_fixed_batches(forward: Callable, feats: np.ndarray,
     """Chunk ``feats`` host-side into full ``batch_size`` batches (one XLA
     call per batch, never one giant buffer), padding the trailing chunk
     with :func:`pad_rows`, and concatenate the trimmed outputs.  The bulk
-    (UDFPredictor) counterpart of the online batcher's bucket padding."""
+    (UDFPredictor) counterpart of the online batcher's bucket padding.
+    Zero-row ``feats`` return a zero-row array without touching the
+    device (the output's trailing shape is unknowable without a forward,
+    so it mirrors the input's)."""
+    feats = np.asarray(feats)
+    if len(feats) == 0:
+        return feats
     outs = []
     for i in range(0, len(feats), batch_size):
         chunk = feats[i:i + batch_size]

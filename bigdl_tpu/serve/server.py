@@ -133,7 +133,8 @@ class InferenceServer:
                                       clock=clock)
         self._example = None if example is None else np.asarray(example)
         self._version = ModelVersion(1, model, "initial", strategy)
-        self._lock = threading.Lock()      # stats + swap serialization
+        self._lock = threading.Lock()       # stats + version flip (brief)
+        self._swap_lock = threading.Lock()  # serialize concurrent swaps
         self._threads: list = []
         self._stats = {"batches": 0, "batch_rows": 0, "batch_errors": 0,
                        "bucket_rows": 0, "swaps": 0}
@@ -208,6 +209,13 @@ class InferenceServer:
             # remember the sample shape so later swaps can warm up the
             # new version's batch shapes before taking traffic
             self._example = np.zeros_like(x)
+        elif x.shape != self._example.shape:
+            # reject shape strays at admission: one odd sample must not
+            # reach np.stack inside a coalesced batch, where the failure
+            # would hit its innocent batch-mates too
+            raise ServeError(
+                f"serve: sample shape {x.shape} does not match the "
+                f"server's example shape {self._example.shape}")
         ms = (deadline_ms if deadline_ms is not None
               else self.default_deadline_ms)
         deadline = (self.batcher.clock() + ms / 1000.0) if ms and ms > 0 \
@@ -244,6 +252,16 @@ class InferenceServer:
                     logger.warning("serve: replica %d received a stall "
                                    "notice between batches; continuing",
                                    idx)
+                except Exception as e:  # noqa: BLE001 — replica backstop
+                    # _execute resolves its own batch's errors, so reqs
+                    # dequeued by a failed iteration are already answered;
+                    # anything that still escapes (collect-path surprise,
+                    # telemetry sink error...) must not take the replica
+                    # down with it — a dead replica silently shrinks the
+                    # pool until the server stops serving
+                    logger.exception(
+                        "serve: replica %d loop error (%s); continuing",
+                        idx, type(e).__name__)
         finally:
             if chan is not None:
                 chan.close()
@@ -253,8 +271,11 @@ class InferenceServer:
         # split the batch across versions (no misrouted requests)
         n = len(reqs)
         bucket = self.batcher.bucket_for(n)
-        batch = pad_rows(np.stack([r.payload for r in reqs]), bucket)
         try:
+            # batch assembly is inside the guard too: a stray payload that
+            # defeats admission-time shape checks (or OOMs the stack) must
+            # fail ITS batch typed, not kill the replica thread
+            batch = pad_rows(np.stack([r.payload for r in reqs]), bucket)
             with telemetry.span("serve.batch", cat="serve", size=n,
                                 bucket=bucket, version=version.id):
                 chaos.fire("serve.batch")
@@ -316,7 +337,12 @@ class InferenceServer:
         engine constructed, batch shapes warmed — BEFORE one reference
         flip makes it live: in-flight batches finish on the old version,
         every queued/new request runs on the new one."""
-        with self._lock:  # serialize concurrent swaps, not the data path
+        # the slow build (retried remote IO, quantize, engine, warmup)
+        # runs under its OWN lock: _lock guards only the reference flip
+        # and per-batch stats, so replicas keep answering traffic for the
+        # whole duration of a swap — serialize concurrent swaps, never
+        # the data path
+        with self._swap_lock:
             vid = self._version.id + 1
             module, label = self._load_module(source, state)
             if quantized:
@@ -326,8 +352,9 @@ class InferenceServer:
             version = ModelVersion(vid, module, label, self._strategy)
             if self._example is not None:
                 self._warm_version(version, self._example)
-            self._version = version  # the atomic flip
-            self._stats["swaps"] += 1
+            with self._lock:
+                self._version = version  # the atomic flip
+                self._stats["swaps"] += 1
         telemetry.instant("serve.swap", cat="serve", version=vid,
                           label=label)
         logger.info("serve: hot-swapped to version %d (%s)", vid, label)

@@ -49,6 +49,7 @@ class UDFPredictor:
         self.postprocess = postprocess or (
             lambda out: np.argmax(out, axis=-1))
         self._predictor = Predictor(model, batch_size=batch_size)
+        self._out_spec = None  # (trailing shape, dtype) of real outputs
 
     def __call__(self, rows) -> np.ndarray:
         if hasattr(rows, "to_numpy"):  # pandas Series
@@ -56,10 +57,19 @@ class UDFPredictor:
         if len(rows) == 0:
             # empty filter result: the empty answer must carry the
             # POSTPROCESS's dtype/shape (a float- or vector-returning
-            # postprocess makes a hardcoded int64 (0,) wrong), so derive
-            # it by running postprocess on a zero-row output stack —
-            # no device call, shapes stay static under jit
-            return np.asarray(self.postprocess(np.empty((0, 1), np.float32)))
+            # postprocess makes a hardcoded int64 (0,) wrong), so run
+            # postprocess on a zero-row output stack — no device call,
+            # shapes stay static under jit.  The probe's trailing shape
+            # is the model's real one when a non-empty call has recorded
+            # it; a guessed (0, 1) probe can defeat a postprocess that
+            # indexes a class column (out[:, 1]), so failures there fall
+            # back to a plain empty array instead of raising
+            shape, dtype = self._out_spec or ((1,), np.float32)
+            try:
+                return np.asarray(
+                    self.postprocess(np.empty((0,) + shape, dtype)))
+            except Exception:  # noqa: BLE001 — probe shape was a guess
+                return np.empty((0,), np.float32)
         feats = (np.stack([np.asarray(self.preprocess(r), np.float32)
                            for r in rows])
                  if self.preprocess is not None
@@ -69,6 +79,7 @@ class UDFPredictor:
         # jit never sees a new shape (no per-remainder recompiles)
         outs = predict_in_fixed_batches(self._predictor.predict, feats,
                                         self._predictor.batch_size)
+        self._out_spec = (outs.shape[1:], outs.dtype)
         return self.postprocess(outs)
 
     def register(self, namespace: dict, name: str) -> "UDFPredictor":

@@ -76,6 +76,17 @@ def test_predict_in_fixed_batches_never_shows_new_shapes():
     np.testing.assert_array_equal(out, x * 2.0)
 
 
+def test_predict_in_fixed_batches_empty_input():
+    """Zero-row feats never reach the forward and come back zero-row —
+    the helper is public (__all__) and must be safe without the caller
+    guarding the empty case first."""
+    def forward(chunk):  # pragma: no cover — must not run
+        raise AssertionError("forward called for empty feats")
+
+    out = predict_in_fixed_batches(forward, _rows(0), 4)
+    assert out.shape == (0, 4)
+
+
 def test_batcher_deadline_shed_at_dequeue_counts():
     clock_box = [0.0]
     b = DynamicBatcher(max_batch=4, max_wait_s=0.0, queue_limit=8,
@@ -208,6 +219,42 @@ def test_deadline_timeout_typed_rejection():
     stats = server.stats()
     assert stats["shed_timeout"] == 3
     assert stats["batch_rows"] == 1  # shed requests never hit the device
+    server.stop()
+
+
+def test_submit_shape_mismatch_typed_rejection():
+    """A sample whose shape differs from the server's example is rejected
+    typed at admission — it must never reach np.stack inside a coalesced
+    batch where the failure would hit its batch-mates."""
+    from bigdl_tpu.serve import ServeError
+
+    Engine.init()
+    with InferenceServer(_linear_model(), max_wait_ms=2,
+                         example=_rows(1)[0]) as server:
+        with pytest.raises(ServeError):
+            server.submit(np.zeros((7,), np.float32))
+        # the server keeps serving well-shaped traffic
+        assert server.predict(_rows(1)[0], timeout=30).shape == (3,)
+
+
+def test_stray_payload_fails_batch_typed_replica_survives():
+    """A shape stray that defeats admission checks (here: enqueued via
+    the batcher directly) fails ITS batch with a typed per-request error;
+    the replica thread and the server survive."""
+    Engine.init()
+    server = InferenceServer(_linear_model(), max_batch=4, max_wait_ms=2,
+                             example=_rows(1)[0])
+    # both queued BEFORE start -> they coalesce into one batch
+    good = server.batcher.submit(_rows(1)[0])
+    bad = server.batcher.submit(np.zeros((7,), np.float32))
+    server.start()
+    with pytest.raises(ValueError):
+        bad.result(30)
+    with pytest.raises(ValueError):
+        good.result(30)  # same batch: fails loudly, not a hang
+    assert server.stats()["batch_errors"] == 1
+    # the replica is still alive and answering
+    assert server.predict(_rows(1)[0], timeout=30).shape == (3,)
     server.stop()
 
 
@@ -361,6 +408,40 @@ def test_swap_quantized_parity(tmp_path):
     assert y_q.shape == y_f.shape
     assert float(np.max(np.abs(y_q - y_f))) < 0.15
     assert int(np.argmax(y_q)) == int(np.argmax(y_f))
+
+
+def test_swap_build_does_not_block_data_path():
+    """The slow half of swap() (checkpoint load / quantize / engine /
+    warmup) must not hold the lock the replicas' stats updates take:
+    while a swap is stuck in _load_module, predict() still answers."""
+    Engine.init()
+    x = _rows(2)
+    with InferenceServer(_linear_model(seed=0), max_wait_ms=2,
+                         example=x[0]) as server:
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = server._load_module
+
+        def slow_load(source, state):
+            entered.set()
+            assert gate.wait(30), "test gate never opened"
+            return orig(source, state)
+
+        server._load_module = slow_load
+        sw = threading.Thread(target=server.swap,
+                              args=(_linear_model(seed=9),))
+        sw.start()
+        try:
+            assert entered.wait(30)
+            # swap is mid-build and holding its own lock — traffic and
+            # stats() must proceed, not pause until the build finishes
+            assert server.predict(x[0], timeout=30).shape == (3,)
+            assert server.stats()["swaps"] == 0
+        finally:
+            gate.set()
+            sw.join(30)
+        assert server.stats()["swaps"] == 1
+        assert server.stats()["version"] == 2
 
 
 def test_swap_module_file(tmp_path):

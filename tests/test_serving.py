@@ -46,6 +46,25 @@ def test_udf_empty_input_respects_postprocess():
     assert udf_f(x).shape == (2,) and udf_f(x).dtype.kind == "f"
 
 
+def test_udf_empty_input_column_indexing_postprocess():
+    """A postprocess that indexes a class column (out[:, 1]) must not
+    blow up on empty input: before any real call the guessed probe shape
+    falls back to a plain empty array; after a real call the probe
+    carries the model's true trailing shape, so the postprocess runs."""
+    model = nn.Sequential().add(nn.Linear(4, 3)).build(jax.random.key(0))
+    udf = UDFPredictor(model, postprocess=lambda o: o[:, 1])
+    # cold: the (0, 1) probe would raise IndexError inside postprocess —
+    # the empty answer degrades to an empty array instead of raising
+    out = udf([])
+    assert out.shape == (0,)
+    # warm: a real call records the (N, 3) output spec; the empty path
+    # now probes with (0, 3) and the postprocess itself shapes the answer
+    x = np.zeros((2, 4), np.float32)
+    assert udf(x).shape == (2,)
+    out = udf([])
+    assert out.shape == (0,) and out.dtype.kind == "f"
+
+
 def test_udf_batching_shared_with_serve():
     """UDFPredictor chunks through the serving subsystem's shared
     fixed-shape batching (serve.batcher.predict_in_fixed_batches): a

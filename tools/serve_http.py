@@ -58,7 +58,7 @@ def build_model(name: str):
 def make_handler(server):
     import numpy as np
 
-    from bigdl_tpu.serve import (RequestTimeout, ServerClosed,
+    from bigdl_tpu.serve import (RequestTimeout, ServeError, ServerClosed,
                                  ServerOverloaded)
 
     class Handler(BaseHTTPRequestHandler):
@@ -123,6 +123,11 @@ def make_handler(server):
             except ServerClosed as e:
                 return self._reply(503, {"error": str(e),
                                          "type": "ServerClosed"})
+            except ServeError as e:
+                # remaining admission rejections (e.g. sample shape does
+                # not match the served model) are the client's fault
+                return self._reply(400, {"error": str(e),
+                                         "type": type(e).__name__})
             except Exception as e:  # noqa: BLE001 — typed per-request
                 return self._reply(500, {"error": str(e),
                                          "type": type(e).__name__})
