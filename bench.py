@@ -50,11 +50,8 @@ import time
 MFU_TARGET = 0.45  # BASELINE.md: ResNet-50 >= 45% MFU on v5e
 _SCALING_TIMEOUT = 420  # seconds for the CPU scaling subprocess
 
-# bf16 peak FLOP/s per *jax device* (v2/v3 devices are single cores).
-_PEAK_BF16 = (
-    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite / v5e
-    ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12),
-)
+# bf16 peak FLOP/s per jax device now lives in utils/flops.py
+# (device_peak_flops) — shared with the Optimizer's per-step mfu counter.
 
 
 # Stall watchdog: the tunneled backend can lose an RPC mid-run (observed
@@ -199,12 +196,24 @@ def _init_backend(timeout=240, retries=3, backoff=15):
 
 
 def _table_peak_flops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    if "tpu" in kind or "tpu" in getattr(device, "platform", ""):
-        for key, val in _PEAK_BF16:
-            if key in kind:
-                return val
-    return None  # CPU/unknown: no table entry
+    from bigdl_tpu.utils.flops import device_peak_flops
+    val, source = device_peak_flops(device)
+    # bench refuses to report MFU against the made-up CPU denominator
+    # (the trace counter uses it as a relative signal; a bench JSON line
+    # must not) — table and explicit BIGDL_TPU_PEAK_FLOPS both count
+    return val if source in ("table", "env") else None
+
+
+def _aot_delta(before):
+    """Per-config AOT-cache ledger for the bench record: counter deltas
+    since `before` (utils/aot.stats snapshot), or a disabled marker."""
+    from bigdl_tpu.utils import aot as aot_mod
+    if not aot_mod.enabled():
+        return {"enabled": False}
+    after = aot_mod.stats()
+    return {"enabled": True,
+            **{k: int(after[k] - before[k])
+               for k in ("hits", "misses", "stores", "compiles")}}
 
 
 def _step_flops(jitted, compiled, example_args):
@@ -404,10 +413,18 @@ def _bench_config(name, build, peak_flops):
     lr_arr, rng = jnp.float32(lr), jax.random.key(1)
 
     _beat(f"compile:{name}")
+    from bigdl_tpu.utils import aot as aot_mod
+    aot0 = aot_mod.stats()
     t0 = time.perf_counter()
     lowered = step.lower(params, net_state, opt_state, inp, tgt, lr_arr, rng)
-    compiled = lowered.compile()
+    # AOT executable cache (BIGDL_TPU_AOT_CACHE): a warm config's
+    # compile_seconds collapses to one cache read; disabled -> identical
+    # to the old lowered.compile()
+    compiled = aot_mod.cached_compile(
+        lowered, label=f"bench.{name}", mesh=mesh,
+        example_args=(params, net_state, opt_state, inp, tgt, lr_arr, rng))
     compile_s = time.perf_counter() - t0
+    aot_rec = _aot_delta(aot0)
 
     _beat(f"trace:{name}")
     flops_step, flops_detail = _step_flops(
@@ -435,7 +452,8 @@ def _bench_config(name, build, peak_flops):
         e2e = {"e2e_error": f"{type(e).__name__}: {e}"}
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
-                        jnp.dtype(policy.compute_dtype).name, **e2e)
+                        jnp.dtype(policy.compute_dtype).name,
+                        aot_cache=aot_rec, **e2e)
 
 
 def _bench_resnet50_bf16_autotune(name, build, peak_flops):
@@ -537,9 +555,14 @@ def _bench_infer(name, build, peak_flops):
 
     tok0 = jnp.float32(0)
     _beat(f"compile:{name}")
+    from bigdl_tpu.utils import aot as aot_mod
+    aot0 = aot_mod.stats()
     t0 = time.perf_counter()
-    compiled = jax.jit(forward).lower(params, inp, tok0).compile()
+    lowered = jax.jit(forward).lower(params, inp, tok0)
+    compiled = aot_mod.cached_compile(lowered, label=f"bench.{name}.infer",
+                                      example_args=(params, inp, tok0))
     compile_s = time.perf_counter() - t0
+    aot_rec = _aot_delta(aot0)
     _beat(f"trace:{name}")
     flops_step, flops_detail = _step_flops(forward, compiled,
                                            (params, inp, tok0))
@@ -556,7 +579,7 @@ def _bench_infer(name, build, peak_flops):
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
-                        mode="inference")
+                        mode="inference", aot_cache=aot_rec)
 
 
 def _bench_flash(name, build, peak_flops):
@@ -835,6 +858,11 @@ def main(argv=None):
     cache_dir = enable_compilation_cache()
     if cache_dir:
         _log(f"XLA compilation cache: {cache_dir}")
+    from bigdl_tpu.utils import aot as _aot
+    if _aot.cache_dir():
+        _log(f"AOT executable cache: {_aot.cache_dir()} "
+             "(warm configs skip XLA entirely; per-config hit/miss in "
+             "each record's aot_cache)")
 
     jax, devices = _init_backend()
 

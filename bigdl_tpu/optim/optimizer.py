@@ -299,6 +299,11 @@ class Optimizer:
         self.metrics = Metrics()
         self._compiled = None
         self._mesh = None
+        # per-step MFU counter (armed lazily at the first step, only when
+        # telemetry is tracing): flops/step from the analytic jaxpr count,
+        # denominator = device peak * mesh size (utils/flops.py)
+        self._step_flops = None
+        self._mfu_denom = None
         # straggler mitigation (reference: Optimizer.setDropModuleProperty,
         # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
         self.drop_percentage = 0.0
@@ -722,7 +727,44 @@ class Optimizer:
             donate_argnums=(0, 1, 2),
         )
 
+        # AOT executable cache (utils/aot.py, BIGDL_TPU_AOT_CACHE): with a
+        # cache dir configured, the first call lowers (cheap tracing),
+        # keys on the HLO hash, and either deserializes a stored
+        # executable (warm start: zero XLA compiles) or compiles once and
+        # stores.  Keyed per batch-aval signature: a partial final batch
+        # lowers/loads its own entry instead of crashing the fixed-shape
+        # executable.  Disabled (the default) -> the pjit call below is
+        # byte-for-byte the old path.
+        aot_exe: dict = {}
+
+        def _aot_step(args):
+            from ..utils import aot as aot_mod
+            sig = tuple((tuple(x.shape), str(x.dtype))
+                        for x in jax.tree.leaves(args[3:5]))
+            comp = aot_exe.get(sig)
+            if comp is None:
+                with mesh:
+                    lowered = jitted.lower(*args)
+                comp = aot_mod.cached_compile(
+                    lowered, label="optim.step", mesh=mesh,
+                    example_args=args)
+                aot_exe[sig] = comp
+            with mesh:
+                return comp(*args)
+
         def step_in_mesh(*args):
+            from ..utils import aot as aot_mod
+            if aot_mod.enabled() and not aot_exe.get("disabled"):
+                try:
+                    return _aot_step(args)
+                except Exception as e:  # noqa: BLE001 — cache must never
+                    # take down training: fall back to the plain pjit call
+                    # (donated args may already be consumed by a partial
+                    # AOT call, but cached_compile/load never consume)
+                    logger.warning("aot: train-step cache path failed "
+                                   "(%s: %s); falling back to jit",
+                                   type(e).__name__, e)
+                    aot_exe["disabled"] = True
             # trace/compile under the mesh context so PartitionSpec-based
             # with_sharding_constraint inside modules binds to the training
             # mesh (e.g. MoEFFN's expert-axis hints); entering a mesh
@@ -760,6 +802,32 @@ class Optimizer:
 
         return fwd_in_mesh
 
+    def _arm_mfu(self, step_fn, example_args, mesh) -> None:
+        """One-shot arming of the per-step ``mfu`` counter (called only
+        when telemetry is tracing, so the extra trace costs nothing on
+        untraced runs): analytic FLOPs of one step from the UNJITTED
+        function (`.raw`, same source bench._step_flops uses) over the
+        device peak * mesh size.  Any failure disarms (denominator 0) —
+        the counter is diagnostics, never a crash."""
+        from ..utils import flops as flops_mod
+        self._mfu_denom = 0.0
+        try:
+            fn = getattr(step_fn, "raw", None)
+            if fn is None:
+                return
+            # fresh lambda: make_jaxpr caches by function identity
+            self._step_flops = flops_mod.jaxpr_flops(
+                jax.make_jaxpr(lambda *a: fn(*a))(*example_args))
+            peak, src = flops_mod.device_peak_flops(jax.devices()[0])
+            if self._step_flops and peak > 0:
+                self._mfu_denom = peak * mesh.size
+                logger.info(
+                    "mfu counter armed: %.3e flops/step, peak %.3e x %d "
+                    "devices (%s)", self._step_flops, peak, mesh.size, src)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            logger.info("mfu counter disarmed: %s: %s",
+                        type(e).__name__, e)
+
     # ------------------------------------------------------------------
     # the driver loop (reference: DistriOptimizer.scala:141-381)
     # ------------------------------------------------------------------
@@ -775,6 +843,10 @@ class Optimizer:
         # _optimize_impl keeps it stable across retry re-entries only)
         self._initial_blob = None
         self._preempted = False
+        # re-arm the mfu counter per run: batch shapes / mesh / tracing
+        # state may all have changed since the last optimize()
+        self._step_flops = None
+        self._mfu_denom = None
         old_handlers = {}
         # armed from rank-consistent inputs ONLY (checkpoint_path and the
         # env knob must agree across ranks) — NOT from whether the signal
@@ -1141,6 +1213,12 @@ class Optimizer:
                 inp, tgt = staged if staged is not None else _put_batch(
                     (batch.get_input(), batch.get_target()), data_sh)
                 rng = next_rng_key()
+                if self._mfu_denom is None and telemetry.enabled():
+                    # arm the per-step mfu counter BEFORE the first step
+                    # consumes (donates) these params
+                    self._arm_mfu(step_fn, (params, net_state, opt_state,
+                                            inp, tgt, jnp.float32(lr), rng),
+                                  mesh)
                 params, net_state, opt_state, loss = step_fn(
                     params, net_state, opt_state, inp, tgt,
                     jnp.float32(lr), rng)
@@ -1178,10 +1256,18 @@ class Optimizer:
                 # track the trace_report phase breakdown reads
                 step_dur = time.perf_counter() - iter_start
                 telemetry.complete("step", step_dur, neval=neval)
-                telemetry.counter(
-                    "train", data_wait_s=data_wait, step_s=step_dur,
-                    records_per_sec=n / max(step_dur, 1e-9),
-                    prefetch_queue_depth=float(qdepth or 0))
+                counters = {"data_wait_s": data_wait, "step_s": step_dur,
+                            "records_per_sec": n / max(step_dur, 1e-9),
+                            "prefetch_queue_depth": float(qdepth or 0)}
+                if self._mfu_denom:
+                    # steady-state host step wall ~= device step time (the
+                    # next dispatch blocks on this step's donated buffers),
+                    # so flops/wall/peak tracks true MFU except on the
+                    # compile step, which shows as an honest dip
+                    counters["mfu"] = (self._step_flops / max(step_dur, 1e-9)
+                                       / self._mfu_denom)
+                    counters["model_flops_per_step"] = self._step_flops
+                telemetry.counter("train", **counters)
                 # per-parameter histograms when a "Parameters" trigger is set
                 # (reference: DistriOptimizer.saveSummary :426-456 — off by
                 # default because it pulls every weight to host)
@@ -1583,6 +1669,11 @@ class _ShardedForward:
         self._fwd = None
         self._placed = None      # (mesh, params, net_state)
         self._placed_src = None  # identity of model.params at placement time
+        # AOT executable cache state (utils/aot.py): per-input-shape
+        # deserialized/compiled executables + the lazily computed module
+        # fingerprint half of their key
+        self._aot_exe: dict = {}
+        self._aot_fp = None
 
     def _ensure(self):
         model = self.model
@@ -1600,6 +1691,7 @@ class _ShardedForward:
             self._placed = (mesh, params, net_state)
             self._placed_src = model.params
             self._fwd = jax.jit(partial(_eval_forward, model))
+            self._aot_exe = {}  # executables are placement-specific
         return self._placed
 
     def dp_size(self) -> int:
@@ -1623,14 +1715,55 @@ class _ShardedForward:
 
         n = (inp[0] if isinstance(inp, (list, tuple)) else inp).shape[0]
         placed = _put_batch(jax.tree.map(pad, inp), data_sh)
-        with mesh:  # PartitionSpec constraints inside modules must bind
-            out = self._fwd(params, net_state, placed)
+        out = None
+        from ..utils import aot as aot_mod
+        if aot_mod.enabled() and not self._aot_exe.get("disabled"):
+            try:
+                out = self._aot_forward(mesh, params, net_state, placed)
+            except Exception as e:  # noqa: BLE001 — the cache must never
+                # break inference: fall back to the plain jit call
+                logger.warning("aot: forward cache path failed (%s: %s); "
+                               "falling back to jit", type(e).__name__, e)
+                self._aot_exe["disabled"] = True
+        if out is None:
+            with mesh:  # PartitionSpec constraints inside modules must bind
+                out = self._fwd(params, net_state, placed)
         if jax.process_count() > 1:
             # global outputs are not host-addressable from one process;
             # each process fed the full rows, so its local shard IS the
             # complete (redundantly computed) answer
             out = _local_rows(_gather_non_batch(out))
         return out, n
+
+    def _aot_forward(self, mesh, params, net_state, placed):
+        """Forward through the AOT executable cache (utils/aot.py).
+
+        The key is a *structural* module fingerprint + the placed arg
+        avals — computable without any tracing — so a warm serve bucket
+        ladder (InferenceServer.warmup on a second process) performs zero
+        fresh lowers: each bucket shape is one cache read."""
+        from ..utils import aot as aot_mod
+        sig = tuple((tuple(x.shape), str(x.dtype))
+                    for x in jax.tree.leaves(placed))
+        comp = self._aot_exe.get(sig)
+        if comp is None:
+            if self._aot_fp is None:
+                self._aot_fp = aot_mod.module_fingerprint(self.model)
+            fields = dict(aot_mod.base_fingerprint(mesh))
+            fields["kind"] = "forward"
+            fields["model"] = self._aot_fp
+            fields["args"] = aot_mod.aval_fingerprint(
+                (params, net_state, placed))
+
+            def lower_fn():
+                with mesh:
+                    return self._fwd.lower(params, net_state, placed)
+
+            comp = aot_mod.get_or_compile(fields, lower_fn,
+                                          label="forward")
+            self._aot_exe[sig] = comp
+        with mesh:
+            return comp(params, net_state, placed)
 
 
 class _PeekedDataSet:

@@ -307,7 +307,14 @@ class InferenceServer:
 
     def warmup(self, example: Optional[np.ndarray] = None) -> None:
         """Compile every bucket shape on the CURRENT version before (or
-        between) traffic, so steady state never recompiles."""
+        between) traffic, so steady state never recompiles.
+
+        With the AOT executable cache armed (``BIGDL_TPU_AOT_CACHE``,
+        utils/aot.py), a warm process turns the whole bucket ladder into
+        N cache reads — zero fresh lowers, zero XLA compiles — so a
+        swapped-in replica reaches serving-ready in seconds instead of
+        minutes.  The first process to run a ladder populates the cache;
+        ``stats()["aot"]`` shows the hit/miss ledger."""
         ex = np.asarray(example) if example is not None else self._example
         if ex is None:
             raise ValueError("serve: warmup needs an example sample "
@@ -406,4 +413,13 @@ class InferenceServer:
         out["batch_fill"] = (round(out["batch_rows"] /
                                    max(out["bucket_rows"], 1), 4))
         out["replicas"] = self.replicas
+        from ..utils import aot
+        if aot.enabled():
+            # warm-start ledger: a freshly swapped/restarted replica that
+            # served its ladder from the AOT cache shows hits==buckets,
+            # misses==0 here (process-wide counters, utils/aot.py)
+            s = aot.stats()
+            out["aot"] = {k: int(s[k]) for k in
+                          ("hits", "misses", "stores", "lowers",
+                           "compiles", "corrupt")}
         return out

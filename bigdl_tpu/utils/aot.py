@@ -1,0 +1,431 @@
+"""Persistent AOT executable cache: cold start is a cache read, not a compile.
+
+The compile-time war chest (ROADMAP item 1): XLA compiles of some models are
+pathologically slow on the tunneled backend (LeNet's train step: 809s
+measured, vs 27s for ResNet-50 — docs/benchmarking.md), and rounds 3-5 lost
+whole bench windows to recompiles.  The XLA persistent cache
+(utils/platform.enable_compilation_cache) already warms the *compiler*; this
+module goes one level up and caches the **serialized executable** itself
+(`jax.jit(...).lower(...).compile()` via
+`jax.experimental.serialize_executable`), so a warm process performs zero
+XLA work at all: startup becomes IO.
+
+Three compile choke points route through here:
+
+- the Optimizer's pjit train step (optim/optimizer._build_step) — keyed by
+  the **HLO hash** (plus versions/backend/mesh/avals), so any model or
+  lowering change is automatically a miss;
+- Evaluator/Predictor/serve forward (optim.optimizer._ShardedForward) —
+  keyed by a **structural module fingerprint** (no tracing needed), so a
+  warm `InferenceServer.warmup()` performs zero fresh lowers: the serve
+  bucket ladder's N compiles become N cache reads;
+- bench.py's timed configs — the measured `compile_seconds` collapses on a
+  warm run and the per-config record carries the hit/miss delta.
+
+Entries are CRC-framed pickles written through :mod:`.file_io` (the PR-1
+checkpoint framing — local, ``memory://`` and fsspec schemes all work, so a
+remote cache dir warms a whole pod).  A corrupt or undeserializable entry is
+**quarantined** (renamed ``*.corrupt``) and silently recompiled — the cache
+can never make a run fail.
+
+Keying / invalidation: every key fingerprints (jax, jaxlib, bigdl_tpu
+versions; backend + device kind + device/process count; mesh shape+axes;
+arg avals incl. shardings; an optional ``BIGDL_TPU_AOT_CACHE_TAG``), plus
+the HLO hash (train/bench) or the module fingerprint (forward).  Change any
+of them and the entry simply misses; stale entries are never served.
+
+Knobs:
+
+| env var | meaning | default |
+|---|---|---|
+| ``BIGDL_TPU_AOT_CACHE`` | cache directory (any file_io scheme); empty/0 = disabled | off |
+| ``BIGDL_TPU_AOT_CACHE_TAG`` | free-form fingerprint salt (bump to invalidate en masse) | "" |
+
+Telemetry: ``aot.load`` / ``aot.store`` / ``compile`` spans, plus an ``aot``
+counter track (hits / misses / stores) so a trace proves whether a run was
+warm.  Multi-process (multi-host) runs disable the cache: a serialized SPMD
+executable embeds the global topology and per-host deserialize ordering is
+not worth the risk — each host still benefits from the XLA persistent cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["enabled", "cache_dir", "get_cache", "reset", "stats",
+           "AOTCache", "fingerprint", "base_fingerprint",
+           "aval_fingerprint", "module_fingerprint", "hlo_hash",
+           "cached_compile", "get_or_compile"]
+
+_FORMAT = "bigdl_tpu-aot-v1"
+_SUFFIX = ".aotx"
+
+# process-wide counters: the "did this run compile anything?" ledger that
+# tests, bench records, and the telemetry counter track all read
+_lock = threading.Lock()
+_STATS_KEYS = ("hits", "misses", "stores", "lowers", "compiles",
+               "corrupt", "errors", "compile_s", "load_s")
+_stats: Dict[str, float] = {k: 0 for k in _STATS_KEYS}
+_cache_singleton: Dict[str, Any] = {}
+
+
+def _bump(key: str, amount: float = 1) -> None:
+    from . import telemetry
+    with _lock:
+        _stats[key] += amount
+        snap = (_stats["hits"], _stats["misses"], _stats["stores"])
+    if key in ("hits", "misses", "stores"):
+        telemetry.counter("aot", hits=snap[0], misses=snap[1],
+                          stores=snap[2])
+
+
+def stats() -> Dict[str, float]:
+    """Snapshot of the process-wide cache counters (hits/misses/stores/
+    lowers/compiles/corrupt/errors + cumulative compile_s/load_s)."""
+    with _lock:
+        return dict(_stats)
+
+
+def reset() -> None:
+    """Zero the counters and drop the cache singleton (tests)."""
+    with _lock:
+        for k in _STATS_KEYS:
+            _stats[k] = 0
+        _cache_singleton.clear()
+
+
+def cache_dir() -> Optional[str]:
+    """The configured cache directory, or None when disabled."""
+    from . import config
+    d = config.get_str("AOT_CACHE", "").strip()
+    if not d or d == "0":
+        return None
+    return d
+
+
+def enabled() -> bool:
+    """True when a cache dir is configured AND this is a single-process
+    run (serialized SPMD executables embed the global topology; multi-host
+    replay is disabled by design — the XLA persistent cache still warms
+    those)."""
+    if cache_dir() is None:
+        return False
+    try:
+        import jax
+        return jax.process_count() == 1
+    except Exception:  # noqa: BLE001 — backend not up yet
+        return False
+
+
+def get_cache() -> Optional["AOTCache"]:
+    """The process AOTCache for the configured dir (singleton per dir)."""
+    d = cache_dir()
+    if d is None or not enabled():
+        return None
+    cache = _cache_singleton.get(d)
+    if cache is None:
+        cache = AOTCache(d)
+        _cache_singleton.clear()
+        _cache_singleton[d] = cache
+    return cache
+
+
+# ----------------------------------------------------------------------
+# fingerprinting
+# ----------------------------------------------------------------------
+
+def fingerprint(fields: Dict[str, Any]) -> str:
+    """Stable sha256 over a canonical-JSON rendering of the key fields."""
+    blob = json.dumps(fields, sort_keys=True, default=str,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def base_fingerprint(mesh=None) -> Dict[str, Any]:
+    """The environment half of every key: versions, backend, device kind,
+    topology, mesh, and the free-form cache tag."""
+    import jax
+    import jaxlib
+
+    from . import config
+    dev = jax.devices()[0]
+    fields = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "bigdl_tpu": _pkg_version(),
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "n_devices": len(jax.devices()),
+        "processes": jax.process_count(),
+        "tag": config.get_str("AOT_CACHE_TAG", ""),
+    }
+    if mesh is not None:
+        fields["mesh"] = {"shape": dict(mesh.shape),
+                          "axes": list(mesh.axis_names)}
+    return fields
+
+
+def _pkg_version() -> str:
+    try:
+        import bigdl_tpu
+        return getattr(bigdl_tpu, "__version__", "0")
+    except Exception:  # noqa: BLE001
+        return "0"
+
+
+def aval_fingerprint(tree) -> list:
+    """Flattened (shape, dtype, sharding) triples for an arg pytree —
+    concrete arrays, ShapeDtypeStructs and avals all work; no tracing."""
+    import jax
+    out = []
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        sh = getattr(leaf, "sharding", None)
+        spec = str(getattr(sh, "spec", "")) if sh is not None else ""
+        out.append([list(shape), dtype, spec])
+    return out
+
+
+def module_fingerprint(module) -> str:
+    """Structural hash of an nn.Module tree: class names + primitive
+    config attributes + children, recursively.  Deliberately excludes the
+    uid-bearing ``name`` and all array state (weights enter the key via
+    :func:`aval_fingerprint` of the placed params).  No tracing, no
+    lowering — this is what lets a warm serve ladder skip lowering
+    entirely."""
+    _VOLATILE = {"name", "params", "state", "grads", "output", "grad_input",
+                 "_last_rng", "modules", "weight_initializer",
+                 "bias_initializer", "training_mode"}
+
+    def walk(m):
+        d: Dict[str, Any] = {
+            "cls": f"{type(m).__module__}.{type(m).__qualname__}"}
+        attrs = {}
+        for k, v in sorted(vars(m).items()):
+            if k in _VOLATILE:
+                continue
+            if isinstance(v, (bool, int, float, str, type(None))):
+                attrs[k] = v
+            elif isinstance(v, (tuple, list)) and all(
+                    isinstance(x, (bool, int, float, str, type(None)))
+                    for x in v):
+                attrs[k] = list(v)
+        if attrs:
+            d["attrs"] = attrs
+        children = getattr(m, "modules", None)
+        if isinstance(children, (list, tuple)) and children:
+            d["children"] = [walk(c) for c in children]
+        return d
+
+    return fingerprint(walk(module))
+
+
+def hlo_hash(lowered) -> str:
+    """sha256 of the lowered StableHLO text — the strongest possible key
+    component: any change to the computation (model edit, donation,
+    sharding, env-dependent lowering like the tiny-channel conv pad)
+    changes it."""
+    return hashlib.sha256(lowered.as_text().encode()).hexdigest()
+
+
+# ----------------------------------------------------------------------
+# the cache proper
+# ----------------------------------------------------------------------
+
+class AOTCache:
+    """One cache directory of CRC-framed serialized executables.
+
+    All IO goes through :mod:`.file_io` (local / ``memory://`` / fsspec,
+    retried remote writes) and every entry carries the PR-1 integrity
+    frame; a CRC mismatch or a deserialize failure quarantines the entry
+    (``*.corrupt``) and reports a miss — the caller recompiles and the
+    fresh store overwrites nothing (new entries are written to a temp name
+    and renamed into place)."""
+
+    def __init__(self, root: str):
+        from . import file_io
+        self.root = file_io._strip_file_scheme(str(root))
+        self._fs = file_io.get_filesystem(self.root)
+        try:
+            self._fs.makedirs(self.root)
+        except Exception:  # noqa: BLE001 — unwritable root = every op misses
+            logger.warning("aot: cache dir %s not creatable", self.root)
+
+    def _path(self, key: str) -> str:
+        from . import file_io
+        return file_io._join(self.root, key + _SUFFIX)
+
+    def load(self, key: str):
+        """Deserialize the executable stored under ``key``; None on miss.
+        Corrupt/stale entries are quarantined and count as misses."""
+        from . import file_io, telemetry
+        path = self._path(key)
+        t0 = time.perf_counter()
+        with telemetry.span("aot.load", cat="aot", key=key[:16]):
+            try:
+                if not self._fs.exists(path):
+                    _bump("misses")
+                    return None
+            except Exception as e:  # noqa: BLE001 — cache must never raise
+                logger.warning("aot: exists(%s) failed: %s", path, e)
+                _bump("errors")
+                _bump("misses")
+                return None
+            try:
+                entry = file_io.load(path)
+                if not (isinstance(entry, dict)
+                        and entry.get("format") == _FORMAT):
+                    raise ValueError(f"not a {_FORMAT} entry")
+                from jax.experimental.serialize_executable import \
+                    deserialize_and_load
+                compiled = deserialize_and_load(
+                    entry["exe"], entry["in_tree"], entry["out_tree"])
+            except Exception as e:  # noqa: BLE001 — corrupt OR stale
+                # (CRC mismatch, truncated pickle, executable rejected by
+                # this jaxlib): quarantine so the next process does not
+                # trip over it again, then silently recompile
+                self._quarantine(path, e)
+                _bump("corrupt")
+                _bump("misses")
+                return None
+        _bump("load_s", time.perf_counter() - t0)
+        _bump("hits")
+        return compiled
+
+    def store(self, key: str, compiled, meta: Optional[dict] = None) -> bool:
+        """Serialize + frame + write ``compiled`` under ``key`` (temp name
+        then rename: concurrent writers race benignly).  Returns False —
+        never raises — when the executable does not support serialization
+        or the write fails."""
+        from . import file_io, telemetry
+        path = self._path(key)
+        with telemetry.span("aot.store", cat="aot", key=key[:16]):
+            try:
+                from jax.experimental.serialize_executable import serialize
+                exe, in_tree, out_tree = serialize(compiled)
+                entry = {"format": _FORMAT, "exe": exe, "in_tree": in_tree,
+                         "out_tree": out_tree, "meta": meta or {}}
+                tmp = f"{path}.tmp.{_token()}"
+                file_io.save(entry, tmp)
+                try:
+                    self._fs.rename(tmp, path)
+                except Exception:  # noqa: BLE001 — loser of a store race
+                    try:
+                        self._fs.remove(tmp)
+                    except Exception:  # noqa: BLE001
+                        pass
+            except Exception as e:  # noqa: BLE001 — cache must never raise
+                logger.warning("aot: store(%s) failed: %s: %s", key[:16],
+                               type(e).__name__, e)
+                _bump("errors")
+                return False
+        _bump("stores")
+        return True
+
+    def _quarantine(self, path: str, err: Exception) -> None:
+        logger.warning("aot: quarantining %s (%s: %s); recompiling", path,
+                       type(err).__name__, err)
+        try:
+            self._fs.rename(path, path + ".corrupt")
+        except Exception:  # noqa: BLE001 — e.g. a concurrent quarantine
+            try:
+                self._fs.remove(path)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def entries(self) -> list:
+        """Keys currently stored (diagnostics/tests)."""
+        try:
+            return sorted(n[:-len(_SUFFIX)] for n in
+                          self._fs.listdir(self.root)
+                          if n.endswith(_SUFFIX))
+        except Exception:  # noqa: BLE001
+            return []
+
+
+def _token() -> str:
+    import os
+    return f"{os.getpid()}.{threading.get_ident()}"
+
+
+# ----------------------------------------------------------------------
+# the two compile-site entry points
+# ----------------------------------------------------------------------
+
+def _compile_timed(lowered, label: str):
+    from . import telemetry
+    t0 = time.perf_counter()
+    with telemetry.span("compile", cat="aot", label=label):
+        compiled = lowered.compile()
+    _bump("compiles")
+    _bump("compile_s", time.perf_counter() - t0)
+    return compiled
+
+
+def cached_compile(lowered, *, label: str, mesh=None,
+                   example_args=None, extra: Optional[dict] = None):
+    """HLO-hash-keyed compile of an already-lowered computation (the train
+    step / bench path: tracing+lowering is cheap, the XLA compile is the
+    800s part).  Cache disabled -> plain ``lowered.compile()``."""
+    _bump("lowers")
+    cache = get_cache()
+    if cache is None:
+        return _compile_timed(lowered, label)
+    fields = dict(base_fingerprint(mesh))
+    fields["label"] = label
+    fields["hlo"] = hlo_hash(lowered)
+    if example_args is not None:
+        fields["args"] = aval_fingerprint(example_args)
+    if extra:
+        fields.update(extra)
+    key = fingerprint(fields)
+    compiled = cache.load(key)
+    if compiled is not None:
+        logger.info("aot: %s warm-started from cache (%s)", label, key[:16])
+        return compiled
+    compiled = _compile_timed(lowered, label)
+    cache.store(key, compiled, meta={"label": label,
+                                     "fields": _meta_fields(fields)})
+    return compiled
+
+
+def get_or_compile(key_fields: Dict[str, Any], lower_fn: Callable[[], Any],
+                   *, label: str):
+    """Logical-key lookup that skips lowering entirely on a hit (the serve
+    bucket-ladder path: ``key_fields`` must identify the computation
+    without tracing — module fingerprint + avals + base fingerprint).
+    On miss, ``lower_fn()`` is invoked once and the compile is stored."""
+    cache = get_cache()
+    if cache is None:
+        _bump("lowers")
+        return _compile_timed(lower_fn(), label)
+    fields = dict(key_fields)
+    fields["label"] = label
+    key = fingerprint(fields)
+    compiled = cache.load(key)
+    if compiled is not None:
+        logger.info("aot: %s warm-started from cache (%s)", label, key[:16])
+        return compiled
+    _bump("lowers")
+    compiled = _compile_timed(lower_fn(), label)
+    cache.store(key, compiled, meta={"label": label,
+                                     "fields": _meta_fields(fields)})
+    return compiled
+
+
+def _meta_fields(fields: Dict[str, Any]) -> Dict[str, Any]:
+    """Human-inspectable copy of the key fields for the entry's meta
+    (avals can be long; everything else is small and invaluable when
+    debugging why a key missed)."""
+    out = {k: v for k, v in fields.items() if k != "args"}
+    out["n_args"] = len(fields.get("args", []))
+    return out

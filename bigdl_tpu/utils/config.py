@@ -49,6 +49,9 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_SERVE_REPLICAS | (net-new: replica worker threads draining the shared serve queue) | 1 |
 | BIGDL_TPU_SERVE_DEADLINE_MS | (net-new: default per-request deadline; expired queued requests shed with RequestTimeout; 0 = none) | 0 |
 | BIGDL_TPU_SERVE_STALL_SECONDS | (net-new: per-replica supervision deadline — a wedged replica trips a stall + crash report; 0 = unwatched) | 0 |
+| BIGDL_TPU_AOT_CACHE | (net-new: AOT executable-cache dir, utils/aot.py — serialized compiled executables; warm start = cache read, zero XLA compiles; empty/0 = off) | off |
+| BIGDL_TPU_AOT_CACHE_TAG | (net-new: free-form AOT fingerprint salt; bump to invalidate every entry at once) | "" |
+| BIGDL_TPU_PEAK_FLOPS | (net-new: per-device MFU denominator override, FLOP/s — utils/flops.device_peak_flops; default TPU table / 1e12 CPU-nominal) | 0 (auto) |
 """
 
 from __future__ import annotations

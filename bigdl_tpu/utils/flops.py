@@ -23,7 +23,43 @@ import math
 
 import jax
 
-__all__ = ["jaxpr_flops", "fn_flops"]
+__all__ = ["jaxpr_flops", "fn_flops", "device_peak_flops",
+           "CPU_NOMINAL_PEAK"]
+
+# bf16 peak FLOP/s per *jax device* (v2/v3 devices are single cores) —
+# the MFU denominator bench.py and the Optimizer's per-step mfu counter
+# share.  Ordering matters: "v5p" must match before "v5" (lite/e).
+_TPU_PEAK_BF16 = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite / v5e
+    ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12),
+)
+
+# Nominal CPU denominator: there is no honest single peak for a shared
+# host CPU, but a FIXED nominal one still makes the per-step mfu counter
+# a usable *regression* signal in CPU traces (the absolute value is
+# meaningless; the trend is not).  Override with BIGDL_TPU_PEAK_FLOPS.
+CPU_NOMINAL_PEAK = 1e12
+
+
+def device_peak_flops(device=None):
+    """(peak_flops, source) for the MFU denominator.
+
+    source is ``"env"`` (BIGDL_TPU_PEAK_FLOPS override), ``"table"`` (TPU
+    device-kind match), or ``"nominal"`` (CPU/unknown fallback,
+    :data:`CPU_NOMINAL_PEAK`).  Callers that refuse to report MFU against
+    a made-up denominator (bench.py) gate on ``source != "nominal"``."""
+    from . import config
+    env = config.get_float("PEAK_FLOPS", 0.0)
+    if env > 0:
+        return env, "env"
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" in kind or "tpu" in getattr(device, "platform", ""):
+        for key, val in _TPU_PEAK_BF16:
+            if key in kind:
+                return val, "table"
+    return CPU_NOMINAL_PEAK, "nominal"
 
 
 def _prod(xs):

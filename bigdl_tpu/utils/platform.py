@@ -28,6 +28,12 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     set BIGDL_TPU_XLA_CACHE=0 to disable.  Returns the cache dir in use, or
     None when disabled/unavailable (backend already initialized with a
     different cache config is fine — jax applies this lazily per compile).
+
+    Layering note: this warms the XLA *compiler* per jit function; the AOT
+    executable cache (utils/aot.py, BIGDL_TPU_AOT_CACHE) sits one level
+    above and skips compilation entirely for whole cached executables.
+    They compose — an AOT miss still compiles through this cache — and
+    either can be disabled independently.
     """
     import os
 
@@ -44,21 +50,55 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
         return None
     import jax
 
-    # thresholds first, each individually guarded (an older jax missing one
-    # knob should not forfeit the cache — it just keeps its own default);
-    # cache everything: even sub-second entries save tunnel round-trips,
-    # and the pathological compiles are exactly the ones worth keeping
-    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
-                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+    # Feature-detect every knob instead of assuming this jax version has
+    # it (the config-option set drifts release to release: the threshold
+    # knobs appeared mid-0.4.x, `jax_enable_compilation_cache` later) —
+    # an older/newer jax missing one knob should not forfeit the cache,
+    # it just keeps that knob's own default.
+    def _maybe(knob, val):
+        if not _has_config_option(jax, knob):
+            return False
         try:
             jax.config.update(knob, val)
-        except Exception:  # noqa: BLE001 — unknown option on older jax
-            pass
-    try:
-        jax.config.update("jax_compilation_cache_dir", path)
-    except Exception:  # noqa: BLE001 — cache genuinely unavailable
+            return True
+        except Exception:  # noqa: BLE001 — present but rejects the value
+            return False
+
+    # cache everything: even sub-second entries save tunnel round-trips,
+    # and the pathological compiles are exactly the ones worth keeping
+    _maybe("jax_enable_compilation_cache", True)
+    _maybe("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _maybe("jax_persistent_cache_min_entry_size_bytes", 0)
+    if not _maybe("jax_compilation_cache_dir", path):
+        # the dir knob is the one that actually arms the cache — without
+        # it there is no persistent cache on this jax
         return None
+    # jax latches its cache object the first time any compile consults it
+    # (compilation_cache._cache_initialized): a process that already
+    # compiled something with NO dir configured would silently ignore this
+    # call forever.  Feature-detect the reset hook and get back to a
+    # pristine state so the new dir takes effect mid-process too.
+    try:
+        from jax._src import compilation_cache as _cc
+        if getattr(_cc, "_cache_initialized", False) and \
+                hasattr(_cc, "reset_cache"):
+            current = getattr(getattr(_cc, "_cache", None), "_path", None)
+            if str(current) != path:
+                _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private surface; absence is fine
+        pass
     return path
+
+
+def _has_config_option(jax_mod, knob: str) -> bool:
+    """True when this jax build knows `knob` (checked against the config
+    registry when available, falling back to attribute presence)."""
+    values = getattr(jax_mod.config, "_value_holders", None)
+    if values is None:
+        values = getattr(jax_mod.config, "values", None)
+    if isinstance(values, dict):
+        return knob in values
+    return hasattr(jax_mod.config, knob)
 
 
 def force_cpu(n_devices: Optional[int] = None) -> bool:

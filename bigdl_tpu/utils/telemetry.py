@@ -475,7 +475,24 @@ def phase_breakdown(merged: dict) -> dict:
     for e in merged.get("traceEvents", []):
         if e.get("ph") == "i":
             instants[e["name"]] = instants.get(e["name"], 0) + 1
-    return {"phases": phases, "ranks": ranks,
+    # counter tracks ("C" events): per track.series — count/mean/max/last.
+    # This is where the optimizer's per-step mfu and the aot hit/miss
+    # ledger become part of the printed report (regressions show up in
+    # `trace_report` output, not just inside Perfetto).
+    counter_vals: Dict[str, List[float]] = {}
+    for e in merged.get("traceEvents", []):
+        if e.get("ph") == "C":
+            for k, v in (e.get("args") or {}).items():
+                counter_vals.setdefault(f"{e['name']}.{k}", []).append(
+                    float(v))
+    counters = {}
+    for name in sorted(counter_vals):
+        vals = counter_vals[name]
+        counters[name] = {"count": len(vals),
+                          "mean": round(sum(vals) / len(vals), 6),
+                          "max": round(max(vals), 6),
+                          "last": round(vals[-1], 6)}
+    return {"phases": phases, "ranks": ranks, "counters": counters,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -513,6 +530,12 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
                          "median rank")
     else:
         lines.append("stragglers: none")
+    if breakdown.get("counters"):
+        lines.append(f"{'counter':<28}{'count':>8}{'mean':>14}{'max':>14}"
+                     f"{'last':>14}")
+        for name, st in breakdown["counters"].items():
+            lines.append(f"{name:<28}{st['count']:>8}{st['mean']:>14.6g}"
+                         f"{st['max']:>14.6g}{st['last']:>14.6g}")
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

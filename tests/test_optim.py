@@ -308,7 +308,12 @@ def test_ema_through_optimizer_training():
     set_seed(0)  # order-independent: the model init draws from the global
     # RNG stream, and convergence at 3 epochs depends on the draw
     model, opt = make_optimizer()
-    opt.set_optim_method(EMA(Adam(learning_rate=1e-3), decay=0.98))
+    # decay=0.9: at 3 epochs x 8 steps the shadow still lags the live
+    # weights (the "differs" assertion below) but carries < 0.9^24 ~ 8%
+    # of the random init.  The previous 0.98 left ~62% init weight in the
+    # shadow, putting the accuracy bound at the mercy of jax-version
+    # numeric drift (0.80 passed on jax<=0.4.30, 0.77 on 0.4.37).
+    opt.set_optim_method(EMA(Adam(learning_rate=1e-3), decay=0.9))
     opt.optimize()
     live = jax.tree.leaves(model.params)
     shadow = jax.tree.leaves(

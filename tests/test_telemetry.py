@@ -203,13 +203,16 @@ def test_traced_lenet_run_has_phase_spans_and_counters(tmp_path,
         assert bd["phases"][phase]["count"] >= 1, bd["phases"]
     assert bd["phases"]["step"]["count"] == 5
     assert 0.0 <= bd["data_wait_fraction"] <= 1.0
-    # per-step counter track with the four series
+    # per-step counter track: the four loop series plus the per-step MFU
+    # pair (armed because tracing is on — utils/flops.device_peak_flops
+    # always yields a denominator, nominal on CPU)
     ctr = [e for e in merged["traceEvents"]
            if e["ph"] == "C" and e["name"] == "train"]
     assert len(ctr) == 5
     assert set(ctr[0]["args"]) == {"data_wait_s", "step_s",
                                    "records_per_sec",
-                                   "prefetch_queue_depth"}
+                                   "prefetch_queue_depth",
+                                   "mfu", "model_flops_per_step"}
     # the prefetch worker produced on its own named thread track
     spans = [e for e in merged["traceEvents"]
              if e["ph"] == "X" and e["name"] == "prefetch.item"]

@@ -15,6 +15,14 @@ Prints one JSON line: wall seconds for the first optimizer iteration
 (compile-dominated: the step itself is milliseconds) plus the knob state,
 so the A/B is self-describing.  `--platform cpu` dry-runs the same code
 path off-TPU (the runbook's smoke mode).
+
+`--aot-cache DIR` switches to the AOT executable-cache A/B
+(utils/aot.py): the SAME training run twice in one process against DIR —
+cold (compile + store) then warm (jit caches cleared, executable
+deserialized from DIR) — emitting one JSON line with `compile_s_cold` /
+`compile_s_warm` (time spent compiling + loading, from the aot counters)
+and the hit/miss ledger.  The XLA persistent cache is disabled in this
+mode so the warm number is attributable to the AOT layer alone.
 """
 
 from __future__ import annotations
@@ -32,23 +40,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--platform", default=None,
-                    help="force a jax platform (e.g. cpu) for smoke runs")
-    ap.add_argument("--batch-size", type=int, default=256)
-    args = ap.parse_args(argv)
-
-    if args.platform:
-        import jax
-        try:
-            jax.config.update("jax_platforms", args.platform)
-        except RuntimeError:
-            pass
-    from bigdl_tpu.utils.platform import enable_compilation_cache
-    cache_dir = enable_compilation_cache()
-
-    import jax
+def _make_run(batch_size):
     import numpy as np
 
     import bigdl_tpu.nn as nn
@@ -57,23 +49,103 @@ def main(argv=None):
     from bigdl_tpu.optim import Optimizer, SGD, Trigger
 
     rng = np.random.default_rng(0)
-    n = args.batch_size
+    n = batch_size
     xs = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
     ys = rng.integers(0, 10, size=n)
-    ds = DataSet.array(
-        [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]).transform(
-        SampleToMiniBatch(n, drop_last=True))
-    opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
-           .set_optim_method(SGD(learning_rate=0.01))
-           .set_end_when(Trigger.max_iteration(1)))
 
-    t0 = time.perf_counter()
-    opt.optimize()  # one iteration: cold compile + one step
-    dt = time.perf_counter() - t0
+    def run():
+        """One fresh optimizer, one iteration: cold compile + one step.
+        Returns wall seconds."""
+        ds = DataSet.array(
+            [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]).transform(
+            SampleToMiniBatch(n, drop_last=True))
+        opt = (Optimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learning_rate=0.01))
+               .set_end_when(Trigger.max_iteration(1)))
+        t0 = time.perf_counter()
+        opt.optimize()
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _aot_mode(args):
+    """Cold-vs-warm A/B against the AOT executable cache: one JSON line."""
+    # attribute the warm number to the AOT layer alone — no XLA disk cache
+    os.environ["BIGDL_TPU_AOT_CACHE"] = args.aot_cache
+    os.environ.setdefault("BIGDL_TPU_XLA_CACHE", "0")
+
+    import jax
+
+    from bigdl_tpu.utils import aot
+
+    run = _make_run(args.batch_size)
+
+    def compile_cost(before, after):
+        # XLA compile time + executable-deserialize time: the "how long
+        # until the step is runnable" number the acceptance bound reads
+        return (after["compile_s"] - before["compile_s"] +
+                after["load_s"] - before["load_s"])
+
+    s0 = aot.stats()
+    wall_cold = run()
+    s1 = aot.stats()
+    # drop every in-memory jit/pjit cache so the second run re-lowers and
+    # must go through the persistent AOT cache, as a fresh process would
+    jax.clear_caches()
+    wall_warm = run()
+    s2 = aot.stats()
+
+    cold = compile_cost(s0, s1)
+    warm = compile_cost(s1, s2)
+    print(json.dumps({
+        "metric": "lenet_aot_cold_warm",
+        "compile_s_cold": round(cold, 3),
+        "compile_s_warm": round(warm, 3),
+        "warm_over_cold": round(warm / max(cold, 1e-9), 4),
+        "wall_s_cold": round(wall_cold, 3),
+        "wall_s_warm": round(wall_warm, 3),
+        "aot": {k: (int(v) if k not in ("compile_s", "load_s")
+                    else round(v, 3)) for k, v in s2.items()},
+        "batch_size": args.batch_size,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "aot_cache_dir": args.aot_cache,
+    }))
+    # acceptance bound (ISSUE 6): warm must be < 20% of cold
+    return 0 if warm < 0.2 * cold else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) for smoke runs")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--aot-cache", metavar="DIR", default=None,
+                    help="AOT executable-cache mode: run cold then warm "
+                         "against DIR, emit compile_s_cold/compile_s_warm; "
+                         "exit 1 unless warm < 20%% of cold")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+    if args.aot_cache:
+        return _aot_mode(args)
+    from bigdl_tpu.utils.platform import enable_compilation_cache
+    cache_dir = enable_compilation_cache()
+
+    import jax
+
+    run = _make_run(args.batch_size)
+    dt = run()
     print(json.dumps({
         "metric": "lenet_cold_compile_seconds",
         "value": round(dt, 3),
-        "batch_size": n,
+        "batch_size": args.batch_size,
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "conv_pad_min_cin": os.environ.get("BIGDL_TPU_CONV_PAD_MIN_CIN",

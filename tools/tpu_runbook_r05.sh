@@ -130,6 +130,21 @@ if [ "$SMOKE" = 1 ]; then
   timeout 420 python bench.py --serve --platform cpu \
     > /tmp/bench_serve.json 2>/tmp/bench_serve.log
   echo "[runbook] bench --serve rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+
+  # AOT executable-cache smoke (cpu only): the lenet train step cold
+  # (compile + store) vs warm (deserialize from the cache, jit caches
+  # cleared) against a fresh dir — the tool exits non-zero unless
+  # warm < 20% of cold, the ISSUE-6 acceptance bound
+  echo "[runbook] 2g/4 AOT executable-cache smoke (cold vs warm)" >> "$LOG"
+  rm -rf /tmp/r05_aot
+  timeout 300 python tools/lenet_cold.py --platform cpu --batch-size 64 \
+    --aot-cache /tmp/r05_aot > /tmp/lenet_aot.json 2>/tmp/lenet_aot.log
+  AOT_RC=$?
+  if [ "$AOT_RC" = 0 ]; then
+    echo "[runbook] aot smoke OK (warm < 20% of cold) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] aot smoke FAILED rc=$AOT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -157,7 +172,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
