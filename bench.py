@@ -442,6 +442,24 @@ def _bench_config(name, build, peak_flops):
     from bigdl_tpu.utils.timing import measure_step_seconds
     dt, timing = measure_step_seconds(
         run, log=lambda m: _log(f"{name}: {m}"), progress=_beat)
+    # step-arithmetic attribution: the fused/bucket knobs the step was
+    # traced with, plus the standalone (unoverlapped) gradient-wire
+    # collective cost — 0.0 on this 1-chip mesh, measured on pod meshes —
+    # so the MFU trajectory can attribute wins to the right knob
+    from bigdl_tpu.parallel import wire as _wire
+    try:
+        collective_s = _wire.measure_collective_seconds(
+            mesh, params, policy.wire_dtype)
+    except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
+        _log(f"{name}: collective probe failed: {type(e).__name__}: {e}")
+        collective_s = None
+    step_arith = {
+        "step_knobs": dict(opt._step_knobs),
+        "collective_s": (None if collective_s is None
+                         else round(collective_s, 6)),
+        "collective_fraction": (None if collective_s is None
+                                else round(min(1.0, collective_s / dt), 4)),
+    }
     _beat(f"e2e:{name}")
     try:
         e2e = _bench_e2e(name, compiled, box, inp, tgt, data_sh,
@@ -453,7 +471,7 @@ def _bench_config(name, build, peak_flops):
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
-                        aot_cache=aot_rec, **e2e)
+                        aot_cache=aot_rec, **step_arith, **e2e)
 
 
 def _bench_resnet50_bf16_autotune(name, build, peak_flops):
@@ -790,6 +808,13 @@ def main(argv=None):
                          "replica pool) on the LeNet forward — reports "
                          "requests/s, latency p50/p95/p99, batch fill and "
                          "shed rate as ONE JSON line")
+    ap.add_argument("--fused", action="store_true",
+                    help="arm the fused train-step arithmetic for this "
+                         "run: multi-tensor optimizer update "
+                         "(BIGDL_TPU_FUSED_UPDATE=1) and the bucketed "
+                         "bf16 gradient wire (BIGDL_TPU_WIRE_BUCKET_MB=4 "
+                         "unless already set) — per-config records carry "
+                         "the knobs in step_knobs either way")
     ap.add_argument("--serve-clients", type=int, default=8,
                     help="--serve closed-loop concurrent clients")
     ap.add_argument("--serve-requests", type=int, default=200,
@@ -851,6 +876,18 @@ def main(argv=None):
         from bigdl_tpu.utils import chaos as _chaos
         _chaos.install(args.chaos)
         _log(f"chaos schedules installed: {args.chaos}")
+    if args.fused:
+        os.environ["BIGDL_TPU_FUSED_UPDATE"] = "1"
+        os.environ.setdefault("BIGDL_TPU_WIRE_BUCKET_MB", "4")
+        _log("fused step arithmetic armed: FUSED_UPDATE=1, "
+             f"WIRE_BUCKET_MB={os.environ['BIGDL_TPU_WIRE_BUCKET_MB']}")
+    # collective-overlap XLA flags (latency-hiding scheduler + async
+    # collectives): must be in LIBTPU_INIT_ARGS before backend init; inert
+    # on CPU (utils/platform.py; BIGDL_TPU_OVERLAP_FLAGS=0 disables)
+    from bigdl_tpu.utils.platform import enable_overlap_flags
+    overlap = enable_overlap_flags()
+    if overlap:
+        _log(f"LIBTPU_INIT_ARGS: {overlap}")
     # persistent XLA cache: warm compiles across processes — the difference
     # between LeNet's pathological 800s+ compile fitting the budget or
     # stalling (utils/platform.py; BIGDL_TPU_XLA_CACHE=0 disables)

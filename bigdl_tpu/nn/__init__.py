@@ -54,4 +54,4 @@ from .criterion import (
     SmoothL1CriterionWithWeights, SoftMarginCriterion, SoftmaxWithCriterion,
     TimeDistributedCriterion)
 from .attention import MultiHeadAttention
-from .fused import ConvBN, fuse_conv_bn
+from .fused import ConvBN, ConvBNAddReLU, fuse_conv_bn

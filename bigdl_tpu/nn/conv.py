@@ -46,15 +46,49 @@ def _pad_tiny_cin(x, w, n_group):
     covers WIO/HWIO/DHWIO weights alike; every conv layer in this module calls
     it, including SpatialFullConvolution whose lhs-dilated *forward* is itself
     a gradient-conv-shaped program.
+
+    Grouped convs pad too: the weight's axis -2 is already per-group
+    (C_in/groups), and x's channel axis is padded per group block —
+    (..., G*cpg) reshaped to (..., G, cpg), zero-padded to (..., G,
+    min_cin), flattened back — so `feature_group_count` still divides and
+    each group contracts over its own (zero-extended) channels.
     """
     min_cin = _config.get_int("CONV_PAD_MIN_CIN", 8)
-    cin = w.shape[-2]
-    if n_group != 1 or min_cin <= 0 or cin >= min_cin:
+    cpg = w.shape[-2]  # per-group input channels (HWIO stores C_in/groups)
+    if min_cin <= 0 or cpg >= min_cin:
         return x, w
-    extra = min_cin - cin
-    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+    extra = min_cin - cpg
     w = jnp.pad(w, [(0, 0)] * (w.ndim - 2) + [(0, extra), (0, 0)])
+    if n_group == 1:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+    else:
+        shape = x.shape
+        x = x.reshape(shape[:-1] + (n_group, cpg))
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+        x = x.reshape(shape[:-1] + (n_group * min_cin,))
     return x, w
+
+
+def _conv_route(w, n_group, lhs_dilation=None) -> str:
+    """Per-shape lowering choice for tiny-C_in 2-D convs.
+
+    Returns 'lax' (no rewrite — C_in is wide enough or the mitigation is
+    off), 'pad' (zero-pad channels, the default mitigation), or 'matmul'
+    (the im2col reshaped-matmul route, ops/convmm.py — opt-in via
+    ``BIGDL_TPU_CONV_ROUTE=matmul``, which eliminates the pathological
+    grad-of-conv program instead of padding around it).  Grouped and
+    lhs-dilated convs always fall back to the pad: the matmul route covers
+    the single-group correlation shape only.
+    """
+    min_cin = _config.get_int("CONV_PAD_MIN_CIN", 8)
+    if min_cin <= 0 or w.shape[-2] >= min_cin:
+        return "lax"
+    mode = _config.get_str("CONV_ROUTE", "pad")
+    if mode == "matmul" and n_group == 1 and lhs_dilation is None:
+        return "matmul"
+    if mode in ("lax", "off", "0"):
+        return "lax"
+    return "pad"
 
 __all__ = ["SpatialConvolution", "SpatialDilatedConvolution",
            "SpatialFullConvolution", "TemporalConvolution",
@@ -113,16 +147,26 @@ class SpatialConvolution(Module):
             # output has the same size as input")
             padding = ("SAME" if pad_h == -1 or pad_w == -1
                        else [(pad_h, pad_h), (pad_w, pad_w)])
-        x, w = _pad_tiny_cin(x, w, self.n_group)
-        y = lax.conv_general_dilated(
-            x.astype(c), w.astype(c),
-            window_strides=self.stride,
-            padding=padding,
-            lhs_dilation=lhs_dilation,
-            rhs_dilation=rhs_dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.n_group,
-            preferred_element_type=conv_accum_dtype())
+        if _conv_route(w, self.n_group, lhs_dilation) == "matmul":
+            from ..ops.convmm import conv2d_matmul, same_pads
+            dil = tuple(rhs_dilation) if rhs_dilation else (1, 1)
+            if padding == "SAME":
+                padding = [same_pads(x.shape[1 + d],
+                                     (w.shape[d] - 1) * dil[d] + 1,
+                                     self.stride[d]) for d in range(2)]
+            y = conv2d_matmul(x.astype(c), w.astype(c), tuple(self.stride),
+                              tuple(tuple(p) for p in padding), dil)
+        else:
+            x, w = _pad_tiny_cin(x, w, self.n_group)
+            y = lax.conv_general_dilated(
+                x.astype(c), w.astype(c),
+                window_strides=self.stride,
+                padding=padding,
+                lhs_dilation=lhs_dilation,
+                rhs_dilation=rhs_dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.n_group,
+                preferred_element_type=conv_accum_dtype())
         # named so selective rematerialization (Optimizer.set_remat("conv_out"))
         # can save exactly the MXU outputs and recompute the cheap elementwise
         # tail (BN/ReLU/add) in the backward pass; a no-op otherwise

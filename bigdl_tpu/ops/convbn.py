@@ -38,7 +38,8 @@ from jax import lax
 from .batchnorm import (_bn_grad_stats_pallas, _global_n, _pad_cols,
                         _LANE)
 
-__all__ = ["matmul_stats", "matmul_stats_reference", "fused_conv_bn_train"]
+__all__ = ["matmul_stats", "matmul_stats_reference", "fused_conv_bn_train",
+           "fused_conv_bn_add_relu_train"]
 
 # MXU-friendly tile sizes.  The C block is wide (1024) because every
 # x-row tile must be re-streamed once per OUTPUT-channel block (each (r,k)
@@ -209,9 +210,11 @@ def _fused_fwd(x2, w2, bias, gamma, beta, eps, interpret, axis_name):
                            axis_name)
 
 
-def _fused_bwd(eps, interpret, axis_name, res, cotangents):
-    x2, w2, y, mean, inv, gamma, has_bias = res
-    dz, _, _ = cotangents  # stat cotangents ignored
+def _bn_matmul_bwd(interpret, axis_name, x2, w2, y, mean, inv, gamma,
+                   has_bias, dz):
+    """Shared backward of the (matmul -> train BN) core for a given BN-input
+    cotangent `dz`: grad-stat Pallas pass, elementwise dy, then two MXU
+    matmuls for dx/dw.  Returns (dx, dw, dbias, dgamma_local, dbeta_local)."""
     # grad-stat pass over (y, dz) — the same fused Pallas reduction the
     # standalone BN backward uses
     sdy_local, sdyx_local = _bn_grad_stats_pallas(
@@ -240,4 +243,85 @@ def _fused_bwd(eps, interpret, axis_name, res, cotangents):
             sdyx_local.astype(gamma.dtype), sdy_local.astype(gamma.dtype))
 
 
+def _fused_bwd(eps, interpret, axis_name, res, cotangents):
+    x2, w2, y, mean, inv, gamma, has_bias = res
+    dz, _, _ = cotangents  # stat cotangents ignored
+    return _bn_matmul_bwd(interpret, axis_name, x2, w2, y, mean, inv,
+                          gamma, has_bias, dz)
+
+
 fused_conv_bn_train.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused conv(1x1) + BN + residual-add + ReLU — the ResNet block tail
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def fused_conv_bn_add_relu_train(x2, w2, bias, gamma, beta, resid2, eps,
+                                 interpret=False, axis_name=None):
+    """z = relu(BN_train(x2 @ w2 (+bias)) + resid2); returns (z, mean, var).
+
+    The residual unit's whole tail — the branch's closing 1x1 conv, its BN,
+    the shortcut add, and the block ReLU (models/resnet.py `_residual`) —
+    behind ONE matmul: stats ride the matmul epilogue exactly as
+    `fused_conv_bn_train`, and the normalize/add/relu tail plus its
+    backward (relu mask recomputed from saved values, never stored) stay a
+    single elementwise fusion instead of three module boundaries each
+    re-reading the activation from HBM.  mean/var are the biased f32 batch
+    stats for the caller's EMA, non-differentiable like
+    `fused_conv_bn_train`'s.
+    """
+    out, _ = _fused_ar_fwd_impl(x2, w2, bias, gamma, beta, resid2, eps,
+                                interpret, axis_name)
+    return out
+
+
+def _bn_scale_shift(gamma, beta, mean, inv):
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    return scale, shift
+
+
+def _fused_ar_fwd_impl(x2, w2, bias, gamma, beta, resid2, eps, interpret,
+                       axis_name):
+    from jax.ad_checkpoint import checkpoint_name
+
+    y, s, ss = matmul_stats(x2, w2, bias, interpret=interpret)
+    y = checkpoint_name(y, "conv_out")
+    if axis_name is not None:
+        s = lax.psum(s, axis_name)
+        ss = lax.psum(ss, axis_name)
+    n = _global_n(x2.shape[0], axis_name)
+    mean = s / n
+    var = ss / n - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    scale, shift = _bn_scale_shift(gamma, beta, mean, inv)
+    pre = y * scale.astype(y.dtype) + shift.astype(y.dtype) + resid2
+    z = jnp.maximum(pre, 0).astype(y.dtype)
+    return (z, mean, var), (x2, w2, y, mean, inv, gamma, beta, resid2,
+                            bias is not None)
+
+
+def _fused_ar_fwd(x2, w2, bias, gamma, beta, resid2, eps, interpret,
+                  axis_name):
+    return _fused_ar_fwd_impl(x2, w2, bias, gamma, beta, resid2, eps,
+                              interpret, axis_name)
+
+
+def _fused_ar_bwd(eps, interpret, axis_name, res, cotangents):
+    x2, w2, y, mean, inv, gamma, beta, resid2, has_bias = res
+    dz, _, _ = cotangents  # stat cotangents ignored
+    # relu mask recomputed from the SAME expression the forward evaluated
+    # (bit-consistent gate, one x-sized save — resid2 — instead of storing
+    # the mask or pre-activation)
+    scale, shift = _bn_scale_shift(gamma, beta, mean, inv)
+    pre = y * scale.astype(y.dtype) + shift.astype(y.dtype) + resid2
+    dz_m = jnp.where(pre > 0, dz, jnp.zeros_like(dz))
+    dresid = dz_m.astype(resid2.dtype)
+    dx, dw, dbias, dgamma, dbeta = _bn_matmul_bwd(
+        interpret, axis_name, x2, w2, y, mean, inv, gamma, has_bias, dz_m)
+    return dx, dw, dbias, dgamma, dbeta, dresid
+
+
+fused_conv_bn_add_relu_train.defvjp(_fused_ar_fwd, _fused_ar_bwd)

@@ -1,0 +1,142 @@
+"""Reshaped-matmul (im2col) conv lowering for tiny-input-channel shapes.
+
+XLA's TPU backend compiles the *gradient* of convs whose C_in is far below
+the sublane granularity pathologically slowly — grad(conv) at
+(512,28,28,1)x(5,5,1,6) measured 809 s cold (docs/benchmarking.md).  The
+shipped mitigation zero-pads C_in (`nn/conv._pad_tiny_cin`), which fixes
+compile time by burning MXU work on dead channels.  This module is the
+reference's OTHER answer, ported natively: BigDL lowers exactly these
+shapes through explicit im2col + gemm (`nn/SpatialConvolution.scala:470-530`
+via `NNPrimitive.im2colFloat`), so the compiler never sees a conv at all.
+
+`conv2d_matmul` computes conv as patch-extraction (kh*kw strided slices,
+concatenated channel-wise) followed by ONE (N*Ho*Wo, kh*kw*C) x
+(kh*kw*C, C_out) matmul.  The custom VJP keeps the backward conv-free too:
+
+  - dw: recompute the patches (slices — cheap) and run one transposed
+    matmul; no grad-of-conv program exists to compile.
+  - dx: one matmul against w^T, then col2im — each tap's cotangent is
+    `lax.pad`-ed (interior padding = stride) back onto the input and
+    summed.  Pads and adds, nothing the TPU backend struggles with.
+
+Recomputing patches in the VJP (instead of saving them) bounds memory at
+one x-sized residual, like the lax route — patches are kh*kw times larger
+than x and would dominate HBM on 5x5 kernels.
+
+Numerics: values match `lax.conv_general_dilated` to float tolerance (the
+contraction is reassociated), with the same f32 accumulation
+(`preferred_element_type`).  Route selection lives in `nn/conv._conv_route`
+(``BIGDL_TPU_CONV_ROUTE=matmul``), applied per-shape: only sub-
+``BIGDL_TPU_CONV_PAD_MIN_CIN`` C_in convs take this path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import conv_accum_dtype
+
+__all__ = ["conv2d_matmul", "im2col", "same_pads"]
+
+
+def same_pads(in_size: int, k_eff: int, stride: int):
+    """XLA SAME padding for one spatial dim: output ceil(in/stride), extra
+    padding on the high side."""
+    out = -(-in_size // stride)
+    total = max((out - 1) * stride + k_eff - in_size, 0)
+    return (total // 2, total - total // 2)
+
+
+def im2col(x, kh: int, kw: int, strides, padding, dilation):
+    """Patch matrix of NHWC `x`: (N, Ho, Wo, kh*kw*C), channel blocks in
+    (i, j) tap order — matching `w.reshape(kh*kw*C, C_out)` of an HWIO
+    kernel.  Pure pads + strided slices: its transpose (what the VJP
+    needs) is pads + adds, never a conv."""
+    sh, sw = strides
+    dh, dw_ = dilation
+    (ph0, ph1), (pw0, pw1) = padding
+    n, h, w, c = x.shape
+    x = jnp.pad(x, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    hp, wp = h + ph0 + ph1, w + pw0 + pw1
+    eff_kh, eff_kw = (kh - 1) * dh + 1, (kw - 1) * dw_ + 1
+    ho = (hp - eff_kh) // sh + 1
+    wo = (wp - eff_kw) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            ii, jj = i * dh, j * dw_
+            cols.append(lax.slice(
+                x, (0, ii, jj, 0),
+                (n, ii + (ho - 1) * sh + 1, jj + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1)))
+    return jnp.concatenate(cols, axis=-1), ho, wo
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def conv2d_matmul(x, w, strides, padding, dilation):
+    """NHWC x HWIO conv as im2col + one matmul (see module docstring).
+
+    strides/dilation: (h, w) ints; padding: ((lo,hi),(lo,hi)) pairs.
+    Output dtype is the accumulation dtype (like the lax route's
+    `preferred_element_type` result) — callers cast back to compute."""
+    y, _ = _fwd_impl(x, w, strides, padding, dilation)
+    return y
+
+
+def _fwd_impl(x, w, strides, padding, dilation):
+    kh, kw, cin, cout = w.shape
+    patches, ho, wo = im2col(x, kh, kw, strides, padding, dilation)
+    n = x.shape[0]
+    acc = conv_accum_dtype()
+    y2 = jnp.dot(patches.reshape(n * ho * wo, kh * kw * cin),
+                 w.reshape(kh * kw * cin, cout),
+                 preferred_element_type=acc)
+    return y2.reshape(n, ho, wo, cout), (x, w)
+
+
+def _fwd(x, w, strides, padding, dilation):
+    return _fwd_impl(x, w, strides, padding, dilation)
+
+
+def _bwd(strides, padding, dilation, res, dy):
+    x, w = res
+    kh, kw, cin, cout = w.shape
+    sh, sw = strides
+    dh, dw_ = dilation
+    (ph0, ph1), (pw0, pw1) = padding
+    n, h, w_in, _ = x.shape
+    hp, wp = h + ph0 + ph1, w_in + pw0 + pw1
+    # recompute the patches: slices are cheap, and saving them would cost
+    # kh*kw times x's HBM footprint
+    patches, ho, wo = im2col(x, kh, kw, strides, padding, dilation)
+    m, k = n * ho * wo, kh * kw * cin
+    dy2 = dy.reshape(m, cout)
+    dw = jnp.dot(patches.reshape(m, k).T, dy2,
+                 preferred_element_type=jnp.float32)
+    # dx: cotangent of each tap's strided slice is an interior-padded
+    # (stride-spaced) embedding back into the padded input — sum the taps,
+    # then strip the conv padding
+    dcols = jnp.dot(dy2, w.reshape(k, cout).T,
+                    preferred_element_type=jnp.float32)
+    dcols = dcols.reshape(n, ho, wo, k)
+    dxp = jnp.zeros((n, hp, wp, cin), jnp.float32)
+    for i in range(kh):
+        for j in range(kw):
+            ii, jj = i * dh, j * dw_
+            tap = dcols[..., (i * kw + j) * cin:(i * kw + j + 1) * cin]
+            dxp = dxp + lax.pad(
+                tap.astype(jnp.float32), jnp.float32(0), (
+                    (0, 0, 0),
+                    (ii, hp - (ii + (ho - 1) * sh + 1), sh - 1),
+                    (jj, wp - (jj + (wo - 1) * sw + 1), sw - 1),
+                    (0, 0, 0)))
+    dx = lax.slice(dxp, (0, ph0, pw0, 0),
+                   (n, hp - ph1, wp - pw1, cin))
+    return dx.astype(x.dtype), dw.reshape(w.shape).astype(w.dtype)
+
+
+conv2d_matmul.defvjp(_fwd, _bwd)

@@ -30,6 +30,11 @@ __all__ = ["OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax",
 class OptimMethod:
     """Base optimizer (reference: optim/OptimMethod.scala:28)."""
 
+    #: multi-tensor fused update support (optim/fused.py): True for every
+    #: elementwise tree.map rule; False where state depends on the leaf
+    #: layout itself (L-BFGS ravels the pytree into its history vectors)
+    supports_fused = True
+
     def __init__(self, learning_rate: float = 1e-3):
         self.learning_rate = learning_rate
         # host-side driver state mirror (reference keeps these in `state: Table`)
@@ -41,6 +46,18 @@ class OptimMethod:
 
     def update(self, grads, params, state, lr):
         raise NotImplementedError
+
+    def update_fused(self, grads, params, state, lr, constraint=None):
+        """Multi-tensor update (optim/fused.py): the same `update` rule run
+        over dtype-homogeneous 1-D fused buffers — a handful of large
+        kernels instead of one per leaf, bit-identical results (the rules
+        are elementwise).  `constraint` shards the fused buffers (ZeRO).
+        Methods that cannot fuse (`supports_fused = False`) silently run
+        the per-leaf path, so callers can gate on the env knob alone."""
+        if not self.supports_fused:
+            return self.update(grads, params, state, lr)
+        from .fused import fused_update
+        return fused_update(self, grads, params, state, lr, constraint)
 
     # -- host-side ------------------------------------------------------
     def get_learning_rate(self, driver_state=None) -> float:
@@ -300,6 +317,10 @@ class LBFGS(OptimMethod):
     Operates on the flattened parameter vector (the reference's native format —
     getParameters contract, AbstractModule.scala:284).
     """
+
+    # the two-loop history ravels the param pytree itself: fusing would
+    # reorder prev_flat/s/y relative to an unfused run's checkpoints
+    supports_fused = False
 
     def __init__(self, learning_rate: float = 1.0, max_iter: int = 1,
                  history_size: int = 10, tolerance_grad: float = 1e-7):

@@ -304,6 +304,14 @@ class Optimizer:
         # denominator = device peak * mesh size (utils/flops.py)
         self._step_flops = None
         self._mfu_denom = None
+        # per-step collective-cost counter (armed with mfu): the measured
+        # standalone wall time of the gradient wire's all-reduce
+        # (parallel/wire.measure_collective_seconds) — traces show it next
+        # to step_s so overlap (or its absence) is visible
+        self._collective_s = None
+        # knobs the compiled step was built with (_build_step fills it;
+        # bench embeds it in the per-config record)
+        self._step_knobs = {}
         # straggler mitigation (reference: Optimizer.setDropModuleProperty,
         # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
         self.drop_percentage = 0.0
@@ -632,6 +640,28 @@ class Optimizer:
         clip_norm, clip_const = self.grad_clip_norm, self.grad_clip_const
         grad_scales = model._grad_scale_tree()  # layer-wise scaleW/scaleB
         from .regularizer import apply_regularizer_grads
+        from ..parallel import wire as wire_mod
+        from ..utils import config as _config
+
+        # fused-arithmetic knobs, baked in at trace time (a toggle rebuilds
+        # the step): BIGDL_TPU_FUSED_UPDATE runs the optimizer update over
+        # multi-tensor fused buffers (optim/fused.py);
+        # BIGDL_TPU_WIRE_BUCKET_MB buckets the bf16 gradient wire
+        # (parallel/wire.py).  Both default off = the per-leaf program,
+        # byte-for-byte.  Under ZeRO the fused buffers/buckets carry the
+        # strategy's sharding constraint so slices stay 1/N.
+        use_fused = _config.get_bool("FUSED_UPDATE", False) and \
+            getattr(optim, "supports_fused", True)
+        bucket_mb = wire_mod.wire_bucket_mb()
+        fused_spec = self.strategy.fused_buffer_spec(mesh)
+        if fused_spec is not None:
+            fused_sh = NamedSharding(mesh, fused_spec)
+            fused_constraint = (
+                lambda b: jax.lax.with_sharding_constraint(b, fused_sh))
+        else:
+            fused_constraint = None
+        self._step_knobs = {"fused_update": bool(use_fused),
+                            "wire_bucket_mb": bucket_mb}
 
         remat = self.remat_policy
 
@@ -688,10 +718,14 @@ class Optimizer:
                 grads = jax.tree.map(lambda g, s: g * s, grads, grad_scales)
             # bf16 wire: cross-chip gradient reduction happens on these values —
             # casting here makes the GSPMD all-reduce ride ICI at bf16, the
-            # reference's FP16CompressedTensor format
+            # reference's FP16CompressedTensor format.  Bucketed
+            # (BIGDL_TPU_WIRE_BUCKET_MB > 0) or per-leaf, the values are
+            # bit-identical; clipping below ALWAYS sees the wire-rounded
+            # grads (wire-before-clip, the reference's compress-then-
+            # aggregate order — docs/performance.md "Step arithmetic")
             if wire is not None:
-                grads = jax.tree.map(
-                    lambda g: g.astype(wire).astype(jnp.float32), grads)
+                grads = wire_mod.wire_cast(grads, wire, bucket_mb,
+                                           constraint=fused_constraint)
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree.map(lambda g: jnp.clip(g, lo, hi), grads)
@@ -700,7 +734,13 @@ class Optimizer:
                                      for g in jax.tree.leaves(grads)))
                 scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-12))
                 grads = jax.tree.map(lambda g: g * scale, grads)
-            new_params, new_opt_state = optim.update(grads, params, opt_state, lr)
+            if use_fused:
+                new_params, new_opt_state = optim.update_fused(
+                    grads, params, opt_state, lr,
+                    constraint=fused_constraint)
+            else:
+                new_params, new_opt_state = optim.update(grads, params,
+                                                         opt_state, lr)
             return new_params, new_net_state, new_opt_state, loss
 
         rep = NamedSharding(mesh, P())
@@ -828,6 +868,28 @@ class Optimizer:
             logger.info("mfu counter disarmed: %s: %s",
                         type(e).__name__, e)
 
+    def _arm_collective(self, mesh) -> None:
+        """One-shot arming of the ``train.collective_s`` counter (with
+        the mfu arm, only when telemetry is tracing): the measured
+        standalone wall cost of the gradient wire's all-reduce over the
+        data axis, at the current wire dtype and bucket layout.  0.0 on a
+        1-device axis; any failure disarms — diagnostics, never a
+        crash."""
+        from ..parallel import wire as wire_mod
+        self._collective_s = 0.0
+        try:
+            self._collective_s = wire_mod.measure_collective_seconds(
+                mesh, self.model.params, get_policy().wire_dtype)
+            if self._collective_s:
+                logger.info("collective counter armed: %.6fs standalone "
+                            "gradient all-reduce (wire=%s, bucket_mb=%s)",
+                            self._collective_s,
+                            get_policy().wire_dtype,
+                            self._step_knobs.get("wire_bucket_mb"))
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            logger.info("collective counter disarmed: %s: %s",
+                        type(e).__name__, e)
+
     # ------------------------------------------------------------------
     # the driver loop (reference: DistriOptimizer.scala:141-381)
     # ------------------------------------------------------------------
@@ -847,6 +909,7 @@ class Optimizer:
         # state may all have changed since the last optimize()
         self._step_flops = None
         self._mfu_denom = None
+        self._collective_s = None
         old_handlers = {}
         # armed from rank-consistent inputs ONLY (checkpoint_path and the
         # env knob must agree across ranks) — NOT from whether the signal
@@ -1219,6 +1282,8 @@ class Optimizer:
                     self._arm_mfu(step_fn, (params, net_state, opt_state,
                                             inp, tgt, jnp.float32(lr), rng),
                                   mesh)
+                if self._collective_s is None and telemetry.enabled():
+                    self._arm_collective(mesh)
                 params, net_state, opt_state, loss = step_fn(
                     params, net_state, opt_state, inp, tgt,
                     jnp.float32(lr), rng)
@@ -1267,6 +1332,14 @@ class Optimizer:
                     counters["mfu"] = (self._step_flops / max(step_dur, 1e-9)
                                        / self._mfu_denom)
                     counters["model_flops_per_step"] = self._step_flops
+                if self._collective_s is not None:
+                    # standalone (unoverlapped) wire cost beside the step
+                    # wall: when the scheduler hides the collective, step_s
+                    # stays ~compute while collective_fraction shows what
+                    # WOULD have been added serialized
+                    counters["collective_s"] = self._collective_s
+                    counters["collective_fraction"] = min(
+                        1.0, self._collective_s / max(step_dur, 1e-9))
                 telemetry.counter("train", **counters)
                 # per-parameter histograms when a "Parameters" trigger is set
                 # (reference: DistriOptimizer.saveSummary :426-456 — off by

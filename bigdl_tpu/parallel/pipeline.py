@@ -116,7 +116,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
         else None
     pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     xspec = P(batch)
-    fn = shard_map(
+    from ..utils.compat import has_vma_marking, shard_map_unchecked
+    # jax < 0.5: the GPipe cond branches mix replicated zeros with varying
+    # microbatches and there is no pvary/pcast to annotate them — the
+    # replication checker cannot be satisfied, so it runs unchecked there
+    wrap = shard_map if has_vma_marking() else shard_map_unchecked
+    fn = wrap(
         partial(_pipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
                 num_microbatches=num_microbatches, remat=remat,
                 vary_axes=(batch,) if batch else ()),

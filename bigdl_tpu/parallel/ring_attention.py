@@ -47,7 +47,12 @@ def _pvary(x, axes):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    # jax < 0.5 (e.g. 0.4.x): shard_map has no varying-manual-axes
+    # bookkeeping, so there is nothing to mark — the value is already
+    # usable on every device of the axis
+    return x
 
 
 def _pvary_like(x, ref):

@@ -41,6 +41,14 @@ class ShardingStrategy:
         # batch dim sharded over the data axis; everything else replicated
         return NamedSharding(mesh, P(tuple(axes) if axes else None))
 
+    def fused_buffer_spec(self, mesh: Mesh):
+        """PartitionSpec for the 1-D fused optimizer buffers
+        (optim/fused.py), or None to leave placement to GSPMD.  The base
+        strategies replicate params, so their fused buffers need no
+        constraint; ZeRO overrides this so the big fused buffers live in
+        1/N slices over 'data' like the per-leaf slots they replace."""
+        return None
+
     def opt_state_sharding(self, mesh: Mesh, opt_state, params,
                            param_shardings):
         """Shardings for the optimizer-state pytree: momentum/Adam slots are
@@ -99,6 +107,13 @@ class ShardedDataParallel(ShardingStrategy):
 
     def __init__(self, min_size: int = 2 ** 14):
         self.min_size = min_size
+
+    def fused_buffer_spec(self, mesh):
+        # fused update buffers shard over 'data' (uneven sizes are fine —
+        # GSPMD pads the last shard), keeping the ZeRO memory claim intact
+        if mesh.shape.get("data", 1) > 1:
+            return P("data")
+        return None
 
     def param_sharding(self, mesh, params):
         n = mesh.shape.get("data", 1)

@@ -16,6 +16,13 @@ except ImportError:  # older jax
 _CHECK_KW = ("check_vma" if "check_vma" in
              inspect.signature(shard_map).parameters else "check_rep")
 
+# jax < 0.5 has neither lax.pcast nor lax.pvary: a shard_map body that mixes
+# replicated and device-varying values (cond branches, ppermute rings) cannot
+# annotate its replication for the checker and must run unchecked there
+def has_vma_marking() -> bool:
+    import jax
+    return hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
 
 def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
     """shard_map with the replication/VMA check disabled, under whichever
@@ -25,4 +32,4 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
                      **{_CHECK_KW: False})
 
 
-__all__ = ["shard_map", "shard_map_unchecked"]
+__all__ = ["shard_map", "shard_map_unchecked", "has_vma_marking"]

@@ -52,6 +52,10 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_AOT_CACHE | (net-new: AOT executable-cache dir, utils/aot.py — serialized compiled executables; warm start = cache read, zero XLA compiles; empty/0 = off) | off |
 | BIGDL_TPU_AOT_CACHE_TAG | (net-new: free-form AOT fingerprint salt; bump to invalidate every entry at once) | "" |
 | BIGDL_TPU_PEAK_FLOPS | (net-new: per-device MFU denominator override, FLOP/s — utils/flops.device_peak_flops; default TPU table / 1e12 CPU-nominal) | 0 (auto) |
+| BIGDL_TPU_FUSED_UPDATE | (net-new: multi-tensor fused optimizer update, optim/fused.py — flatten grad/param/slot trees into dtype-homogeneous 1-D buffers; bit-identical to the per-leaf path) | 0 (off) |
+| BIGDL_TPU_WIRE_BUCKET_MB | (net-new: max wire-dtype MB per gradient bucket, parallel/wire.py; 0 = per-leaf wire cast) | 0 (per-leaf) |
+| BIGDL_TPU_OVERLAP_FLAGS | (net-new: latency-hiding-scheduler / async-collective LIBTPU flags, utils/platform.enable_overlap_flags; 0 disables) | 1 |
+| BIGDL_TPU_CONV_ROUTE | (net-new: tiny-C_in conv lowering — pad (zero-pad), matmul (im2col reshaped-matmul, ops/convmm.py), lax (untouched); nn/conv._conv_route) | pad |
 """
 
 from __future__ import annotations

@@ -11,7 +11,52 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["force_cpu", "enable_compilation_cache"]
+__all__ = ["force_cpu", "enable_compilation_cache", "enable_overlap_flags"]
+
+
+#: latency-hiding-scheduler / async-collective flags for the TPU compiler.
+#: The bucketed gradient wire (parallel/wire.py) gives XLA a handful of
+#: bucket-sized bf16 all-reduces; these flags let it ISSUE them while the
+#: backward tail is still computing instead of serializing them after it —
+#: the MLPerf TPU-pods overlap move (PAPERS.md).  Flag-by-flag: the
+#: latency-hiding scheduler reorders ops to hide collective latency behind
+#: compute; async-collective fusion converts blocking collectives to
+#: start/done pairs (multiple_steps lets one fusion span several of them);
+#: overlap_compute_collective_tc runs collectives on the transfer core
+#: concurrently with TensorCore compute.
+_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def enable_overlap_flags() -> Optional[str]:
+    """Arm the XLA collective-overlap flags via LIBTPU_INIT_ARGS.
+
+    Must run BEFORE the TPU backend initializes (libtpu reads the env at
+    load); call it next to `force_cpu`/`enable_compilation_cache` at
+    process start (bench.py does).  Flags go into LIBTPU_INIT_ARGS — read
+    only by libtpu, so the call is inert on CPU/GPU processes — and any
+    flag the operator already set there wins (only missing keys are
+    appended).  ``BIGDL_TPU_OVERLAP_FLAGS=0`` disables.  Returns the
+    LIBTPU_INIT_ARGS value in effect, or None when disabled.
+    """
+    import os
+
+    from . import config as _config
+
+    if not _config.get_bool("OVERLAP_FLAGS", True):
+        return None
+    cur = os.environ.get("LIBTPU_INIT_ARGS", "")
+    add = [f for f in _OVERLAP_FLAGS if f.split("=", 1)[0] not in cur]
+    if add:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            ([cur] if cur else []) + add)
+    return os.environ.get("LIBTPU_INIT_ARGS")
 
 
 def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:

@@ -160,23 +160,148 @@ def test_module_fusion_parity(monkeypatch):
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_fused_add_relu_forward_and_grad_parity():
+    """ops-level: fused_conv_bn_add_relu_train == BN(x@w) + resid, relu'd,
+    on values and every gradient (incl. the residual's)."""
+    from bigdl_tpu.ops.convbn import fused_conv_bn_add_relu_train
+
+    R, K, C = 96, 32, 48
+    x = _rand((R, K), 3)
+    w = _rand((K, C), 4) * 0.2
+    gamma = 1.0 + 0.1 * _rand((C,), 5)
+    beta = 0.1 * _rand((C,), 6)
+    resid = _rand((R, C), 8)
+
+    z, mean, var = fused_conv_bn_add_relu_train(
+        x, w, None, gamma, beta, resid, EPS, True)
+    z_ref, m_ref, v_ref = bn_train_reference(jnp.dot(x, w), gamma, beta, EPS)
+    z_ref = jax.nn.relu(z_ref + resid)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(m_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(v_ref),
+                               rtol=1e-5, atol=1e-5)
+
+    t = _rand((R, C), 7)
+
+    def loss_fused(x, w, gamma, beta, resid):
+        z, _, _ = fused_conv_bn_add_relu_train(
+            x, w, None, gamma, beta, resid, EPS, True)
+        return jnp.sum((z - t) ** 2)
+
+    def loss_ref(x, w, gamma, beta, resid):
+        z, _, _ = bn_train_reference(jnp.dot(x, w), gamma, beta, EPS)
+        return jnp.sum((jax.nn.relu(z + resid) - t) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(
+        x, w, gamma, beta, resid)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(
+        x, w, gamma, beta, resid)
+    for a, b_, name in zip(gf, gr,
+                           ("dx", "dw", "dgamma", "dbeta", "dresid")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+def test_residual_tail_module_parity(monkeypatch):
+    """ConvBNAddReLU on a real bottleneck block: the fused path matches
+    the unfused fallback on forward, BN EMA state, and every param grad."""
+    from bigdl_tpu.models.resnet import ShortcutType, _bottleneck
+    from bigdl_tpu.nn.fused import ConvBNAddReLU
+
+    blk, _ = _bottleneck(16, 4, 1, ShortcutType.B)
+    fuse_conv_bn(blk)
+    assert any(isinstance(m, ConvBNAddReLU) for m in blk.modules)
+    p, s = blk.init(jax.random.PRNGKey(0))
+    x = _rand((8, 6, 6, 16), 1)
+
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    y1, s1 = blk.apply(p, s, x, training=True)
+    monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+    y0, s0 = blk.apply(p, s, x, training=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+    t = _rand(y0.shape, 12)
+
+    def loss(pp):
+        y, _ = blk.apply(pp, s, x, training=True)
+        return jnp.mean((y - t) ** 2)
+
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    g1 = jax.grad(loss)(p)
+    monkeypatch.delenv("BIGDL_TPU_BN_IMPL")
+    g0 = jax.grad(loss)(p)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_residual_tail_eval_mode_falls_back(monkeypatch):
+    """Eval mode must use the running stats (unfused children) — the fused
+    kernel computes batch stats and must not engage."""
+    from bigdl_tpu.models.resnet import ShortcutType, _bottleneck
+
+    blk, _ = _bottleneck(16, 4, 1, ShortcutType.B)
+    import copy
+    ref = copy.deepcopy(blk)
+    fuse_conv_bn(blk)
+    p, s = blk.init(jax.random.PRNGKey(0))
+    x = _rand((4, 6, 6, 16), 2)
+    monkeypatch.setenv("BIGDL_TPU_BN_IMPL", "pallas_interpret")
+    y_eval, _ = blk.apply(p, s, x, training=False)
+    assert bool(jnp.isfinite(y_eval).all())
+
+
+def test_residual_tail_bigdl_format_defuses(tmp_path):
+    """Saving a tail-fused model in bigdl format de-fuses it back to the
+    reference block shape (ConcatTable -> CAddTable -> ReLU) — the fusion
+    is a TPU-local rewrite, not a wire class — and the reload forwards
+    identically."""
+    from bigdl_tpu.interop import bigdl as bigdl_fmt
+    from bigdl_tpu.models.resnet import ShortcutType, _bottleneck
+
+    blk, _ = _bottleneck(16, 4, 1, ShortcutType.B)
+    fuse_conv_bn(blk)
+    blk.build(jax.random.PRNGKey(0))
+    x = _rand((2, 6, 6, 16), 1)
+    y0, _ = blk.apply(blk.params, blk.state, x)
+    p = str(tmp_path / "tail.bigdl")
+    bigdl_fmt.save(blk, p)
+    m2 = bigdl_fmt.load(p)
+    assert not any(type(m).__name__ == "ConvBNAddReLU"
+                   for m in m2.modules)
+    y1, _ = m2.apply(m2.params, m2.state, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_resnet50_rewrite_fuses_bottleneck_convs():
-    """ResNet-50's bottleneck 1x1 convs (2 per block x 16 blocks) fuse; the
+    """ResNet-50's bottleneck 1x1 convs fuse: the block-opening 1x1 pairs
+    become ConvBN, every residual tail (closing 1x1 conv + BN + shortcut
+    add + ReLU, one per block x 16 blocks) becomes ConvBNAddReLU; the
     3x3/7x7/strided-shortcut convs stay unfused."""
     from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn.fused import ConvBNAddReLU
 
     model = ResNet(50, class_num=10, dataset="imagenet")
     fuse_conv_bn(model)
 
-    def count(m):
-        if isinstance(m, ConvBN):
+    def count(m, cls):
+        if isinstance(m, cls):
             return 1
         if isinstance(m, nn.Sequential) or hasattr(m, "modules"):
-            return sum(count(c) for c in getattr(m, "modules", []))
+            return sum(count(c, cls) for c in getattr(m, "modules", []))
         return 0
 
-    n = count(model)
-    assert n >= 32, f"expected >=32 fused pairs in ResNet-50, got {n}"
+    pairs = count(model, ConvBN)
+    tails = count(model, ConvBNAddReLU)
+    assert tails == 16, f"expected 16 fused residual tails, got {tails}"
+    assert pairs >= 16, f"expected >=16 fused pairs in ResNet-50, got {pairs}"
 
 
 def test_module_fusion_parity_bf16(monkeypatch):

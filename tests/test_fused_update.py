@@ -1,0 +1,378 @@
+"""Fused train-step arithmetic (ISSUE 7 tentpole): multi-tensor optimizer
+update (optim/fused.py) and the bucketed bf16 gradient wire
+(parallel/wire.py).
+
+The contract under test is BIT-parity: fusing changes the compiled
+program's granularity (a handful of large kernels instead of one per
+leaf), never the scalar expression each element sees.  The one documented
+exception: under ZeRO (ShardedDataParallel) on a multi-device axis the
+bucket/buffer sharding constraints change how GSPMD decomposes the
+cross-device gradient reduction, reassociating the float sum — parity
+there is ~1e-7 relative (pinned below), not bitwise.
+
+Also pins the wire/clip ORDERING: clipping always sees wire-rounded
+gradients (compress-then-aggregate, docs/performance.md "Step arithmetic
+& overlap"); the bucketed wire must preserve that bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.common import set_seed
+from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import Adam, Optimizer, SGD, Trigger
+from bigdl_tpu.optim.method import Adadelta, Adagrad, Adamax, LBFGS, RMSprop
+from bigdl_tpu.optim import fused as fused_mod
+from bigdl_tpu.parallel import wire as wire_mod
+from bigdl_tpu.parallel.sharding import DataParallel, ShardedDataParallel
+from bigdl_tpu.utils.engine import Engine
+
+
+def _tree(seed=0):
+    """A mixed-dtype pytree shaped like a small model's params."""
+    k = jax.random.split(jax.random.PRNGKey(seed), 6)
+    return {
+        "conv": {"weight": jax.random.normal(k[0], (5, 5, 1, 6)),
+                 "bias": jax.random.normal(k[1], (6,))},
+        "bn": {"weight": jax.random.normal(k[2], (6,), jnp.bfloat16)},
+        "fc": [jax.random.normal(k[3], (84, 10)),
+               jax.random.normal(k[4], (10,), jnp.bfloat16)],
+    }
+
+
+def _assert_bitwise(a, b, msg=""):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert x.dtype == y.dtype, msg
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+# ----------------------------------------------------------------------
+# layout / fuse / unfuse
+# ----------------------------------------------------------------------
+
+def test_fuse_unfuse_roundtrip_bitwise():
+    t = _tree()
+    layout = fused_mod.plan(t)
+    # one buffer per dtype present (f32 + bf16 here)
+    assert len(layout.groups) == 2
+    bufs = fused_mod.fuse(layout, t)
+    assert all(b.ndim == 1 for b in bufs)
+    assert sum(int(b.size) for b in bufs) == sum(layout.sizes)
+    _assert_bitwise(fused_mod.unfuse(layout, bufs), t, "roundtrip")
+
+
+def test_layout_matches_rejects_scalars_and_shape_drift():
+    t = _tree()
+    layout = fused_mod.plan(t)
+    assert layout.matches(jax.tree.map(jnp.zeros_like, t))
+    # same structure, different leaf shape => not a param-shaped slot tree
+    bad = jax.tree.map(lambda x: jnp.zeros(x.size), t)
+    assert not layout.matches(bad)
+    # scalar state (Adam's t counter) must never fuse
+    single = {"w": jnp.ones((4, 4))}
+    l2 = fused_mod.plan(single)
+    assert not l2.matches({"w": jnp.float32(3.0)})
+
+
+def test_single_leaf_per_dtype_falls_back():
+    """Nothing to fuse => the per-leaf update runs (no added reshapes)."""
+    m = SGD(0.1)
+    p = {"w": jnp.ones((8,))}
+    g = {"w": jnp.full((8,), 0.5)}
+    s = m.init_state(p)
+    ref = m.update(g, p, s, 0.1)
+    out = m.update_fused(g, p, s, 0.1)
+    _assert_bitwise(out[0], ref[0])
+    _assert_bitwise(out[1], ref[1])
+
+
+# ----------------------------------------------------------------------
+# per-method bit parity
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", [
+    SGD(0.1, momentum=0.9, weight_decay=1e-4),
+    Adam(1e-3),
+    Adagrad(1e-2),
+    Adadelta(),
+    Adamax(2e-3),
+    RMSprop(1e-3),
+], ids=lambda m: type(m).__name__)
+def test_method_fused_update_bitwise(method):
+    p = _tree(1)
+    g = jax.tree.map(lambda x: (x * 0.01).astype(x.dtype), _tree(2))
+    s = method.init_state(p)
+    lr = method.get_learning_rate()
+    p_ref, s_ref = method.update(g, p, s, lr)
+    p_f, s_f = method.update_fused(g, p, s, lr)
+    _assert_bitwise(p_f, p_ref, type(method).__name__)
+    _assert_bitwise(s_f, s_ref, type(method).__name__ + " state")
+    # second step from the fused state keeps agreeing (slot trees took the
+    # roundtrip once already)
+    p_ref2, s_ref2 = method.update(g, p_ref, s_ref, lr)
+    p_f2, s_f2 = method.update_fused(g, p_f, s_f, lr)
+    _assert_bitwise(p_f2, p_ref2, type(method).__name__ + " step2")
+    _assert_bitwise(s_f2, s_ref2, type(method).__name__ + " state2")
+
+
+def test_lbfgs_opts_out():
+    m = LBFGS()
+    assert m.supports_fused is False
+    p = {"w": jnp.ones((6,)), "v": jnp.ones((3, 2))}
+    g = jax.tree.map(lambda x: x * 0.1, p)
+    s = m.init_state(p)
+    ref = m.update(g, p, s, 1.0)
+    out = m.update_fused(g, p, s, 1.0)  # silently the per-leaf path
+    _assert_bitwise(out[0], ref[0])
+
+
+# ----------------------------------------------------------------------
+# bucketed gradient wire
+# ----------------------------------------------------------------------
+
+def test_bucket_assignment_caps_and_order():
+    sizes = [100, 200, 50, 1000, 10]
+    itemsize = 2  # bf16
+    cap_mb = 600 * 2 / (1 << 20)  # 600 elements
+    buckets = wire_mod.bucket_assignment(sizes, itemsize, cap_mb)
+    assert [i for b in buckets for i in b] == list(range(len(sizes)))
+    for b in buckets:
+        elems = sum(sizes[i] for i in b)
+        assert elems <= 600 or len(b) == 1  # oversized leaf rides alone
+    assert buckets == [[0, 1, 2], [3], [4]]
+
+
+def test_wire_cast_bucketed_bitwise():
+    g = _tree(3)
+    ref = wire_mod.wire_cast(g, jnp.bfloat16, 0.0)
+    for mb in (0.001, 0.01, 1024.0):
+        out = wire_mod.wire_cast(g, jnp.bfloat16, mb)
+        _assert_bitwise(out, ref, f"bucket_mb={mb}")
+
+
+def test_wire_cast_none_passthrough():
+    g = _tree(4)
+    assert wire_mod.wire_cast(g, None, 8.0) is g
+
+
+def test_measure_collective_seconds():
+    Engine.reset()
+    Engine.init()
+    mesh = Engine.mesh()
+    t = wire_mod.measure_collective_seconds(mesh, _tree(5), jnp.bfloat16,
+                                            bucket_mb=0.01)
+    if mesh.shape.get("data", 1) > 1:
+        assert t > 0.0
+    # single-device axis: no collective exists
+    Engine.reset()
+    Engine.init(devices=[jax.devices()[0]])
+    assert wire_mod.measure_collective_seconds(
+        Engine.mesh(), _tree(5), jnp.bfloat16) == 0.0
+    Engine.reset()
+
+
+# ----------------------------------------------------------------------
+# end-to-end parity (the acceptance criterion)
+# ----------------------------------------------------------------------
+
+def _samples(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(0.0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
+    ys = rng.integers(0, 10, size=n)
+    return [Sample(xs[i], np.int32(ys[i])) for i in range(n)]
+
+
+class _LossCapture:
+    def __init__(self):
+        self.losses = []
+
+    def add_scalar(self, name, value, step):
+        if name == "Loss":
+            self.losses.append(float(value))
+
+
+def _resnet_block_model():
+    """A ResNet-style model small enough for 5 CPU steps: conv stem, one
+    basic residual block, pool, linear head."""
+    from bigdl_tpu.models.resnet import ShortcutType, _basic_block
+    set_seed(11)
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(1, 8, 3, 3, 2, 2, 1, 1))
+    m.add(nn.SpatialBatchNormalization(8))
+    m.add(nn.ReLU())
+    blk, _ = _basic_block(8, 8, 1, ShortcutType.B)
+    m.add(blk)
+    m.add(nn.Reshape([14 * 14 * 8]))
+    m.add(nn.Linear(14 * 14 * 8, 10))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _train(model_fn, steps=5, strategy=None, clip_norm=None):
+    set_seed(7)
+    model = model_fn()
+    ds = DataSet.array(_samples()).transform(
+        SampleToMiniBatch(32, drop_last=True))
+    cap = _LossCapture()
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(1e-3))
+           .set_end_when(Trigger.max_iteration(steps))
+           .set_log_interval(1)
+           .set_train_summary(cap))
+    if strategy is not None:
+        opt.set_strategy(strategy)
+    if clip_norm is not None:
+        opt.set_gradient_clipping_by_l2_norm(clip_norm)
+    opt.optimize()
+    return cap.losses, [np.asarray(l) for l in jax.tree.leaves(model.params)]
+
+
+def _lenet():
+    from bigdl_tpu.models import LeNet5
+    return LeNet5(10)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+@pytest.mark.parametrize("model_fn", [_lenet, _resnet_block_model],
+                         ids=["lenet", "resnet_block"])
+def test_fused_update_parity_data_parallel(model_fn, monkeypatch):
+    """Acceptance: 5-step LeNet and a ResNet-block model, pure DP — the
+    fused update is bit-identical to the per-leaf path."""
+    Engine.init()
+    losses0, params0 = _train(model_fn)
+    monkeypatch.setenv("BIGDL_TPU_FUSED_UPDATE", "1")
+    losses1, params1 = _train(model_fn)
+    assert losses1 == losses0
+    for a, b in zip(params1, params0):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("model_fn", [_lenet, _resnet_block_model],
+                         ids=["lenet", "resnet_block"])
+def test_fused_update_parity_zero(model_fn, monkeypatch):
+    """Acceptance: the same runs under ZeRO (ShardedDataParallel).  The
+    fused buffers' P('data') sharding constraint changes how GSPMD
+    decomposes the cross-device reduction, so parity is the documented
+    float tolerance (reassociation-level, ~1e-7 relative), not bitwise."""
+    Engine.init()
+    losses0, params0 = _train(
+        model_fn, strategy=ShardedDataParallel(min_size=1))
+    monkeypatch.setenv("BIGDL_TPU_FUSED_UPDATE", "1")
+    losses1, params1 = _train(
+        model_fn, strategy=ShardedDataParallel(min_size=1))
+    np.testing.assert_allclose(losses1, losses0, rtol=1e-5)
+    for a, b in zip(params1, params0):
+        np.testing.assert_allclose(
+            a.astype(np.float32), b.astype(np.float32),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_bucketed_wire_parity_and_clip_ordering(monkeypatch):
+    """The bucketed wire is bit-identical to the per-leaf wire, INCLUDING
+    under L2-norm clipping — which proves the ordering: the norm is
+    computed on wire-rounded grads either way (wire-before-clip).  If the
+    bucketed path clipped first, the bf16 rounding of already-scaled
+    grads would diverge bitwise within a step."""
+    Engine.init()
+    for clip in (None, 1.0):
+        monkeypatch.delenv("BIGDL_TPU_WIRE_BUCKET_MB", raising=False)
+        losses0, params0 = _train(_lenet, clip_norm=clip)
+        monkeypatch.setenv("BIGDL_TPU_WIRE_BUCKET_MB", "0.25")
+        losses1, params1 = _train(_lenet, clip_norm=clip)
+        assert losses1 == losses0, f"clip={clip}"
+        for a, b in zip(params1, params0):
+            np.testing.assert_array_equal(a, b, err_msg=f"clip={clip}")
+
+
+def test_bucketed_wire_with_fused_update_and_zero(monkeypatch):
+    """All three knobs at once (bucketed wire + fused update + ZeRO): the
+    full fused-arithmetic step trains to the same losses within the
+    documented ZeRO tolerance."""
+    Engine.init()
+    losses0, params0 = _train(
+        _lenet, strategy=ShardedDataParallel(min_size=1))
+    monkeypatch.setenv("BIGDL_TPU_FUSED_UPDATE", "1")
+    monkeypatch.setenv("BIGDL_TPU_WIRE_BUCKET_MB", "0.25")
+    losses1, params1 = _train(
+        _lenet, strategy=ShardedDataParallel(min_size=1))
+    np.testing.assert_allclose(losses1, losses0, rtol=1e-5)
+    for a, b in zip(params1, params0):
+        np.testing.assert_allclose(
+            a.astype(np.float32), b.astype(np.float32),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_collective_counter_in_trace_and_report(tmp_path, monkeypatch):
+    """Acceptance: `train.collective_s` (and collective_fraction) appear
+    in the Optimizer's counter track and in tools/trace_report.py output
+    when tracing is armed, beside the existing mfu track."""
+    import os
+    import subprocess
+    import sys
+
+    from bigdl_tpu.utils import telemetry
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv("BIGDL_TPU_TRACE", str(trace_dir))
+    monkeypatch.setenv("BIGDL_TPU_WIRE_BUCKET_MB", "0.25")
+    Engine.init()
+    _train(_lenet, steps=4)
+
+    merged = telemetry.merge_traces(str(trace_dir))
+    counters = [e for e in merged["traceEvents"]
+                if e.get("ph") == "C" and e.get("name") == "train"]
+    with_coll = [e for e in counters if "collective_s" in e["args"]]
+    assert with_coll, "no collective_s samples on the train counter track"
+    # 8-device data axis: a real cross-device reduce was measured
+    assert all(e["args"]["collective_s"] > 0 for e in with_coll)
+    assert all(0 <= e["args"]["collective_fraction"] <= 1
+               for e in with_coll)
+
+    bd = telemetry.phase_breakdown(merged)
+    assert "train.collective_s" in bd["counters"]
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_report.py"),
+         str(trace_dir)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": repo})
+    assert r.returncode == 0, r.stderr
+    assert "train.collective_s" in r.stdout
+
+
+def test_collective_not_armed_without_tracing():
+    Engine.init()
+    model = _lenet()
+    ds = DataSet.array(_samples()).transform(
+        SampleToMiniBatch(32, drop_last=True))
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(1e-3))
+           .set_end_when(Trigger.max_iteration(2)))
+    opt.optimize()
+    assert opt._collective_s is None
+
+
+def test_step_knobs_recorded(monkeypatch):
+    """_build_step records the knobs it was traced with — bench embeds
+    them in the per-config record for MFU attribution."""
+    monkeypatch.setenv("BIGDL_TPU_FUSED_UPDATE", "1")
+    monkeypatch.setenv("BIGDL_TPU_WIRE_BUCKET_MB", "4")
+    Engine.init(devices=[jax.devices()[0]])
+    model = _lenet()
+    model.build(jax.random.PRNGKey(0))
+    opt = Optimizer(model, dataset=None, criterion=nn.ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(0.1))
+    opt._build_step(Engine.mesh())
+    assert opt._step_knobs == {"fused_update": True, "wire_bucket_mb": 4.0}

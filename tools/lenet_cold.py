@@ -23,6 +23,18 @@ deserialized from DIR) — emitting one JSON line with `compile_s_cold` /
 `compile_s_warm` (time spent compiling + loading, from the aot counters)
 and the hit/miss ledger.  The XLA persistent cache is disabled in this
 mode so the warm number is attributable to the AOT layer alone.
+
+`--conv-route matmul` switches to the conv-lowering A/B (ISSUE 7): the
+LeNet train step built twice in one process — pad route (the default
+zero-pad mitigation) vs the reshaped-matmul route
+(BIGDL_TPU_CONV_ROUTE=matmul, ops/convmm.py) — emitting one JSON line
+with, per route, the conv-op count of the compiled train step (the
+CPU-side proxy for the 809 s TPU compile: the pathology lives in the TPU
+backend's grad-of-conv emitter, so the HLO that matters is the
+convolution subprogram, which the matmul route deletes outright), total
+HLO size for context, compile seconds, and steady-state step seconds.
+Exit 1 unless the matmul route eliminates every conv from the step AND
+its step time is no worse (<= 1.25x, measurement slack).
 """
 
 from __future__ import annotations
@@ -116,6 +128,88 @@ def _aot_mode(args):
     return 0 if warm < 0.2 * cold else 1
 
 
+def _build_step(batch_size):
+    """The real compiled train step (Optimizer._build_step) on device 0;
+    returns (step_fn, args, hlo_text)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(devices=[jax.devices()[0]])
+    mesh = Engine.mesh()
+    model = LeNet5(10)
+    model.build(jax.random.key(0))
+    opt = Optimizer(model, dataset=None, criterion=nn.ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(learning_rate=0.01))
+    step, param_sh, _ = opt._build_step(mesh)
+
+    rng = np.random.default_rng(0)
+    inp = jnp.asarray(rng.normal(size=(batch_size, 28, 28, 1)),
+                      jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, 10, size=batch_size), jnp.int32)
+    params = jax.device_put(model.params, param_sh)
+    args = (params, model.state, opt.optim_method.init_state(params),
+            inp, tgt, jnp.float32(0.01), jax.random.key(1))
+    hlo = step.lower(*args).as_text()
+    return step, args, hlo
+
+
+def _conv_route_mode(args):
+    """Pad-vs-matmul conv-lowering A/B on the LeNet train step."""
+    import jax
+
+    results = {}
+    for route in ("pad", args.conv_route):
+        os.environ["BIGDL_TPU_CONV_ROUTE"] = route
+        jax.clear_caches()
+        step, step_args, hlo = _build_step(args.batch_size)
+        t0 = time.perf_counter()
+        compiled = step.lower(*step_args).compile()
+        compile_s = time.perf_counter() - t0
+        opt_hlo = compiled.as_text()
+        out = step(*step_args)
+        jax.block_until_ready(out)
+        # steady state: params/opt_state threaded so shapes stay fixed
+        params, net_state, opt_state = out[0], out[1], out[2]
+        t0 = time.perf_counter()
+        iters = 10
+        for _ in range(iters):
+            params, net_state, opt_state, loss = step(
+                params, net_state, opt_state, *step_args[3:])
+        jax.block_until_ready(loss)
+        results[route] = {
+            # the pathology metric: convolution ops in the COMPILED step
+            # (each is a program the TPU conv emitter must lower; the
+            # 809 s case is one grad-of-conv among these)
+            "hlo_conv_ops": opt_hlo.count(" convolution"),
+            "hlo_ops": opt_hlo.count("\n"),
+            "stablehlo_ops": hlo.count("\n"),
+            "compile_s": round(compile_s, 3),
+            "step_s": round((time.perf_counter() - t0) / iters, 6),
+        }
+    pad, mm = results["pad"], results[args.conv_route]
+    ok = (mm["hlo_conv_ops"] == 0 and pad["hlo_conv_ops"] > 0
+          and mm["step_s"] <= 1.25 * pad["step_s"])
+    print(json.dumps({
+        "metric": "lenet_conv_route_ab",
+        "routes": results,
+        "conv_ops_eliminated": pad["hlo_conv_ops"] - mm["hlo_conv_ops"],
+        "step_ratio": round(mm["step_s"] / max(pad["step_s"], 1e-9), 4),
+        "batch_size": args.batch_size,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default=None,
@@ -125,6 +219,11 @@ def main(argv=None):
                     help="AOT executable-cache mode: run cold then warm "
                          "against DIR, emit compile_s_cold/compile_s_warm; "
                          "exit 1 unless warm < 20%% of cold")
+    ap.add_argument("--conv-route", metavar="ROUTE", default=None,
+                    choices=["matmul", "lax"],
+                    help="conv-lowering A/B mode: pad route vs ROUTE on "
+                         "the train step, one JSON line; exit 1 unless "
+                         "ROUTE's HLO is smaller with step time no worse")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -135,6 +234,8 @@ def main(argv=None):
             pass
     if args.aot_cache:
         return _aot_mode(args)
+    if args.conv_route:
+        return _conv_route_mode(args)
     from bigdl_tpu.utils.platform import enable_compilation_cache
     cache_dir = enable_compilation_cache()
 

@@ -145,6 +145,29 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] aot smoke FAILED rc=$AOT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # fused step-arithmetic smoke (cpu only): 5-step LeNet with
+  # BIGDL_TPU_FUSED_UPDATE=1 + bucketed wire must be BIT-identical to
+  # the unfused baseline (loss sequence + final params), then the
+  # conv-lowering A/B — the matmul route must eliminate every conv from
+  # the compiled train step with step time no worse
+  echo "[runbook] 2h/4 fused-arithmetic smoke (fused_smoke + conv-route A/B)" >> "$LOG"
+  timeout 300 python tools/fused_smoke.py --platform cpu \
+    > /tmp/fused_smoke.json 2>/tmp/fused_smoke.log
+  FUSED_RC=$?
+  if [ "$FUSED_RC" = 0 ]; then
+    echo "[runbook] fused smoke OK (bit-identical) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] fused smoke FAILED rc=$FUSED_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
+  timeout 300 python tools/lenet_cold.py --platform cpu --batch-size 64 \
+    --conv-route matmul > /tmp/conv_route_ab.json 2>/tmp/conv_route_ab.log
+  CONVRT_RC=$?
+  if [ "$CONVRT_RC" = 0 ]; then
+    echo "[runbook] conv-route A/B OK (convs eliminated, step no worse) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] conv-route A/B FAILED rc=$CONVRT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -172,7 +195,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
