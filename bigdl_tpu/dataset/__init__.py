@@ -134,7 +134,9 @@ class DistributedDataSet(AbstractDataSet):
         if pi is not None and pc is not None:
             return pi, pc
         from ..utils.engine import Engine
-        if Engine._mesh is not None:
+        if Engine._mesh is not None or Engine.elastic_active():
+            # elastic_active: a logical (simulated / post-shrink) topology
+            # defines the shard layout even before any mesh is built
             si, sc = Engine.data_shard_info()
         else:  # no mesh yet: blind per-process slice (the default-DP layout)
             si, sc = jax.process_index(), jax.process_count()
@@ -227,7 +229,7 @@ class StreamingRecordDataSet(AbstractDataSet):
         if pi is not None and pc is not None:
             return pi, pc
         from ..utils.engine import Engine
-        if Engine._mesh is not None:
+        if Engine._mesh is not None or Engine.elastic_active():
             si, sc = Engine.data_shard_info()
         else:  # no mesh yet: blind per-process slice (the default-DP layout)
             si, sc = jax.process_index(), jax.process_count()

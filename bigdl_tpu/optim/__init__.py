@@ -17,4 +17,5 @@ from .validation import (ValidationResult, AccuracyResult, LossResult,
 from .metrics import Metrics
 from .optimizer import (Optimizer, DistriOptimizer, LocalOptimizer, Evaluator,
                         Predictor, Validator, DistriValidator,
-                        LocalValidator, TrainingPreempted, StallError)
+                        LocalValidator, TrainingPreempted, StallError,
+                        PeerLostError)

@@ -7,6 +7,8 @@ manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7):
 - ulysses_attention: all-to-all sequence parallelism
 - pipeline: GPipe-style microbatched stage parallelism
 - expert: capacity-routed MoE over the `expert` axis (GSPMD + shard_map)
+- elastic: coordinated host-loss recovery (detect -> negotiate ->
+  re-form -> resume; docs/robustness.md "Elasticity")
 """
 
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
@@ -15,8 +17,10 @@ from .ring_attention import ring_attention, ulysses_attention
 from .pipeline import pipeline_apply, stack_stage_params
 from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
                      load_balancing_loss)
+from .elastic import PeerLostError, ElasticNegotiationError
 
 __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
            "TensorParallel", "ring_attention", "ulysses_attention",
            "pipeline_apply", "stack_stage_params", "MoEFFN",
-           "expert_parallel_ffn", "top_k_routing", "load_balancing_loss"]
+           "expert_parallel_ffn", "top_k_routing", "load_balancing_loss",
+           "PeerLostError", "ElasticNegotiationError"]

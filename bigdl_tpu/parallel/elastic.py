@@ -1,0 +1,349 @@
+"""Elastic multi-host training: coordinated host-loss recovery.
+
+Reference gap this closes: BigDL's core claim is that synchronous
+data-parallel training can run on commodity-failure-prone clusters
+because the DRIVER re-forms the job from checkpoints
+(DistriOptimizer.scala:750-816 — a dead executor fails the Spark job,
+the driver reloads the snapshot and resubmits over whatever executors
+remain).  A compiled SPMD backend has no driver: when one host dies,
+every surviving rank parks inside a collective that will never complete
+(the MLPerf TPU-pods regime, PAPERS.md).  The supervision subsystem
+(utils/supervisor) already *observes* the death — "host 3 last seen 94s
+ago" — but observation alone recovers nothing.  This module composes
+the existing pieces (heartbeat liveness, CRC-verified checkpoint
+lineage, deterministic chaos) into a cluster-wide recovery protocol:
+
+1. **detect** — every rank's supervisor promotes a peer whose heartbeat
+   *publication* goes silent for ``BIGDL_TPU_ELASTIC_PEER_LOST`` seconds
+   into a typed :class:`PeerLostError`, async-raised into the train loop
+   (the same PyThreadState_SetAsyncExc mechanism as ``StallError``), and
+   publishes an epoch-stamped ``elastic/recover.<rank>`` intent file so
+   ranks that have not noticed yet converge on the next monitor poll.
+   Publication age — not beat age — is the loss signal: a rank stuck in
+   a long XLA compile (or a wedged step) still *publishes* from its
+   monitor thread; only a dead process (or one cut off from the shared
+   store, the same failure domain) goes publication-silent.
+2. **negotiate** — surviving ranks agree on the newest checkpoint
+   lineage entry that is PRESENT and CRC-VALID for every survivor: each
+   publishes its verified view (``elastic/lineage.<rank>``), polls for
+   the others' views with retried best-effort IO, and takes the max of
+   the intersection.  A pure ``file_io`` protocol — no collectives,
+   because collectives are exactly what is broken.  The leader (lowest
+   responding rank) quarantines every entry NEWER than the agreement
+   (per-rank divergent tails), so any rank that negotiates late — or
+   recovers independently afterwards — converges on the same entry.
+3. **re-form** — the Optimizer tears down its jitted step, rebuilds the
+   mesh/topology over the surviving slice (``Engine.reform`` /
+   ``ShardingStrategy.remap``), rescales the per-host batch so the
+   GLOBAL batch is preserved (rounding rule: ``ceil(B*W / W')`` — the
+   global batch may grow by up to ``W'-1`` rows, never shrink), and
+   resumes from the negotiated entry.  The retry loop treats the whole
+   detect->negotiate->re-form sequence as ONE typed attempt.
+4. **drill** — chaos ``host.lost@<rank>`` (utils/chaos: the addressed
+   rank stops publishing and exits or wedges, optionally at an
+   ``@epoch:iteration`` address) runs the full cycle deterministically:
+   ``tools/elastic_smoke.py`` and ``tests/test_elastic.py`` kill one of
+   two subprocess ranks mid-epoch and assert the survivor shrinks,
+   rolls back to the negotiated entry, and matches a clean world-1 run.
+
+Simulated multi-host: the drill harness runs N single-process jax
+runtimes coordinated ONLY through ``file_io`` (heartbeats, lineage,
+intents) with the logical topology declared via
+``BIGDL_TPU_ELASTIC_WORLD`` / ``_ELASTIC_RANK`` (utils/engine).  On a
+real pod the same protocol runs over the shared checkpoint store; mesh
+re-formation there means the surviving processes restart into the
+smaller world (the BigDL-driver semantics) — the jax runtime cannot
+shrink a live multi-controller world in place.
+
+Knobs (utils/config tier):
+
+| env var | meaning | default |
+|---|---|---|
+| ``BIGDL_TPU_ELASTIC_PEER_LOST`` | publication-silence seconds promoting a peer to LOST (0 = elasticity off) | 0 |
+| ``BIGDL_TPU_ELASTIC_WORLD`` / ``_ELASTIC_RANK`` | simulated-multi-host logical topology | off |
+| ``BIGDL_TPU_ELASTIC_NEGOTIATE_TIMEOUT`` | seconds to wait for every survivor's lineage view | 60 |
+| ``BIGDL_TPU_ELASTIC_NEGOTIATE_POLL`` | seconds between view polls | 0.25 |
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..utils import config, file_io, telemetry
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["PeerLostError", "ElasticNegotiationError", "ElasticPlan",
+           "armed", "peer_lost_seconds", "elastic_dir", "survey",
+           "publish_intent", "read_intents", "publish_lineage_view",
+           "read_lineage_view", "negotiate", "quarantine_tail",
+           "set_last_peer_lost"]
+
+#: subdirectory of the checkpoint dir holding the recovery protocol files
+ELASTIC_DIRNAME = "elastic"
+
+# PyThreadState_SetAsyncExc raises the exception CLASS with no args in the
+# target thread (the StallError pattern, utils/supervisor): the class pulls
+# its payload from here so the error the retry loop catches still names the
+# lost ranks and the proposed recovery epoch.
+_LAST_PEER_LOST = {"message": None, "lost": (), "epoch": 0}
+
+
+def set_last_peer_lost(message: str, lost: Sequence[int],
+                       epoch: int) -> None:
+    """Stage the payload the next async-raised PeerLostError picks up."""
+    _LAST_PEER_LOST["message"] = message
+    _LAST_PEER_LOST["lost"] = tuple(int(r) for r in lost)
+    _LAST_PEER_LOST["epoch"] = int(epoch)
+
+
+class PeerLostError(RuntimeError):
+    """A peer host stopped publishing heartbeats: its collectives would
+    hang every rank forever.  Async-raised into the train loop (the
+    StallError mechanism); the retry loop runs the elastic
+    detect->negotiate->re-form->resume sequence as one typed attempt."""
+
+    def __init__(self, *args):
+        if not args and _LAST_PEER_LOST["message"]:
+            args = (_LAST_PEER_LOST["message"],)
+        super().__init__(*args or ("peer host lost (heartbeat publication "
+                                   "silent past the elastic threshold)",))
+        self.lost_ranks = tuple(_LAST_PEER_LOST["lost"])
+        self.epoch = int(_LAST_PEER_LOST["epoch"])
+
+
+class ElasticNegotiationError(RuntimeError):
+    """Negotiation could not produce a restore point (empty lineage, or
+    no entry valid for every survivor): typed failure, never a hang —
+    the run is unrecoverable in place and the retry loop re-raises."""
+
+
+@dataclass
+class ElasticPlan:
+    """The negotiated recovery: resume `neval` on `survivors`."""
+
+    neval: int
+    model_path: str
+    optim_path: str
+    survivors: tuple
+    epoch: int
+
+
+def peer_lost_seconds() -> float:
+    return config.get_float("ELASTIC_PEER_LOST", 0.0)
+
+
+def armed() -> bool:
+    """True when host-loss promotion is configured (the elasticity master
+    switch; 0/unset keeps every path in this module inert)."""
+    return peer_lost_seconds() > 0
+
+
+def elastic_dir(ckpt_path: str) -> str:
+    return file_io._join(file_io._strip_file_scheme(str(ckpt_path)),
+                         ELASTIC_DIRNAME)
+
+
+# ---------------------------------------------------------------------------
+# protocol files (intents + lineage views) — best-effort, retried by the
+# caller's poll loop; a torn write is replaced by the next one
+# ---------------------------------------------------------------------------
+
+def _write_json(base_dir: str, name: str, doc: dict) -> str:
+    path = file_io._join(base_dir, name)
+    fs = file_io.get_filesystem(path)
+    fs.makedirs(base_dir)
+    fs.write_bytes(path, json.dumps(doc).encode())
+    return path
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        fs = file_io.get_filesystem(path)
+        if not fs.exists(path):
+            return None
+        return json.loads(fs.read_bytes(path))
+    except Exception:  # noqa: BLE001 — a torn/in-flight write is transient;
+        # the caller's poll loop retries
+        return None
+
+
+def publish_intent(ckpt_path: str, rank: int, epoch: int,
+                   lost: Sequence[int], wall_time: float) -> str:
+    """Announce 'I observed host loss; recovery round `epoch` begins' so
+    ranks that have not noticed the silence yet converge on their next
+    monitor poll instead of waiting out their own threshold."""
+    return _write_json(elastic_dir(ckpt_path), f"recover.{int(rank)}",
+                       {"rank": int(rank), "epoch": int(epoch),
+                        "lost": sorted(int(r) for r in lost),
+                        "time": float(wall_time)})
+
+
+def read_intents(ckpt_path: str, min_epoch: int,
+                 exclude_rank: Optional[int] = None) -> Dict[int, dict]:
+    """rank -> intent doc, for every ``recover.<rank>`` proposing a
+    recovery round >= `min_epoch` (stale rounds are ignored)."""
+    base = elastic_dir(ckpt_path)
+    fs = file_io.get_filesystem(base)
+    try:
+        names = fs.listdir(base)
+    except Exception:  # noqa: BLE001 — dir may not exist yet
+        return {}
+    intents = {}
+    for name in names:
+        head, _, tail = name.rpartition(".")
+        if head != "recover" or not tail.isdigit():
+            continue
+        rank = int(tail)
+        if exclude_rank is not None and rank == exclude_rank:
+            continue
+        doc = _read_json(file_io._join(base, name))
+        if doc and int(doc.get("epoch", 0)) >= min_epoch:
+            intents[rank] = doc
+    return intents
+
+
+def publish_lineage_view(ckpt_path: str, rank: int, epoch: int,
+                         valid: Sequence[int]) -> str:
+    return _write_json(elastic_dir(ckpt_path), f"lineage.{int(rank)}",
+                       {"rank": int(rank), "epoch": int(epoch),
+                        "valid": sorted((int(n) for n in valid),
+                                        reverse=True)})
+
+
+def read_lineage_view(ckpt_path: str, rank: int,
+                      min_epoch: int) -> Optional[dict]:
+    doc = _read_json(file_io._join(elastic_dir(ckpt_path),
+                                   f"lineage.{int(rank)}"))
+    if doc is None or int(doc.get("epoch", -1)) < min_epoch:
+        return None
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# lineage survey + negotiation
+# ---------------------------------------------------------------------------
+
+def survey(ckpt_path: str) -> List[int]:
+    """This rank's verified lineage view: nevals (newest first) whose
+    model+optimMethod pair both exist AND pass CRC verification from
+    here.  Entries that fail stay in place — whether they are corrupt
+    for everyone is the CLUSTER's call (negotiate/quarantine_tail), not
+    one rank's."""
+    valid = []
+    for mp, op, n in file_io.checkpoint_lineage(ckpt_path):
+        try:
+            file_io.verify(mp)
+            file_io.verify(op)
+        except Exception as e:  # noqa: BLE001 — unreadable == not usable
+            logger.warning("elastic: lineage entry %d fails verification "
+                           "here (%s: %s); excluded from this rank's view",
+                           n, type(e).__name__, e)
+            continue
+        valid.append(n)
+    return valid
+
+
+def quarantine_tail(ckpt_path: str, above_neval: int) -> List[int]:
+    """Quarantine every lineage entry NEWER than the negotiated one (the
+    per-rank divergent tail: entries some survivor cannot see or cannot
+    verify).  Renamed ``.corrupt`` — out of every future resume's sight,
+    kept for forensics — so a straggler negotiating late, or the plain
+    retry loop's newest-first recovery, lands on the same entry."""
+    pruned = []
+    for mp, op, n in file_io.checkpoint_lineage(ckpt_path):
+        if n <= above_neval:
+            continue
+        file_io.quarantine_checkpoint(mp, op)
+        pruned.append(n)
+    if pruned:
+        logger.warning("elastic: quarantined divergent lineage tail %s "
+                       "(newer than the negotiated entry %d)",
+                       sorted(pruned), above_neval)
+    return pruned
+
+
+def negotiate(ckpt_path: str, rank: int, survivors: Sequence[int],
+              epoch: int, *, my_valid: Optional[Sequence[int]] = None,
+              timeout: Optional[float] = None,
+              poll: Optional[float] = None,
+              clock=None, sleep=None) -> ElasticPlan:
+    """Agree on the newest lineage entry valid for every survivor.
+
+    Pure file_io, no collectives: publish my verified view, poll for the
+    other survivors' views (stamped with this recovery round or newer),
+    intersect, take the max.  A survivor that never publishes within
+    `timeout` is dropped from the agreement (it is effectively lost too;
+    when it comes back it finds the divergent tail quarantined and
+    converges on the same entry).  Raises the typed
+    :class:`ElasticNegotiationError` — never hangs — when the lineage is
+    empty or no common entry exists."""
+    timeout = (config.get_float("ELASTIC_NEGOTIATE_TIMEOUT", 60.0)
+               if timeout is None else timeout)
+    poll = (config.get_float("ELASTIC_NEGOTIATE_POLL", 0.25)
+            if poll is None else poll)
+    clock = clock or time.monotonic
+    sleep = sleep or time.sleep
+    survivors = tuple(sorted(int(r) for r in survivors))
+    with telemetry.span("elastic.negotiate", cat="elastic", epoch=epoch,
+                        survivors=list(survivors)):
+        if my_valid is None:
+            my_valid = survey(ckpt_path)
+        publish_lineage_view(ckpt_path, rank, epoch, my_valid)
+        views: Dict[int, List[int]] = {int(rank): list(my_valid)}
+        waiting = set(survivors) - {int(rank)}
+        start = clock()
+        while waiting:
+            for r in sorted(waiting):
+                doc = read_lineage_view(ckpt_path, r, min_epoch=epoch)
+                if doc is not None:
+                    views[r] = [int(n) for n in doc.get("valid", [])]
+            waiting -= set(views)
+            if not waiting:
+                break
+            if clock() - start >= timeout:
+                logger.warning(
+                    "elastic: survivors %s never published a lineage view "
+                    "within %.1fs — negotiating without them (they will "
+                    "converge on the quarantined lineage when they return)",
+                    sorted(waiting), timeout)
+                break
+            # the wait is legitimate progress: refresh the supervising
+            # watchdog's current phase so a long negotiation cannot be
+            # mistaken for a stall (no-op without an active supervisor)
+            from ..utils import supervisor as _supervision
+            _supervision.notify()
+            sleep(poll)
+        responders = sorted(views)
+        common = set(views[responders[0]])
+        for r in responders[1:]:
+            common &= set(views[r])
+        if not common:
+            raise ElasticNegotiationError(
+                f"elastic negotiation (round {epoch}): no checkpoint "
+                f"lineage entry is valid for all responding survivors "
+                f"{responders} (views: "
+                f"{ {r: v[:3] for r, v in views.items()} }) — nothing to "
+                "resume from; the run is unrecoverable in place")
+        chosen = max(common)
+        if int(rank) == responders[0]:
+            # the leader (lowest responding rank) owns the shared-store
+            # mutation; doing it on every rank would race the renames
+            quarantine_tail(ckpt_path, chosen)
+        base = file_io._strip_file_scheme(str(ckpt_path))
+        plan = ElasticPlan(
+            neval=chosen,
+            model_path=file_io._join(base, f"model.{chosen}"),
+            optim_path=file_io._join(base, f"optimMethod.{chosen}"),
+            survivors=tuple(sorted(set(responders) | {int(rank)})),
+            epoch=int(epoch))
+        telemetry.instant("elastic.agree", cat="elastic", neval=chosen,
+                          epoch=epoch, survivors=list(plan.survivors))
+        logger.warning("elastic: negotiated restore point = snapshot %d "
+                       "(round %d, survivors %s)", chosen, epoch,
+                       list(plan.survivors))
+        return plan

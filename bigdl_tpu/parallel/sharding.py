@@ -49,6 +49,25 @@ class ShardingStrategy:
         1/N slices over 'data' like the per-leaf slots they replace."""
         return None
 
+    def remap(self, mesh: Mesh, params):
+        """Re-place a parameter tree under THIS strategy's shardings on a
+        (possibly different) mesh — the elastic re-form path
+        (parallel/elastic step 3): after a host loss shrinks the mesh,
+        every leaf is re-derived for the surviving slice, so ZeRO shards
+        go from 1/N to 1/N' and replicated leaves land on the new device
+        set.  Leaves round-trip through host memory (device buffers on a
+        dead mesh cannot be resharded in place); every leaf must be
+        addressable from this process — on a real multi-controller pod
+        the survivors reload from the negotiated checkpoint instead
+        (Optimizer._elastic_recover), which is this same path with the
+        host copy coming off storage."""
+        host = jax.tree.map(
+            lambda l: np.asarray(l) if isinstance(l, jax.Array) else l,
+            params)
+        shardings = self.param_sharding(mesh, host)
+        return jax.tree.map(lambda l, s: jax.device_put(l, s),
+                            host, shardings)
+
     def opt_state_sharding(self, mesh: Mesh, opt_state, params,
                            param_shardings):
         """Shardings for the optimizer-state pytree: momentum/Adam slots are

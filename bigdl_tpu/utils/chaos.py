@@ -23,6 +23,7 @@ Fault points wired into the runtime:
 | ``step.stall``  | once per device step dispatch (driver loop)   | stall     |
 | ``serve.request``| once per request admitted (serve/batcher)    | fail      |
 | ``serve.batch`` | once per online device batch (serve/server)   | fail/stall |
+| ``host.lost@<rank>`` | once per train iteration on rank `<rank>` (driver loop) | exit/wedge |
 
 Schedules (1-based counts):
 
@@ -34,31 +35,68 @@ Schedules (1-based counts):
 - ``StallAt(2, seconds=30)`` — BLOCK at those counts (interruptible
   50ms-sliced sleep, so the supervisor's async ``StallError`` can land;
   a real wedged C call is the supervisor's hard-exit policy case)
+- ``ExitAt(2)`` / ``WedgeAt(2, seconds=30)`` — the host-loss drill
+  (parallel/elastic): stop publishing liveness heartbeats, then die
+  (``os._exit(117)``) or wedge UNINTERRUPTIBLY (the sliced sleep
+  swallows async-raised exceptions — a lost host cannot be recovered by
+  a StallError, which is the point)
 
 Env/config spec (``BIGDL_TPU_CHAOS``), `;`-separated points::
 
     ckpt.write=corrupt@3;fs.remote=fail*2@1;data.batch=fail@6;step.stall=stall*30@5
+    host.lost@1=exit@1:4;step.stall=stall*30@2:5
 
 `fail` raises :class:`ChaosFault` (a RuntimeError: the optimizer retry
 loop and the IO retry layer treat it like any transient failure).
 ``stall`` blocks for 3600s by default; ``stall*N`` blocks N seconds —
 the deterministic hang the supervision subsystem (utils/supervisor)
 exists to catch.
+
+Addressing extensions (net-new with the elastic subsystem):
+
+- **rank-addressed points** — ``host.lost@<rank>`` is an ordinary point
+  NAME: the driver loop on rank r fires ``host.lost@r`` once per
+  iteration, so a spec shared through the env across every rank only
+  engages on the addressed one.  Actions: ``exit`` (the process dies
+  instantly with code 117) and ``wedge``/``lost`` (stops beating and
+  blocks, default 3600s, ``wedge*N`` for N seconds).
+- **``@epoch:iteration`` addressing** — any schedule's ``@`` list may
+  mix plain invocation counts with ``epoch:neval`` pairs
+  (``stall*30@2:5`` = hang at epoch 2, iteration 5).  The driver
+  publishes its position via :func:`at_position` once per iteration;
+  position addressing therefore targets per-iteration points
+  (``host.lost@r``, ``step.stall``, ``step.loss_nan``, the synchronous
+  ``data.*`` path) — multi-fire points (``fs.remote``) and the
+  prefetch worker's read-ahead ``data.batch`` see skewed positions.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, Iterable, List, Optional
 
+logger = logging.getLogger("bigdl_tpu")
+
 __all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
-           "register", "install", "clear", "reset", "armed", "fire",
-           "transform", "scoped", "counts", "FAULT_POINTS"]
+           "ExitAt", "WedgeAt", "register", "install", "clear", "reset",
+           "armed", "fire", "transform", "scoped", "counts", "at_position",
+           "FAULT_POINTS"]
 
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
                 "step.loss_nan", "data.record", "data.stall", "step.stall",
-                "serve.request", "serve.batch")
+                "serve.request", "serve.batch", "host.lost")
+
+#: the driver loop's current (epoch, neval), published once per iteration
+#: via at_position() — the coordinate ``@epoch:iteration`` addresses match
+_POSITION = {"at": None}
+
+
+def at_position(epoch: int, neval: int) -> None:
+    """Publish the driver's position for ``@epoch:iteration``-addressed
+    schedules (one dict store; free when no such schedule exists)."""
+    _POSITION["at"] = (int(epoch), int(neval))
 
 
 class ChaosFault(RuntimeError):
@@ -194,6 +232,79 @@ class StallAt:
         return f"StallAt({sorted(self.counts)}, seconds={self.seconds})"
 
 
+def _suspend_liveness():
+    """Host-loss drill: this rank must go publication-silent on its peers
+    (the signal parallel/elastic promotes to PeerLostError).  Lazy import:
+    supervisor imports chaos at module level."""
+    from . import supervisor as supervision
+    sup = supervision.get_active()
+    if sup is not None:
+        sup.suspend_heartbeat()
+
+
+class ExitAt:
+    """Host-loss drill, hard mode: at the given counts the process stops
+    publishing heartbeats and dies instantly (``os._exit(117)``) — the
+    deterministic stand-in for a host falling out of the pod.  The
+    SURVIVORS' behavior is what the drill measures."""
+
+    EXIT_CODE = 117
+
+    def __init__(self, *counts: int):
+        self.counts = frozenset(int(c) for c in counts)
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):  # exit schedules never mutate
+        raise AssertionError("ExitAt has no payload mutation")
+
+    def engage(self) -> None:
+        import os as _os
+        _suspend_liveness()
+        logger.error("chaos[host.lost]: exiting this rank (drill)")
+        _os._exit(self.EXIT_CODE)
+
+    is_fail = False
+    is_exit = True
+
+    def __repr__(self):
+        return f"ExitAt({sorted(self.counts)})"
+
+
+class WedgeAt:
+    """Host-loss drill, zombie mode: stop publishing heartbeats and block
+    UNINTERRUPTIBLY (async-raised exceptions are swallowed — a lost host
+    cannot be rescued by a StallError, which is exactly what makes it a
+    host loss rather than a stall)."""
+
+    def __init__(self, *counts: int, seconds: float = 3600.0):
+        self.counts = frozenset(int(c) for c in counts)
+        self.seconds = float(seconds)
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):  # wedge schedules never mutate
+        raise AssertionError("WedgeAt has no payload mutation")
+
+    def engage(self) -> None:
+        _suspend_liveness()
+        end = time.monotonic() + self.seconds
+        while time.monotonic() < end:
+            try:
+                time.sleep(min(0.05, max(end - time.monotonic(), 0.001)))
+            except BaseException:  # noqa: BLE001 — swallow async raises:
+                # the wedged host must stay wedged
+                pass
+
+    is_fail = False
+    is_exit = True  # engage() like ExitAt; never returns control normally
+
+    def __repr__(self):
+        return f"WedgeAt({sorted(self.counts)}, seconds={self.seconds})"
+
+
 class _Point:
     __slots__ = ("schedules", "count")
 
@@ -220,6 +331,7 @@ def clear(point: Optional[str] = None) -> None:
         if point is None:
             _POINTS.clear()
             _ENV_LOADED = False
+            _POSITION["at"] = None
         else:
             _POINTS.pop(point, None)
 
@@ -246,6 +358,15 @@ def armed(point: str) -> bool:
         return point in _POINTS and bool(_POINTS[point].schedules)
 
 
+def _matches(s, count: int) -> bool:
+    """Plain invocation-count match OR ``@epoch:iteration`` position match
+    (positions attached by the spec parser; see at_position)."""
+    if s.fires(count):
+        return True
+    at = _POSITION["at"]
+    return at is not None and at in getattr(s, "positions", ())
+
+
 def _bump(point: str):
     """count++ and return (count, matching schedules) — one counted
     invocation per fire()/transform() call."""
@@ -255,7 +376,7 @@ def _bump(point: str):
         if p is None or not p.schedules:
             return 0, []
         p.count += 1
-        return p.count, [s for s in p.schedules if s.fires(p.count)]
+        return p.count, [s for s in p.schedules if _matches(s, p.count)]
 
 
 def _trace_hits(point: str, count: int, hits) -> None:
@@ -276,7 +397,9 @@ def fire(point: str) -> None:
     if hits:
         _trace_hits(point, count, hits)
     for s in hits:
-        if getattr(s, "is_stall", False):
+        if getattr(s, "is_exit", False):
+            s.engage()
+        elif getattr(s, "is_stall", False):
             s.block()
         elif s.is_fail:
             raise ChaosFault(f"chaos[{point}] injected failure "
@@ -291,7 +414,9 @@ def transform(point: str, value):
     if hits:
         _trace_hits(point, count, hits)
     for s in hits:
-        if getattr(s, "is_stall", False):
+        if getattr(s, "is_exit", False):
+            s.engage()
+        elif getattr(s, "is_stall", False):
             s.block()
         elif s.is_fail:
             raise ChaosFault(f"chaos[{point}] injected failure "
@@ -305,35 +430,67 @@ def transform(point: str, value):
 # spec parsing (env var / --chaos CLI)
 # ---------------------------------------------------------------------------
 
+def _parse_counts(at: str, action: str):
+    """``@`` operand -> (plain counts, (epoch, neval) positions).  Each
+    comma-separated entry is a 1-based invocation count or an
+    ``epoch:iteration`` pair (the net-new position addressing)."""
+    counts_, positions = [], []
+    for c in at.split(","):
+        if not c:
+            continue
+        if ":" in c:
+            e, _, s = c.partition(":")
+            positions.append((int(e), int(s)))
+        else:
+            counts_.append(int(c))
+    if not counts_ and not positions:
+        raise ValueError(f"chaos spec: empty counts in {action!r}")
+    return counts_, frozenset(positions)
+
+
 def _parse_action(action: str):
     """One schedule from ``fail@3,5`` / ``fail*2@4`` / ``corrupt@2`` /
     ``truncate@2`` / ``nan@7`` / ``stall@5`` / ``stall*30@5`` (for stall,
-    ``*N`` is the block duration in SECONDS, not a repeat count)."""
+    ``*N`` is the block duration in SECONDS, not a repeat count) /
+    ``exit@4`` / ``wedge*30@4`` / ``lost@4`` (= wedge; the host-loss
+    drill actions).  Counts may be ``epoch:iteration`` pairs
+    (``stall*30@2:5``)."""
     if "@" not in action:
         raise ValueError(f"chaos spec: missing '@counts' in {action!r}")
     kind, _, at = action.partition("@")
-    counts_ = [int(c) for c in at.split(",") if c]
-    if not counts_:
-        raise ValueError(f"chaos spec: empty counts in {action!r}")
+    counts_, positions = _parse_counts(at, action)
+
+    def place(sched):
+        if positions:
+            sched.positions = positions
+        return sched
+
     if kind.startswith("stall"):
         seconds = 3600.0
         if "*" in kind:  # stall*SECONDS@counts
             seconds = float(kind.split("*", 1)[1])
-        return StallAt(*counts_, seconds=seconds)
+        return place(StallAt(*counts_, seconds=seconds))
+    if kind == "exit":
+        return place(ExitAt(*counts_))
+    if kind.startswith(("wedge", "lost")):
+        seconds = 3600.0
+        if "*" in kind:  # wedge*SECONDS@counts
+            seconds = float(kind.split("*", 1)[1])
+        return place(WedgeAt(*counts_, seconds=seconds))
     if kind.startswith("fail"):
         if "*" in kind:  # fail*N@start
             n = int(kind.split("*", 1)[1])
-            if len(counts_) != 1:
+            if len(counts_) != 1 or positions:
                 raise ValueError(
                     f"chaos spec: fail*N takes one start count: {action!r}")
             return FailN(n, start=counts_[0])
-        return FailAt(*counts_)
+        return place(FailAt(*counts_))
     if kind in ("corrupt", "flip"):
-        return CorruptAt(*counts_, mode="flip")
+        return place(CorruptAt(*counts_, mode="flip"))
     if kind == "truncate":
-        return CorruptAt(*counts_, mode="truncate")
+        return place(CorruptAt(*counts_, mode="truncate"))
     if kind == "nan":
-        return CorruptAt(*counts_)  # float payloads NaN under any mode
+        return place(CorruptAt(*counts_))  # float payloads NaN any mode
     raise ValueError(f"chaos spec: unknown action {kind!r} in {action!r}")
 
 

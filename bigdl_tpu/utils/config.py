@@ -56,6 +56,9 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_WIRE_BUCKET_MB | (net-new: max wire-dtype MB per gradient bucket, parallel/wire.py; 0 = per-leaf wire cast) | 0 (per-leaf) |
 | BIGDL_TPU_OVERLAP_FLAGS | (net-new: latency-hiding-scheduler / async-collective LIBTPU flags, utils/platform.enable_overlap_flags; 0 disables) | 1 |
 | BIGDL_TPU_CONV_ROUTE | (net-new: tiny-C_in conv lowering — pad (zero-pad), matmul (im2col reshaped-matmul, ops/convmm.py), lax (untouched); nn/conv._conv_route) | pad |
+| BIGDL_TPU_ELASTIC_PEER_LOST | (net-new: elastic host-loss threshold, seconds of heartbeat-PUBLICATION silence promoting a peer to PeerLostError; parallel/elastic — 0 disarms elasticity) | 0 (off) |
+| BIGDL_TPU_ELASTIC_WORLD / _ELASTIC_RANK | (net-new: simulated-multi-host logical topology for the elastic drill harness; utils/engine.Engine.world/rank) | off |
+| BIGDL_TPU_ELASTIC_NEGOTIATE_TIMEOUT / _ELASTIC_NEGOTIATE_POLL | (net-new: seconds to wait for every survivor's lineage view / poll cadence during elastic negotiation) | 60 / 0.25 |
 """
 
 from __future__ import annotations

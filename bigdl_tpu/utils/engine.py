@@ -50,6 +50,15 @@ class Engine:
     #: True once jax.distributed.initialize has run in this process
     _distributed_initialized = False
 
+    #: elastic logical topology (parallel/elastic): None, or a dict
+    #: {"rank": original rank id, "survivors": sorted tuple of surviving
+    #: original rank ids}.  Ranks keep their ORIGINAL ids across shrinks
+    #: (heartbeat/intent files stay addressable); the world SIZE and a
+    #: rank's data-shard index derive from the survivor set.  Installed
+    #: by reform(); the pre-fault logical topology of a simulated
+    #: multi-host run comes from BIGDL_TPU_ELASTIC_WORLD/_ELASTIC_RANK.
+    _elastic = None
+
     @classmethod
     def init_distributed(cls, coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
@@ -216,6 +225,102 @@ class Engine:
         cls._mesh = None
         cls._initialized = False
         cls._probe = None
+        cls._elastic = None
+
+    # -- elastic topology (parallel/elastic) ----------------------------
+
+    @classmethod
+    def _env_elastic_world(cls) -> int:
+        from . import config
+        return config.get_int("ELASTIC_WORLD", 0)
+
+    @classmethod
+    def world(cls) -> int:
+        """Logical world size: survivor count after a reform(), the
+        BIGDL_TPU_ELASTIC_WORLD simulated topology, else
+        jax.process_count() (the physical truth)."""
+        if cls._elastic is not None:
+            return len(cls._elastic["survivors"])
+        w = cls._env_elastic_world()
+        return w if w > 1 else jax.process_count()
+
+    @classmethod
+    def rank(cls) -> int:
+        """This process's logical rank (ORIGINAL id — stable across
+        shrinks); falls back to jax.process_index()."""
+        if cls._elastic is not None:
+            return cls._elastic["rank"]
+        if cls._env_elastic_world() > 1:
+            from . import config
+            return config.get_int("ELASTIC_RANK", jax.process_index())
+        return jax.process_index()
+
+    @classmethod
+    def survivors(cls) -> tuple:
+        """Surviving original rank ids, sorted (all ranks pre-fault)."""
+        if cls._elastic is not None:
+            return cls._elastic["survivors"]
+        return tuple(range(cls.world()))
+
+    @classmethod
+    def elastic_active(cls) -> bool:
+        """True when a logical (elastic/simulated) topology overrides the
+        physical jax process view."""
+        return cls._elastic is not None or cls._env_elastic_world() > 1
+
+    @classmethod
+    def is_writer(cls) -> bool:
+        """True on the rank that owns shared-store writes (checkpoints):
+        the lowest surviving rank.  Identical to process_index()==0 until
+        a reform() removes rank 0."""
+        return cls.rank() == min(cls.survivors() or (0,))
+
+    @classmethod
+    def reform(cls, world: Optional[int] = None, rank: Optional[int] = None,
+               survivors: Optional[Sequence[int]] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+        """Re-form the topology over the surviving slice after a host
+        loss (parallel/elastic step 3).
+
+        `survivors` are ORIGINAL rank ids (default: the first `world`
+        current survivors); `rank` is this process's original id
+        (default: unchanged).  With `devices` given, the mesh itself is
+        rebuilt over that device subset (the in-process simulated-host
+        path: "losing a host" = losing its devices); only 1-D
+        data-parallel meshes re-form this way — multi-axis layouts need
+        an explicit Engine.init.  Without `devices` the mesh keeps its
+        current (local) devices and only the logical topology shrinks —
+        the simulated-multi-host path, where each rank's devices were
+        local all along.  The caller (Optimizer._elastic_recover) owns
+        tearing down compiled steps and re-placing state."""
+        cur = cls.survivors()
+        if survivors is None:
+            if world is None:
+                raise ValueError("Engine.reform: need world or survivors")
+            survivors = cur[:int(world)]
+        survivors = tuple(sorted(int(r) for r in survivors))
+        if not survivors:
+            raise ValueError("Engine.reform: empty survivor set")
+        if world is not None and int(world) != len(survivors):
+            raise ValueError(f"Engine.reform: world={world} disagrees with "
+                             f"survivors {survivors}")
+        if rank is None:
+            rank = cls.rank()
+        rank = int(rank)
+        if rank not in survivors:
+            raise ValueError(f"Engine.reform: rank {rank} not in survivors "
+                             f"{survivors}")
+        if devices is not None:
+            devs = list(devices)
+            if cls._mesh is not None and len(cls._mesh.axis_names) > 1:
+                raise NotImplementedError(
+                    "Engine.reform(devices=...) re-forms 1-D data meshes "
+                    "only; rebuild multi-axis layouts via Engine.init")
+            cls.set_mesh(Mesh(np.array(devs), (cls.DATA_AXIS,)))
+        cls._elastic = {"rank": rank, "survivors": survivors}
+        logger.warning("Engine.reform: world -> %d (rank %d, survivors %s)",
+                       len(survivors), rank, list(survivors))
+        return cls.mesh()
 
     # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
 
@@ -233,6 +338,11 @@ class Engine:
         (shard_count == 1).  Feeding a blind per-process slice in the
         latter layout silently trains each host on different data."""
         axis = axis or cls.DATA_AXIS
+        if cls._elastic is not None or cls._env_elastic_world() > 1:
+            # elastic logical topology (simulated multi-host / post-shrink):
+            # each surviving rank feeds its index-th stride of the data
+            surv = cls.survivors()
+            return surv.index(cls.rank()), len(surv)
         if jax.process_count() == 1:
             return 0, 1
         mesh = cls.mesh()

@@ -63,10 +63,10 @@ from .recordio import crc32c_update
 
 logger = logging.getLogger("bigdl_tpu")
 
-__all__ = ["save", "load", "save_checkpoint", "latest_checkpoint", "File",
-           "register_filesystem", "get_filesystem", "CorruptCheckpoint",
-           "checkpoint_lineage", "quarantine_checkpoint", "prune_checkpoints",
-           "RetryPolicy", "set_retry_timebase"]
+__all__ = ["save", "load", "verify", "save_checkpoint", "latest_checkpoint",
+           "File", "register_filesystem", "get_filesystem",
+           "CorruptCheckpoint", "checkpoint_lineage", "quarantine_checkpoint",
+           "prune_checkpoints", "RetryPolicy", "set_retry_timebase"]
 
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 
@@ -503,6 +503,22 @@ def load(path: str) -> Any:
             return fs.read_pickle(path)
         data = chaos.transform("ckpt.read", fs.read_bytes(path))
         return _loads_payload(unframe_bytes(data, path), path)
+
+
+def verify(path: str) -> None:
+    """Integrity-check one blob WITHOUT unpickling it: raises
+    :class:`CorruptCheckpoint` on CRC mismatch or truncation, returns
+    None on success (legacy unframed files pass, matching `load`).  The
+    elastic lineage negotiation (parallel/elastic.survey) uses this to
+    build each rank's verified view — a cheap frame walk, not a load."""
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    if isinstance(fs, LocalFileSystem):
+        # chunked streaming verify: no whole-blob copy for multi-GB files
+        with open(path, "rb") as f:
+            LocalFileSystem._verify_frame(f, path)
+        return
+    unframe_bytes(fs.read_bytes(path), path)
 
 
 def save_checkpoint(path: str, neval: int, model_blob: Any,

@@ -47,13 +47,26 @@ Core pieces
   into the WORKER, which forwards it to the consumer's ``next()``.
 
 - Multi-host liveness: each process publishes a heartbeat file
-  (``<peer_dir>/heartbeat.<rank>``, JSON with the last beat's wall time)
-  through ``file_io``; every supervisor flags peers whose heartbeats go
-  stale (``BIGDL_TPU_SUPERVISE_PEER_STALE`` seconds), so an eternal
-  allgather hang dies with "host 3 last seen 94s ago" in the crash
-  report instead of hanging forever.  Publication happens from the
-  MONITOR thread but stamps the supervised thread's last-beat time — a
-  stalled rank goes stale on its peers even while its monitor lives.
+  (``<peer_dir>/heartbeat.<rank>``, JSON with the last beat's wall time
+  AND the monitor's publication wall time) through ``file_io``; every
+  supervisor flags peers whose BEATS go stale
+  (``BIGDL_TPU_SUPERVISE_PEER_STALE`` seconds), so an eternal allgather
+  hang dies with "host 3 last seen 94s ago" in the crash report instead
+  of hanging forever.  Publication happens from the MONITOR thread but
+  stamps the supervised thread's last-beat time — a stalled rank goes
+  stale on its peers even while its monitor lives.  Publication is
+  best-effort and RETRIED: a transient store flake is counted
+  (``heartbeat_errors``) and re-attempted on the next poll, never
+  allowed to kill the monitor or silently stop beats.
+
+- Elastic host-loss promotion (parallel/elastic): with
+  ``BIGDL_TPU_ELASTIC_PEER_LOST`` armed, a peer whose *publication*
+  (not just beats — a compiling or wedged rank still publishes) goes
+  silent past that threshold is promoted to a typed ``PeerLostError``
+  async-raised into the supervised thread, and an epoch-stamped
+  ``elastic/recover.<rank>`` intent file is published so the other
+  survivors converge on their next poll.  The optimizer's retry loop
+  turns that into negotiate -> re-form -> resume (docs/robustness.md).
 
 Knobs (utils/config tier):
 
@@ -62,7 +75,8 @@ Knobs (utils/config tier):
 | ``BIGDL_TPU_SUPERVISE_DATA/_STEP/_CHECKPOINT/_VALIDATION`` | per-phase deadline seconds (0 = unwatched) | 0 |
 | ``BIGDL_TPU_SUPERVISE_DEADLINE`` | default deadline for phases without their own | 0 |
 | ``BIGDL_TPU_SUPERVISE_POLICY`` | ``raise`` or ``exit`` | raise |
-| ``BIGDL_TPU_SUPERVISE_PEER_STALE`` | peer heartbeat staleness threshold, seconds | 60 |
+| ``BIGDL_TPU_SUPERVISE_PEER_STALE`` | peer heartbeat (beat-age) staleness threshold, seconds | 60 |
+| ``BIGDL_TPU_ELASTIC_PEER_LOST`` | publication-silence seconds promoting a peer to LOST (0 = off) | 0 |
 """
 
 from __future__ import annotations
@@ -224,6 +238,10 @@ class Supervisor:
                  rank: int = 0, world: int = 1,
                  peer_stale: Optional[float] = None,
                  publish_interval: Optional[float] = None,
+                 peer_lost: Optional[float] = None,
+                 lineage_dir: Optional[str] = None,
+                 on_peer_stale: Optional[Callable[[int, float],
+                                                  None]] = None,
                  name: str = "bigdl-supervisor",
                  timeline_len: int = 64):
         self.deadlines = dict(deadlines or {})
@@ -245,6 +263,23 @@ class Supervisor:
                            else config.get_float("SUPERVISE_PEER_STALE",
                                                  60.0))
         self.publish_interval = publish_interval
+        # elastic host-loss promotion (parallel/elastic): peer_lost is the
+        # PUBLICATION-silence threshold (0 = off); elastic_dir holds the
+        # recover.<rank>/lineage.<rank> protocol files (usually
+        # <ckpt>/elastic); on_peer_stale fires once per peer per stale
+        # episode (programmatic access beside the log line)
+        self.peer_lost = (peer_lost if peer_lost is not None
+                          else config.get_float("ELASTIC_PEER_LOST", 0.0))
+        #: the CHECKPOINT/lineage dir whose `elastic/` subdir carries the
+        #: recovery protocol files (parallel/elastic.elastic_dir)
+        self.lineage_dir = lineage_dir
+        self.on_peer_stale = on_peer_stale
+        self.elastic_epoch = 0      # completed elastic recovery rounds
+        self.heartbeat_errors = 0   # failed (retried) heartbeat publishes
+        self._publish_suspended = False
+        self._lost_peers = set()    # ranks already recovered away from
+        self._peer_lost_pending = False
+        self._lost_candidates: Dict[int, float] = {}
         self.name = name
         self._lock = threading.Lock()
         self._timeline = collections.deque(maxlen=timeline_len)
@@ -340,6 +375,10 @@ class Supervisor:
         if self.poll_interval is None:
             cands = [d for d in (*self.deadlines.values(),
                                  self.default_deadline) if d]
+            if self.peer_lost > 0 and self.peer_dir and self.world > 1:
+                # elastic detection must poll fast enough to notice a
+                # publication-silent peer well inside the threshold
+                cands.append(self.peer_lost)
             self.poll_interval = (min(max(min(cands) / 4.0, 0.05), 10.0)
                                   if cands else 1.0)
         self._thread = threading.Thread(target=self._monitor, daemon=True,
@@ -367,9 +406,26 @@ class Supervisor:
 
     def _monitor(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            # each sub-duty individually guarded: a broken peer listing or
+            # report write must not skip the deadline checks (or vice
+            # versa) — the watchdog outlives any single failure
             try:
                 self._publish_heartbeat()
-                self._check_peers(log=True)
+            except Exception:  # noqa: BLE001
+                self.heartbeat_errors += 1
+                logger.warning("supervisor: heartbeat publish errored "
+                               "(non-fatal, will retry)", exc_info=True)
+            stale: Dict[int, float] = {}
+            try:
+                stale = self._check_peers(log=True)
+            except Exception:  # noqa: BLE001
+                logger.exception("supervisor peer check error (non-fatal)")
+            try:
+                self._check_elastic(stale)
+            except Exception:  # noqa: BLE001
+                logger.exception("supervisor elastic check error "
+                                 "(non-fatal)")
+            try:
                 now = self.clock()
                 # auxiliary channels first: a stalled input-pipeline
                 # worker is the CAUSE of the main thread's stale data
@@ -565,12 +621,24 @@ class Supervisor:
         return file_io._join(file_io._strip_file_scheme(str(self.peer_dir)),
                              f"heartbeat.{rank}")
 
+    def suspend_heartbeat(self) -> None:
+        """Stop publishing liveness (the ``host.lost`` chaos drill:
+        peers must see this rank go publication-silent)."""
+        self._publish_suspended = True
+
     def _publish_heartbeat(self) -> None:
         """Publish this process's last-beat wall time.  Runs on the
         MONITOR thread but stamps the SUPERVISED thread's last beat, so a
         stalled rank goes stale on its peers even while its monitor keeps
-        publishing."""
-        if not self.peer_dir or self.world <= 1:
+        publishing; the blob ALSO carries the monitor's own publication
+        time (``published``) — the elastic host-LOST signal, which a
+        merely-stalled or long-compiling rank keeps fresh.
+
+        Best-effort with retry: a transient store failure is counted in
+        ``heartbeat_errors`` and the publish re-attempted on the NEXT
+        monitor poll (``_last_publish`` only advances on success) — one
+        flake can delay a beat, never silently end liveness."""
+        if not self.peer_dir or self.world <= 1 or self._publish_suspended:
             return
         now = self.clock()
         interval = (self.publish_interval
@@ -579,14 +647,14 @@ class Supervisor:
         if self._last_publish is not None and \
                 now - self._last_publish < interval:
             return
-        self._last_publish = now
         with self._lock:
             phase, _ = self._last
             count = self._count
             last_wall = (self._timeline[-1][3] if self._timeline
                          else self.wall_clock())
         blob = json.dumps({"rank": self.rank, "phase": phase,
-                           "count": count, "time": last_wall}).encode()
+                           "count": count, "time": last_wall,
+                           "published": self.wall_clock()}).encode()
         path = self._heartbeat_path(self.rank)
         try:
             from . import file_io
@@ -595,13 +663,31 @@ class Supervisor:
             fs.write_bytes(path, blob)
         except Exception as e:  # noqa: BLE001 — liveness publication is
             # best-effort; a broken heartbeat store must not kill training
-            logger.warning("supervisor: heartbeat publish to %s failed: %s",
-                           path, e)
+            self.heartbeat_errors += 1
+            logger.warning("supervisor: heartbeat publish to %s failed "
+                           "(%d so far; retrying next poll): %s",
+                           path, self.heartbeat_errors, e)
+            return
+        self._last_publish = now
 
     def check_peers(self) -> Dict[int, float]:
         """rank -> seconds-since-last-beat for every peer whose heartbeat
         file is stale (public entry for tests/tools)."""
         return dict(self._check_peers(log=False))
+
+    def stale_peers(self) -> Dict[int, float]:
+        """The most recent peer-staleness observation (rank -> beat age,
+        seconds) WITHOUT re-listing the store — the programmatic
+        accessor beside the log line; refreshed every monitor poll."""
+        with self._lock:
+            return dict(self._stale_peers)
+
+    def lost_peers(self) -> Dict[int, float]:
+        """Peers whose heartbeat PUBLICATION is silent past the elastic
+        ``peer_lost`` threshold (rank -> publication age, seconds) — the
+        host-loss candidates, as of the last monitor poll."""
+        with self._lock:
+            return dict(self._lost_candidates)
 
     def _check_peers(self, log: bool) -> Dict[int, float]:
         if not self.peer_dir or self.world <= 1:
@@ -615,19 +701,27 @@ class Supervisor:
             return {}
         now = self.wall_clock()
         stale = {}
+        lost = {}
         for name in names:
             head, _, tail = name.rpartition(".")
             if head != "heartbeat" or not tail.isdigit():
                 continue
             rank = int(tail)
-            if rank == self.rank:
+            if rank == self.rank or rank in self._lost_peers:
+                # peers already recovered away from (elastic reform) keep
+                # their final heartbeat file forever — not news
                 continue
             try:
                 hb = json.loads(fs.read_bytes(self._heartbeat_path(rank)))
                 age = now - float(hb["time"])
+                # pre-elastic heartbeat blobs have no 'published' stamp:
+                # fall back to the beat time (conservative — more lost)
+                pub_age = now - float(hb.get("published", hb["time"]))
             except Exception:  # noqa: BLE001 — a torn heartbeat write is
                 # transient; the next publish replaces it
                 continue
+            if self.peer_lost > 0 and pub_age > self.peer_lost:
+                lost[rank] = pub_age
             if age > self.peer_stale:
                 stale[rank] = age
                 if log and rank not in self._stale_peers:
@@ -635,5 +729,87 @@ class Supervisor:
                         "supervisor: peer host %d heartbeat is stale — "
                         "last seen %.0fs ago (phase %r); its collectives "
                         "will hang every rank", rank, age, hb.get("phase"))
-        self._stale_peers = stale
+                    if self.on_peer_stale is not None:
+                        try:
+                            self.on_peer_stale(rank, age)
+                        except Exception:  # noqa: BLE001 — observer only
+                            logger.exception("on_peer_stale callback "
+                                             "failed (non-fatal)")
+        if log and stale:
+            # stragglers-about-to-die on the run timeline: one counter
+            # sample per stale peer per poll (no-op when tracing is off)
+            from . import telemetry
+            telemetry.counter("peers", **{f"stale_age_r{r}": round(a, 3)
+                                          for r, a in stale.items()})
+        with self._lock:
+            self._stale_peers = stale
+            self._lost_candidates = lost
         return stale
+
+    # -- elastic host-loss promotion (parallel/elastic) -----------------
+
+    def _check_elastic(self, stale: Dict[int, float]) -> None:
+        """Promote publication-silent peers into a typed PeerLostError
+        (parallel/elastic step 1): stage the payload, publish the
+        epoch-stamped ``elastic/recover.<rank>`` intent so slower ranks
+        converge on their next poll, and async-raise into the supervised
+        thread — the retry loop owns negotiate/re-form/resume."""
+        if self.peer_lost <= 0 or self.world <= 1 or not self.peer_dir \
+                or self._peer_lost_pending or not self.lineage_dir:
+            return
+        with self._lock:
+            lost = {r: a for r, a in self._lost_candidates.items()
+                    if r not in self._lost_peers}
+        from ..parallel import elastic
+        # fast convergence: another survivor already called this round
+        intents = elastic.read_intents(
+            self.lineage_dir, min_epoch=self.elastic_epoch + 1,
+            exclude_rank=self.rank)
+        for doc in intents.values():
+            for r in doc.get("lost", []):
+                if int(r) != self.rank and int(r) not in self._lost_peers:
+                    lost.setdefault(int(r), 0.0)
+        if not lost:
+            return
+        propose = max([self.elastic_epoch + 1] +
+                      [int(d.get("epoch", 0)) for d in intents.values()])
+        msg = (f"supervisor[{self.name}]: peer host(s) "
+               f"{sorted(lost)} lost — heartbeat publication silent "
+               f"{', '.join(f'{a:.0f}s (host {r})' for r, a in sorted(lost.items()))}"
+               f"; starting elastic recovery round {propose}")
+        try:
+            elastic.publish_intent(self.lineage_dir, self.rank,
+                                   propose, sorted(lost),
+                                   self.wall_clock())
+        except Exception:  # noqa: BLE001 — the local raise still recovers
+            # this rank; peers fall back to their own thresholds
+            logger.exception("supervisor: could not publish elastic "
+                             "recovery intent (non-fatal)")
+        from . import telemetry
+        telemetry.instant("elastic.detect", cat="elastic",
+                          lost=sorted(lost), epoch=propose)
+        logger.error(msg)
+        elastic.set_last_peer_lost(msg, sorted(lost), propose)
+        self._peer_lost_pending = True
+        with self._lock:
+            tid = self._thread_id
+        if not _async_raise(tid, elastic.PeerLostError):
+            logger.error("supervisor: could not deliver PeerLostError to "
+                         "thread %s (already exited?)", tid)
+
+    def reform(self, rank: int, world: int, epoch: int,
+               lost=()) -> None:
+        """Install the post-recovery topology (Optimizer._elastic_recover
+        step 3): the lost peers' frozen heartbeat files stop counting as
+        news, the completed recovery round is recorded, and promotion
+        re-arms for the NEXT loss."""
+        with self._lock:
+            self.rank, self.world = int(rank), int(world)
+            self._lost_peers |= {int(r) for r in lost}
+            self._stale_peers = {r: a for r, a in self._stale_peers.items()
+                                 if r not in self._lost_peers}
+            self._lost_candidates = {
+                r: a for r, a in self._lost_candidates.items()
+                if r not in self._lost_peers}
+        self.elastic_epoch = int(epoch)
+        self._peer_lost_pending = False

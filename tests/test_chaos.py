@@ -449,6 +449,97 @@ def test_stall_default_duration_and_repr():
 
 
 # ---------------------------------------------------------------------------
+# host.lost + @epoch:iteration addressing (the elastic drill grammar)
+# ---------------------------------------------------------------------------
+
+def test_host_lost_grammar_roundtrip():
+    """`host.lost@<rank>` is a rank-addressed POINT name; `exit` and
+    `wedge`/`lost` are its actions.  A shared spec only engages on the
+    addressed rank because only that rank fires the suffixed point."""
+    with chaos.scoped("host.lost@1=exit@3;host.lost@0=wedge*0.5@2"):
+        assert chaos.armed("host.lost@1")
+        assert chaos.armed("host.lost@0")
+        assert not chaos.armed("host.lost@2")  # unaddressed rank: inert
+    s = chaos._parse_action("exit@3")
+    assert isinstance(s, chaos.ExitAt)
+    assert s.fires(3) and not s.fires(2) and s.EXIT_CODE == 117
+    w = chaos._parse_action("wedge*2.5@4")
+    assert isinstance(w, chaos.WedgeAt) and w.seconds == 2.5
+    assert chaos._parse_action("lost@4").seconds == 3600.0  # wedge alias
+    with pytest.raises(ValueError):
+        chaos.install("host.lost@1=exit")  # no counts
+    with pytest.raises(ValueError):
+        chaos.install("host.lost@1=lose@1")  # unknown action stays loud
+
+
+def test_epoch_step_addressing_roundtrip():
+    """`@epoch:iteration` pairs address the driver position published by
+    chaos.at_position — alongside (and mixable with) plain counts."""
+    s = chaos._parse_action("stall*30@2:5")
+    assert s.positions == frozenset({(2, 5)}) and not s.counts
+    mixed = chaos._parse_action("fail@3,2:5")
+    assert mixed.counts == frozenset({3})
+    assert mixed.positions == frozenset({(2, 5)})
+    chaos.at_position(2, 5)
+    assert chaos._matches(s, 99)       # position match, any count
+    chaos.at_position(2, 4)
+    assert not chaos._matches(s, 99)
+    assert chaos._matches(mixed, 3)    # plain count still matches
+    with pytest.raises(ValueError):
+        chaos._parse_action("fail*2@2:5")  # fail*N takes one plain start
+
+
+def test_epoch_step_addressed_fault_fires_at_position():
+    with chaos.scoped("data.batch=fail@2:3"):
+        chaos.at_position(1, 1)
+        chaos.fire("data.batch")            # wrong position: clean
+        chaos.at_position(2, 3)
+        with pytest.raises(chaos.ChaosFault):
+            chaos.fire("data.batch")
+        chaos.at_position(2, 4)
+        chaos.fire("data.batch")            # past it: clean again
+
+
+def test_exit_at_engages_and_suspends_liveness(monkeypatch, tmp_path):
+    """ExitAt must go publication-silent then hard-exit (monkeypatched:
+    the test process stays alive) — the survivors' detection signal."""
+    from bigdl_tpu.utils.supervisor import Supervisor
+    from bigdl_tpu.utils import supervisor as sup_mod
+    calls = {}
+    monkeypatch.setattr(os, "_exit", lambda code: calls.setdefault(
+        "code", code))
+    sup = Supervisor({"step": 60.0}, peer_dir=str(tmp_path), rank=1,
+                     world=2, publish_interval=0.0)
+    sup_mod.set_active(sup)
+    try:
+        with chaos.scoped("host.lost@1=exit@1"):
+            chaos.fire("host.lost@1")
+        assert calls["code"] == chaos.ExitAt.EXIT_CODE == 117
+        assert sup._publish_suspended  # went silent before dying
+        sup.beat("step")
+        sup._publish_heartbeat()
+        assert not os.path.exists(str(tmp_path / "heartbeat.1"))
+    finally:
+        sup_mod.set_active(None)
+
+
+def test_wedge_at_blocks_for_duration_and_suspends():
+    from bigdl_tpu.utils.supervisor import Supervisor
+    from bigdl_tpu.utils import supervisor as sup_mod
+    import time as _time
+    sup = Supervisor({"step": 60.0})
+    sup_mod.set_active(sup)
+    try:
+        with chaos.scoped("host.lost@0=wedge*0.2@1"):
+            t0 = _time.monotonic()
+            chaos.fire("host.lost@0")
+            assert _time.monotonic() - t0 >= 0.18
+        assert sup._publish_suspended
+    finally:
+        sup_mod.set_active(None)
+
+
+# ---------------------------------------------------------------------------
 # tier-1 chaos smoke: 5-step LeNet fit over a corrupt BDRecord shard
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Elastic host-loss drill: prove detect->negotiate->re-form->resume
+end-to-end with REAL processes (the runbook's cpu-smoke stage 2i and the
+tier-1 acceptance test both drive this).
+
+Orchestration (default mode):
+
+1. Spawn 2 subprocess ranks — single-process jax runtimes coordinated
+   ONLY through file_io (the simulated multi-host harness: logical
+   topology from ``BIGDL_TPU_ELASTIC_WORLD``/``_ELASTIC_RANK``, shared
+   checkpoint + heartbeat dirs).  Rank 1 carries chaos
+   ``host.lost@1=exit@1:<iter>`` — at epoch 1 iteration <iter> it stops
+   publishing and dies (exit 117, the expected outcome).
+2. Rank 0 must DETECT the publication silence (PeerLostError), negotiate
+   the newest common lineage entry, shrink to world=1 with the per-host
+   batch rescaled 16 -> 32 (global batch preserved), resume, and finish
+   training — its trace must carry the ``elastic.*`` events.
+3. A third, CLEAN world-1 process resumes from the SAME negotiated
+   lineage entry at batch 32 and trains to the same end trigger: its
+   final loss must match rank 0's bit-for-bit (shuffle disabled and the
+   snapshot's RNG state restored in both, so the post-resume iteration
+   sequences are identical).
+
+Prints ONE JSON line; exit 0 iff the whole drill closed:
+
+    {"metric": "elastic_smoke", "recovered": true, "neval_resumed": 7,
+     "world_after": 1, "batch_after": 32, "loss": ..., "clean_loss": ...,
+     "loss_match": true, "elastic_events": [...], ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+# runnable as `python tools/elastic_smoke.py` from the repo root (the
+# runbook's invocation): sys.path[0] is tools/, so add the repo root
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+LOST_EXIT = 117  # chaos.ExitAt.EXIT_CODE
+
+
+def _worker(args) -> int:
+    """One logical rank (or the clean comparison run)."""
+    if args.platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.transformer import Transformer
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(6).astype(np.float32),
+                      np.float32(i % 2)) for i in range(128)]
+
+    class Pace(Transformer):
+        """Per-minibatch pacing so the run outlives the detection window
+        (the drill's clock is the peer-lost threshold, not the model)."""
+
+        def __init__(self, seconds):
+            self.seconds = seconds
+
+        def __call__(self, it):
+            import time
+            for x in it:
+                if self.seconds:
+                    time.sleep(self.seconds)
+                yield x
+
+    ds = (DataSet.rdd(samples)
+          .transform(SampleToMiniBatch(args.batch, drop_last=True))
+          .transform(Pace(args.pace)))
+    # identical epoch order for the faulted and clean runs: post-resume
+    # bit-identity is the acceptance bound, and dataset shuffle RNGs are
+    # per-instance (not in the snapshot) — so the drill pins the order
+    ds.shuffle = lambda: None
+
+    opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), ds,
+                     nn.CrossEntropyCriterion())
+           .set_optim_method(Adam(1e-2))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    out = {"rank": args.rank, "recovered": False}
+    if args.resume_neval:
+        # clean world-1 comparison: resume from the negotiated entry, no
+        # new checkpoints (the lineage under test must stay untouched)
+        opt.resume_from(os.path.join(args.ckpt_dir,
+                                     f"model.{args.resume_neval}"),
+                        os.path.join(args.ckpt_dir,
+                                     f"optimMethod.{args.resume_neval}"))
+    else:
+        opt.set_checkpoint(args.ckpt_dir, Trigger.several_iteration(1))
+    trained = opt.optimize()
+    plan = getattr(opt, "_elastic_plan", None)
+    if plan is not None:
+        out.update(recovered=True, neval_resumed=plan.neval,
+                   world_after=Engine.world(),
+                   batch_after=opt._find_batchers(opt.dataset)[0].batch_size)
+    out["loss"] = float(opt.optim_method.hyper["loss"])
+    out["finite"] = bool(all(np.all(np.isfinite(np.asarray(leaf)))
+                             for leaf in
+                             __import__("jax").tree.leaves(trained.params)))
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _spawn(args, rank: int, extra_env: dict, worker_args: list):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("BIGDL_TPU_ELASTIC", "BIGDL_TPU_CHAOS",
+                                "BIGDL_TPU_TRACE", "BIGDL_TPU_SUPERVISE"))}
+    env.update({"PYTHONPATH": _REPO_ROOT,
+                "JAX_PLATFORMS": args.platform or "cpu",
+                "BIGDL_TPU_PREFETCH_DEPTH": "0",  # sync data path: the
+                # faulted and clean runs must be bit-comparable
+                **extra_env})
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--rank", str(rank), *worker_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _last_json(out: str):
+    lines = [l for l in out.splitlines() if l.startswith("{")]
+    return json.loads(lines[-1]) if lines else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--pace", type=float, default=0.05)
+    ap.add_argument("--resume-neval", type=int, default=0)
+    ap.add_argument("--lost-iter", type=int, default=3,
+                    help="epoch-1 iteration at which rank 1 dies "
+                         "(chaos host.lost@1=exit@1:N)")
+    ap.add_argument("--peer-lost", type=float, default=0.8)
+    ap.add_argument("--timeout", type=int, default=240)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return _worker(args)
+
+    base = args.ckpt_dir or tempfile.mkdtemp(prefix="elastic_smoke_")
+    cleanup = args.ckpt_dir is None
+    ckpt = os.path.join(base, "ckpt")
+    trace = os.path.join(base, "trace")
+    os.makedirs(ckpt, exist_ok=True)
+    out = {"metric": "elastic_smoke", "recovered": False,
+           "loss_match": False, "elastic_events": []}
+    try:
+        wargs = ["--ckpt-dir", ckpt, "--epochs", str(args.epochs),
+                 "--batch", str(args.batch), "--pace", str(args.pace)]
+        if args.platform:
+            wargs += ["--platform", args.platform]
+        common = {"BIGDL_TPU_ELASTIC_WORLD": "2",
+                  "BIGDL_TPU_ELASTIC_PEER_LOST": str(args.peer_lost),
+                  "BIGDL_TPU_SUPERVISE_PEER_STALE":
+                      str(args.peer_lost / 2),
+                  # a live phase deadline beside elasticity: recovery must
+                  # run under the 'checkpoint' phase, not trip this
+                  "BIGDL_TPU_SUPERVISE_STEP": "20"}
+        p0 = _spawn(args, 0, {**common, "BIGDL_TPU_ELASTIC_RANK": "0",
+                              "BIGDL_TPU_TRACE": trace}, wargs)
+        p1 = _spawn(args, 1, {**common, "BIGDL_TPU_ELASTIC_RANK": "1",
+                              "BIGDL_TPU_CHAOS":
+                                  f"host.lost@1=exit@1:{args.lost_iter}"},
+                    wargs)
+        out1, err1 = p1.communicate(timeout=args.timeout)
+        out0, err0 = p0.communicate(timeout=args.timeout)
+        out["rank1_rc"] = p1.returncode
+        out["rank0_rc"] = p0.returncode
+        if p1.returncode != LOST_EXIT:
+            out["error"] = (f"rank 1 exited {p1.returncode}, expected the "
+                            f"host-lost drill exit {LOST_EXIT}: "
+                            f"{err1[-1500:]}")
+            return 1
+        if p0.returncode != 0:
+            out["error"] = f"rank 0 failed: {err0[-2000:]}"
+            return 1
+        r0 = _last_json(out0)
+        if not r0 or not r0.get("recovered") or not r0.get("finite"):
+            out["error"] = f"rank 0 never ran elastic recovery: {r0}"
+            return 1
+        out.update(recovered=True, neval_resumed=r0["neval_resumed"],
+                   world_after=r0["world_after"],
+                   batch_after=r0["batch_after"], loss=r0["loss"])
+        if r0["world_after"] != 1 or \
+                r0["batch_after"] != 2 * args.batch:
+            out["error"] = ("shrink did not preserve the global batch: "
+                            f"{r0}")
+            return 1
+        # the survivor's trace must show the recovery next to the fault
+        events = set()
+        for tf in glob.glob(os.path.join(trace, "trace.*.json")):
+            try:
+                for ev in json.load(open(tf)).get("traceEvents", []):
+                    if str(ev.get("name", "")).startswith("elastic."):
+                        events.add(ev["name"])
+            except ValueError:
+                pass
+        out["elastic_events"] = sorted(events)
+        need = {"elastic.detect", "elastic.negotiate", "elastic.reform",
+                "elastic.resume"}
+        if not need <= events:
+            out["error"] = f"missing elastic trace events: {need - events}"
+            return 1
+        # clean world-1 run from the SAME lineage entry at the rescaled
+        # batch: final loss must match the recovered run bit-for-bit
+        cargs = ["--ckpt-dir", ckpt, "--epochs", str(args.epochs),
+                 "--batch", str(2 * args.batch), "--pace", "0",
+                 "--resume-neval", str(r0["neval_resumed"])]
+        if args.platform:
+            cargs += ["--platform", args.platform]
+        pc = _spawn(args, 0, {}, cargs)
+        outc, errc = pc.communicate(timeout=args.timeout)
+        if pc.returncode != 0:
+            out["error"] = f"clean run failed: {errc[-2000:]}"
+            return 1
+        rc = _last_json(outc)
+        out["clean_loss"] = rc["loss"]
+        out["loss_match"] = bool(abs(rc["loss"] - r0["loss"]) < 1e-9)
+        if not out["loss_match"]:
+            out["error"] = (f"recovered loss {r0['loss']!r} != clean "
+                            f"world-1 loss {rc['loss']!r}")
+            return 1
+        return 0
+    except subprocess.TimeoutExpired as e:
+        out["error"] = f"drill timed out: {e}"
+        for p in ("p0", "p1", "pc"):
+            proc = locals().get(p)
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        return 1
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        out["error"] = f"{type(e).__name__}: {e}"
+        return 1
+    finally:
+        print(json.dumps(out))
+        sys.stdout.flush()
+        if cleanup:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,21 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] conv-route A/B FAILED rc=$CONVRT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # elastic host-loss drill (cpu only): 2 subprocess ranks, chaos
+  # host.lost@1 kills rank 1 mid-epoch; rank 0 must detect the
+  # publication silence, negotiate the newest common lineage entry,
+  # shrink to world=1 with the global batch preserved, resume, and
+  # bit-match a clean world-1 run resumed from the same entry
+  echo "[runbook] 2i/4 elastic host-loss drill (detect -> negotiate -> re-form -> resume)" >> "$LOG"
+  timeout 420 python tools/elastic_smoke.py --platform cpu \
+    > /tmp/elastic_smoke.json 2>/tmp/elastic_smoke.log
+  ELASTIC_RC=$?
+  if [ "$ELASTIC_RC" = 0 ]; then
+    echo "[runbook] elastic drill OK (survivor shrank + loss matched) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] elastic drill FAILED rc=$ELASTIC_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -195,7 +210,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
