@@ -391,13 +391,26 @@ def _bench_config(name, build, peak_flops):
     policy = get_policy()
     Engine.reset()
     # per-CHIP numbers: bench on device 0 only, so flops/dt is divided by a
-    # single device's peak (a mesh over N devices would inflate MFU by N)
-    Engine.init(devices=[jax.devices()[0]])
+    # single device's peak (a mesh over N devices would inflate MFU by N).
+    # BIGDL_TPU_BENCH_LAYOUT="data,fsdp,tp" instead benches the config on a
+    # MeshLayout mesh with role-resolved FSDP/TP shardings
+    # (parallel/layout) — the per-device memory block below is where the
+    # 1/N footprint shows up in the trajectory.
+    layout_env = os.environ.get("BIGDL_TPU_BENCH_LAYOUT")
+    strategy = None
+    if layout_env:
+        from bigdl_tpu.parallel import LayoutSharding, MeshLayout
+        layout = MeshLayout.parse(layout_env)
+        Engine.set_mesh(layout.build_mesh())
+        strategy = LayoutSharding(model)
+    else:
+        Engine.init(devices=[jax.devices()[0]])
     mesh = Engine.mesh()
 
     model.build(jax.random.key(0))
     opt = Optimizer(model, dataset=None, criterion=criterion,
-                    end_trigger=Trigger.max_iteration(1))
+                    end_trigger=Trigger.max_iteration(1),
+                    strategy=strategy)
     opt.set_optim_method(SGD(learning_rate=lr, momentum=0.9))
     # perf knobs measured by bigdl_tpu.tools.bn_experiment: remat policy for
     # the timed step (BIGDL_TPU_BENCH_REMAT=conv_out|full) composes with the
@@ -442,6 +455,18 @@ def _bench_config(name, build, peak_flops):
     from bigdl_tpu.utils.timing import measure_step_seconds
     dt, timing = measure_step_seconds(
         run, log=lambda m: _log(f"{name}: {m}"), progress=_beat)
+    # per-device memory block (utils/memstats): runtime ledger (peak HBM)
+    # when the backend has one, live-buffer sum fallback on CPU — plus
+    # per-device param/slot bytes, where FSDP's 1/N footprint and
+    # donation's savings show up in the bench trajectory
+    from bigdl_tpu.utils import memstats
+    try:
+        memory = memstats.memory_record(box["params"], box["opt_state"])
+        if layout_env:
+            memory["layout"] = layout_env
+    except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
+        _log(f"{name}: memory stats failed: {type(e).__name__}: {e}")
+        memory = {"error": f"{type(e).__name__}: {e}"}
     # step-arithmetic attribution: the fused/bucket knobs the step was
     # traced with, plus the standalone (unoverlapped) gradient-wire
     # collective cost — 0.0 on this 1-chip mesh, measured on pod meshes —
@@ -449,7 +474,7 @@ def _bench_config(name, build, peak_flops):
     from bigdl_tpu.parallel import wire as _wire
     try:
         collective_s = _wire.measure_collective_seconds(
-            mesh, params, policy.wire_dtype)
+            mesh, params, policy.wire_dtype, axis=("data", "fsdp"))
     except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
         _log(f"{name}: collective probe failed: {type(e).__name__}: {e}")
         collective_s = None
@@ -471,7 +496,8 @@ def _bench_config(name, build, peak_flops):
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
-                        aot_cache=aot_rec, **step_arith, **e2e)
+                        aot_cache=aot_rec, memory=memory, **step_arith,
+                        **e2e)
 
 
 def _bench_resnet50_bf16_autotune(name, build, peak_flops):
@@ -594,10 +620,15 @@ def _bench_infer(name, build, peak_flops):
 
     dt, timing = measure_step_seconds(run, log=lambda m: _log(f"{name}: {m}"),
                                       progress=_beat)
+    from bigdl_tpu.utils import memstats
+    try:
+        memory = memstats.memory_record(params)
+    except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
+        memory = {"error": f"{type(e).__name__}: {e}"}
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
-                        mode="inference", aot_cache=aot_rec)
+                        mode="inference", aot_cache=aot_rec, memory=memory)
 
 
 def _bench_flash(name, build, peak_flops):

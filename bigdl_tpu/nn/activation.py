@@ -43,6 +43,8 @@ class ReLU6(Module):
 
 
 class PReLU(Module):
+
+    PARAM_ROLES = {"weight": "elementwise"}
     """Learnable leaky slope; n_output_plane=0 means one shared scalar
     (nn/PReLU.scala)."""
 

@@ -24,6 +24,10 @@ __all__ = ["MultiHeadAttention"]
 class MultiHeadAttention(Module):
     """Self-attention over [B, T, E] inputs."""
 
+    #: (E, E) projections are applied x @ w (in-major): kernel_in
+    PARAM_ROLES = {"wq": "kernel_in", "wk": "kernel_in", "wv": "kernel_in",
+                   "wo": "kernel_in", "*": "bias"}
+
     def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
                  seq_parallel: bool = False, seq_axis: str = "seq",
                  with_bias: bool = True):

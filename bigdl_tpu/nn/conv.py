@@ -104,6 +104,10 @@ class SpatialConvolution(Module):
     (nInputPlane, nOutputPlane, kW, kH, dW, dH, padW, padH, nGroup) signature.
     """
 
+    #: mesh-layout roles: HWIO kernels are tp-split on cout,
+    #: fsdp-sliced on cin (parallel/layout)
+    PARAM_ROLES = {"weight": "conv_kernel", "bias": "bias"}
+
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
@@ -277,6 +281,8 @@ class SpatialFullConvolution(Module):
     Output size: (in-1)*stride - 2*pad + kernel + adj.
     """
 
+    PARAM_ROLES = {"weight": "conv_kernel", "bias": "bias"}
+
     def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
                  stride_w=1, stride_h=1, pad_w=0, pad_h=0, adj_w=0, adj_h=0,
                  n_group=1, no_bias=False, w_regularizer=None, b_regularizer=None):
@@ -334,6 +340,8 @@ class TemporalConvolution(Module):
     ("NWC", "WIO", "NWC") so the MXU still sees a big matmul.
     """
 
+    PARAM_ROLES = {"weight": "conv_kernel", "bias": "bias"}
+
     def __init__(self, input_frame_size: int, output_frame_size: int,
                  kernel_w: int, stride_w: int = 1, propagate_back: bool = True,
                  w_regularizer=None, b_regularizer=None):
@@ -370,6 +378,8 @@ class TemporalConvolution(Module):
 class VolumetricConvolution(Module):
     """3-D convolution over (batch, depth, height, width, channels)
     (nn/VolumetricConvolution.scala; reference layout NCDHW → NDHWC here)."""
+
+    PARAM_ROLES = {"weight": "conv_kernel", "bias": "bias"}
 
     def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
                  d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,

@@ -52,6 +52,9 @@ class LookupTable(Module):
     (n_index, n_output) weight.  Indices are 0-based (reference is 1-based Torch;
     pass `one_based=True` for parity with reference data)."""
 
+    #: rows shard over fsdp x tp (the wide-embedding role, SNIPPETS.md [2])
+    PARAM_ROLES = {"weight": "embedding_row"}
+
     def __init__(self, n_index: int, n_output: int, padding_value: float = None,
                  max_norm: float = None, norm_type: float = 2.0,
                  should_scale_grad_by_freq: bool = False, one_based: bool = False,

@@ -26,6 +26,10 @@ __all__ = ["Linear", "Bilinear", "CMul", "CAdd", "Mul", "Add", "MulConstant",
 class Linear(Module):
     """y = x W^T + b, weight shape (out, in) as in the reference (nn/Linear.scala)."""
 
+    #: mesh-layout roles (parallel/layout): (out, in) weight is
+    #: column-parallel over tp, fsdp-sliced on the input axis
+    PARAM_ROLES = {"weight": "kernel_out", "bias": "bias"}
+
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  w_regularizer=None, b_regularizer=None):
         super().__init__()
@@ -61,6 +65,8 @@ class Linear(Module):
 class Bilinear(Module):
     """y_k = x1^T W_k x2 + b_k (nn/Bilinear.scala). Inputs: [x1, x2]."""
 
+    PARAM_ROLES = {"weight": "kernel_out", "bias": "bias"}
+
     def __init__(self, input_size1: int, input_size2: int, output_size: int,
                  bias_res: bool = True):
         super().__init__()
@@ -89,6 +95,8 @@ class Bilinear(Module):
 class CMul(Module):
     """Learnable per-element scale broadcast over the batch (nn/CMul.scala)."""
 
+    PARAM_ROLES = {"weight": "elementwise"}
+
     def __init__(self, size):
         super().__init__()
         self.size = tuple(size)
@@ -105,6 +113,8 @@ class CMul(Module):
 
 class CAdd(Module):
     """Learnable per-element bias (nn/CAdd.scala)."""
+
+    PARAM_ROLES = {"bias": "elementwise"}
 
     def __init__(self, size):
         super().__init__()
@@ -123,6 +133,8 @@ class CAdd(Module):
 class Mul(Module):
     """Single learnable scalar gain (nn/Mul.scala)."""
 
+    PARAM_ROLES = {"weight": "scalar"}
+
     def _init(self, rng):
         return {"weight": jax.random.uniform(rng, (), jnp.float32, -1.0, 1.0)}
 
@@ -132,6 +144,8 @@ class Mul(Module):
 
 class Add(Module):
     """Learnable bias vector over the feature dim (nn/Add.scala)."""
+
+    PARAM_ROLES = {"bias": "bias"}
 
     def __init__(self, input_size: int):
         super().__init__()

@@ -111,6 +111,8 @@ class Abs(Module):
 
 
 class Scale(Module):
+
+    PARAM_ROLES = {"weight": "elementwise", "bias": "elementwise"}
     """CMul then CAdd with learnable per-channel weight/bias (nn/Scale.scala)."""
 
     def __init__(self, size):
@@ -156,6 +158,8 @@ class MV(Module):
 
 
 class Cosine(Module):
+
+    PARAM_ROLES = {"weight": "kernel_out"}
     """Cosine similarity of input rows to each of `output_size` learned anchors
     (nn/Cosine.scala)."""
 
@@ -176,6 +180,8 @@ class Cosine(Module):
 
 
 class Euclidean(Module):
+
+    PARAM_ROLES = {"weight": "kernel_out"}
     """Euclidean distance of input rows to learned centers (nn/Euclidean.scala)."""
 
     def __init__(self, input_size: int, output_size: int,

@@ -66,6 +66,15 @@ def _tree_zeros_like(a):
 class Module:
     """Base class for all layers (BigDL: AbstractModule, abstractnn/AbstractModule.scala:54)."""
 
+    #: parameter-name -> role string for the mesh-layout assigner
+    #: (parallel/layout.py): modules declare WHAT each parameter is
+    #: ("kernel_out", "embedding_row", "bias", ...) and the canonical
+    #: role table decides how it shards over the data/fsdp/tp mesh.
+    #: None (the default) = unannotated — the assigner fails loudly on
+    #: such leaves instead of silently replicating them.  "*" is a
+    #: wildcard entry covering every remaining name.
+    PARAM_ROLES = None
+
     def __init__(self):
         self.name = f"{type(self).__name__}_{next(_uid_counter)}"
         self.training_mode: bool = True
@@ -132,6 +141,13 @@ class Module:
 
     def has_params(self) -> bool:
         return len(jax.tree.leaves(self.init(jax.random.key(0))[0])) > 0
+
+    def param_roles(self):
+        """name -> role map for THIS module's own parameters (see
+        PARAM_ROLES; containers are never asked — the layout assigner
+        recurses into their children instead, and parameter-free
+        modules have no leaves to resolve).  None = unannotated."""
+        return self.PARAM_ROLES
 
     # ------------------------------------------------------------------
     # stateful facade (Torch-style API parity)

@@ -92,6 +92,8 @@ _fused_bn_train.defvjp(_fused_bn_fwd_res, _fused_bn_bwd)
 
 
 class BatchNormalization(Module):
+
+    PARAM_ROLES = {"weight": "norm_scale", "bias": "norm_scale"}
     """BN over the last (feature) axis; all leading axes are reduction axes.
 
     Reference: nn/BatchNormalization.scala (eps/momentum/affine semantics,
@@ -307,6 +309,8 @@ class SpatialBatchNormalization(BatchNormalization):
 
 
 class LayerNorm(Module):
+
+    PARAM_ROLES = {"weight": "norm_scale", "bias": "norm_scale"}
     """Layer normalization over the last axis (net-new vs the 2017
     reference — required by the transformer/long-context capability,
     SURVEY.md §7; companion to nn/attention.MultiHeadAttention).  Stats in

@@ -100,6 +100,9 @@ def _project(xs, w):
 
 
 class RnnCell(Cell):
+
+    PARAM_ROLES = {"w_ih": "kernel_in", "w_hh": "kernel_in",
+                   "bias": "bias"}
     """Vanilla RNN: h' = act(W x + U h + b) (reference: nn/RNN.scala RnnCell)."""
 
     def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh):
@@ -130,6 +133,8 @@ class RnnCell(Cell):
 
 
 class LSTM(Cell):
+
+    PARAM_ROLES = {"kernel": "kernel_in", "bias": "bias"}
     """LSTM cell (reference: nn/LSTM.scala).  The four gate projections are
     fused into one (in+hidden, 4*hidden) kernel; under Recurrent's scan the
     x-half is hoisted out as one big (T*B, in) gemm and each step runs only
@@ -176,6 +181,10 @@ class LSTM(Cell):
 
 
 class LSTMPeephole(Cell):
+
+    PARAM_ROLES = {"kernel": "kernel_in", "bias": "bias",
+                   "peep_i": "elementwise", "peep_f": "elementwise",
+                   "peep_o": "elementwise"}
     """LSTM with peephole connections (reference: nn/LSTMPeephole.scala):
     gates also see the cell state through diagonal weights."""
 
@@ -224,6 +233,9 @@ class LSTMPeephole(Cell):
 
 
 class GRU(Cell):
+
+    PARAM_ROLES = {"gate_kernel": "kernel_in", "gate_bias": "bias",
+                   "cand_kernel": "kernel_in", "cand_bias": "bias"}
     """GRU cell (reference: nn/GRU.scala). Reset/update gates fused in one gemm."""
 
     def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
@@ -273,6 +285,10 @@ class GRU(Cell):
 
 
 class ConvLSTMPeephole(Cell):
+
+    PARAM_ROLES = {"kernel": "conv_kernel", "bias": "bias",
+                   "peep_i": "elementwise", "peep_f": "elementwise",
+                   "peep_o": "elementwise"}
     """Convolutional LSTM with peepholes over NHWC maps
     (reference: nn/ConvLSTMPeephole.scala)."""
 

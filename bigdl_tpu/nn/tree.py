@@ -50,6 +50,10 @@ class TreeLSTM(Module):
 
 
 class BinaryTreeLSTM(TreeLSTM):
+
+    PARAM_ROLES = {"leaf_c": "kernel_in", "leaf_o": "kernel_in",
+                   "comp_w": "kernel_in", "leaf_cb": "bias",
+                   "leaf_ob": "bias", "comp_b": "bias"}
     """Binary constituency TreeLSTM (reference: nn/BinaryTreeLSTM.scala).
 
     Leaf:      c = W_leaf x,            h = o * tanh(c), o = sigm(O_leaf x)

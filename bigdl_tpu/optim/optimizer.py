@@ -669,8 +669,19 @@ class Optimizer:
                 lambda b: jax.lax.with_sharding_constraint(b, fused_sh))
         else:
             fused_constraint = None
+        # buffer donation (ROADMAP item 1): params, net_state, and
+        # optimizer slots are donated to the compiled step so XLA updates
+        # them IN PLACE — peak HBM drops by roughly a full model+slots
+        # copy, which is what lets FSDP shard sizes translate into bigger
+        # trainable models.  BIGDL_TPU_NO_DONATE=1 is the correctness
+        # debug knob: it disables donation (the step allocates fresh
+        # outputs) with bit-identical results — if a run behaves
+        # differently under it, something is reading a donated buffer
+        # after the step (tests/test_layout.py pins the parity).
+        donate = () if _config.get_bool("NO_DONATE", False) else (0, 1, 2)
         self._step_knobs = {"fused_update": bool(use_fused),
-                            "wire_bucket_mb": bucket_mb}
+                            "wire_bucket_mb": bucket_mb,
+                            "donate": bool(donate)}
 
         remat = self.remat_policy
 
@@ -773,7 +784,7 @@ class Optimizer:
             in_shardings=(param_sh, rep, opt_sh, data_sh, data_sh,
                           None, None),
             out_shardings=(param_sh, rep, opt_sh, None),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=donate,
         )
 
         # AOT executable cache (utils/aot.py, BIGDL_TPU_AOT_CACHE): with a
@@ -888,7 +899,8 @@ class Optimizer:
         self._collective_s = 0.0
         try:
             self._collective_s = wire_mod.measure_collective_seconds(
-                mesh, self.model.params, get_policy().wire_dtype)
+                mesh, self.model.params, get_policy().wire_dtype,
+                axis=self.strategy.batch_axes(mesh))
             if self._collective_s:
                 logger.info("collective counter armed: %.6fs standalone "
                             "gradient all-reduce (wire=%s, bucket_mb=%s)",
@@ -1888,7 +1900,13 @@ class _ShardedForward:
         if (self._placed is None or self._placed[0] is not mesh or
                 self._placed_src is not model.params):
             rep = NamedSharding(mesh, P())
-            params = jax.device_put(model.params, rep)
+            # params place under the STRATEGY's shardings (DataParallel =
+            # replicated, unchanged; LayoutSharding = the same per-role
+            # FSDP/TP shards training uses) — sharded SERVING is what
+            # lets a model too big for one chip answer through the same
+            # bucket ladder (ROADMAP item 4 prerequisite)
+            param_sh = self.strategy.param_sharding(mesh, model.params)
+            params = jax.device_put(model.params, param_sh)
             net_state = jax.device_put(model.state, rep)
             self._placed = (mesh, params, net_state)
             self._placed_src = model.params
@@ -1897,9 +1915,9 @@ class _ShardedForward:
         return self._placed
 
     def dp_size(self) -> int:
-        mesh = Engine.mesh()
-        axis = Engine.DATA_AXIS
-        return mesh.shape[axis] if axis in mesh.axis_names else 1
+        # the padding multiple: how many ways the strategy splits the
+        # batch rows (data, and fsdp on MeshLayout meshes)
+        return self.strategy.batch_shard_count(Engine.mesh())
 
     def __call__(self, inp):
         """Pad batch dim to a multiple of the data axis, forward sharded,

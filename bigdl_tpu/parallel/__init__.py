@@ -2,7 +2,10 @@
 
 The reference's only strategy is sync data-parallel SGD over the Spark block
 manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7):
-- sharding: DataParallel / ShardedDataParallel (ZeRO) / TensorParallel specs
+- layout: MeshLayout (named data/fsdp/tp axes) + the canonical per-role
+  PartitionSpec table and module-annotation assigner (docs/parallelism.md)
+- sharding: DataParallel / ShardedDataParallel (ZeRO) / TensorParallel /
+  LayoutSharding specs
 - ring_attention: sequence/context parallelism (shard_map + ppermute ring)
 - ulysses_attention: all-to-all sequence parallelism
 - pipeline: GPipe-style microbatched stage parallelism
@@ -11,8 +14,10 @@ manager (SURVEY.md §2.5); TP/SP/PP here are net-new TPU capabilities (§7):
   re-form -> resume; docs/robustness.md "Elasticity")
 """
 
+from .layout import (MeshLayout, UnannotatedParameterError, MeshReformError,
+                     assign_specs, assign_shardings)
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
-                       TensorParallel)
+                       TensorParallel, LayoutSharding)
 from .ring_attention import ring_attention, ulysses_attention
 from .pipeline import pipeline_apply, stack_stage_params
 from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
@@ -20,7 +25,9 @@ from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
 from .elastic import PeerLostError, ElasticNegotiationError
 
 __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
-           "TensorParallel", "ring_attention", "ulysses_attention",
+           "TensorParallel", "LayoutSharding", "MeshLayout",
+           "UnannotatedParameterError", "MeshReformError", "assign_specs",
+           "assign_shardings", "ring_attention", "ulysses_attention",
            "pipeline_apply", "stack_stage_params", "MoEFFN",
            "expert_parallel_ffn", "top_k_routing", "load_balancing_loss",
            "PeerLostError", "ElasticNegotiationError"]

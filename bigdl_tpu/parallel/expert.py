@@ -120,6 +120,9 @@ class MoEFFN(Module):
     capacity_factor: C = ceil(k * T / E * capacity_factor).
     """
 
+    PARAM_ROLES = {"gate": "kernel_in", "w1": "kernel_in",
+                   "w2": "kernel_in", "b1": "bias", "b2": "bias"}
+
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  k: int = 1, capacity_factor: float = 1.25,
                  expert_axis: Optional[str] = None):

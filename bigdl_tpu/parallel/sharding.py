@@ -16,6 +16,10 @@ Strategies:
   slice", DistriOptimizer.scala:265-280).
 - TensorParallel (net-new vs reference, SURVEY.md §7): large Linear/conv layers
   split over the 'model' axis by a rule table keyed on parameter path.
+- LayoutSharding: the MeshLayout-era strategy (parallel/layout.py) — params
+  resolve to per-ROLE PartitionSpecs over the named data/fsdp/tp axes, so
+  FSDP (1/N params+slots over 'fsdp') and tensor parallelism (wide layers
+  over 'tp') compose with the data axis in one compiled program.
 """
 
 from __future__ import annotations
@@ -26,8 +30,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import layout as layout_mod
+
 __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
-           "TensorParallel"]
+           "TensorParallel", "LayoutSharding"]
+
+#: mesh axes a batch may shard over, in order: 'data' always; 'fsdp' is a
+#: second data axis on MeshLayout meshes (each fsdp group sees different
+#: rows — that is what turns parameter sharding into a memory win)
+BATCH_AXES = ("data", "fsdp")
 
 
 class ShardingStrategy:
@@ -36,9 +47,20 @@ class ShardingStrategy:
     def param_sharding(self, mesh: Mesh, params):
         raise NotImplementedError
 
+    def batch_axes(self, mesh: Mesh) -> tuple:
+        return tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+
+    def batch_shard_count(self, mesh: Mesh) -> int:
+        """How many ways the batch dimension is split (the padding
+        multiple inference/eval must round batches up to)."""
+        n = 1
+        for a in self.batch_axes(mesh):
+            n *= int(mesh.shape[a])
+        return n
+
     def batch_sharding(self, mesh: Mesh):
-        axes = [a for a in ("data",) if a in mesh.axis_names]
-        # batch dim sharded over the data axis; everything else replicated
+        axes = self.batch_axes(mesh)
+        # batch dim sharded over the data axes; everything else replicated
         return NamedSharding(mesh, P(tuple(axes) if axes else None))
 
     def fused_buffer_spec(self, mesh: Mesh):
@@ -128,10 +150,14 @@ class ShardedDataParallel(ShardingStrategy):
         self.min_size = min_size
 
     def fused_buffer_spec(self, mesh):
-        # fused update buffers shard over 'data' (uneven sizes are fine —
-        # GSPMD pads the last shard), keeping the ZeRO memory claim intact
-        if mesh.shape.get("data", 1) > 1:
-            return P("data")
+        # fused update buffers shard over the batch axes (uneven sizes are
+        # fine — GSPMD pads the last shard), keeping the ZeRO memory claim
+        # intact; on a MeshLayout mesh that is ('data','fsdp') so the 1-D
+        # buffers stay 1/(data*fsdp)
+        axes = tuple(a for a in self.batch_axes(mesh)
+                     if mesh.shape.get(a, 1) > 1)
+        if axes:
+            return P(axes)
         return None
 
     def param_sharding(self, mesh, params):
@@ -183,3 +209,61 @@ class TensorParallel(ShardingStrategy):
     def batch_sharding(self, mesh):
         axes = [a for a in ("data",) if a in mesh.axis_names]
         return NamedSharding(mesh, P(tuple(axes) if axes else None))
+
+
+class LayoutSharding(ShardingStrategy):
+    """Role-resolved sharding over a MeshLayout's data/fsdp/tp axes.
+
+    The strategy holds the MODEL (roles live on modules, not on the
+    params pytree) and resolves every param leaf through the canonical
+    role table (parallel/layout.assign_shardings): FSDP shards each
+    annotated leaf 1/N over 'fsdp' (all-gathered by GSPMD at use, the
+    gradients reduce-scattered back), TP splits wide Linear/LookupTable
+    axes over 'tp', and the batch shards over data x fsdp.  On a
+    ``(W,1,1)`` layout — or a legacy ('data',)-only mesh — every leaf
+    replicates and the batch shards over 'data': exactly DataParallel,
+    so one strategy covers the whole ladder down to single-device CPU.
+
+    Optimizer slots inherit the param shardings leaf-for-leaf through
+    the base ``opt_state_sharding`` (what turns 1/N params into 1/N
+    params+slots), ``remap`` re-derives every leaf for a post-reform
+    mesh (elastic), and ``fused_buffer_spec`` keeps the fused-update /
+    wire-bucket 1-D buffers sharded so neither fusion path
+    (BIGDL_TPU_FUSED_UPDATE / _WIRE_BUCKET_MB) resurrects a replicated
+    copy.
+    """
+
+    def __init__(self, model, layout: Optional["layout_mod.MeshLayout"] = None,
+                 min_size: Optional[int] = None):
+        self.model = model
+        self.layout = layout
+        self.min_size = min_size
+
+    def _layout_for(self, mesh):
+        # the MESH is the live topology (an elastic reform may have
+        # shrunk the data axis since construction) — a layout passed at
+        # construction only covers legacy meshes without canonical axes
+        return layout_mod.MeshLayout.of_mesh(mesh) or self.layout
+
+    def param_sharding(self, mesh, params):
+        return layout_mod.assign_shardings(
+            self.model, params, mesh, layout=self._layout_for(mesh),
+            min_size=self.min_size)
+
+    def batch_sharding(self, mesh):
+        lay = self._layout_for(mesh)
+        if lay is None:
+            return super().batch_sharding(mesh)
+        spec = lay.batch_spec()
+        axes = tuple(a for a in spec[0] if a in mesh.axis_names)
+        return NamedSharding(mesh, P(axes if axes else None))
+
+    def fused_buffer_spec(self, mesh):
+        # 1-D fused buffers cannot keep per-role axes; shard them over
+        # 'fsdp' (the memory-bearing axis) so fused updates / wire
+        # buckets stay 1/N_fsdp.  data stays out: params are replicated
+        # across data here (unlike ZeRO), and the per-leaf path keeps
+        # them so — the fused path must not change placement semantics.
+        if mesh.shape.get("fsdp", 1) > 1:
+            return P("fsdp")
+        return None

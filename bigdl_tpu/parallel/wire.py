@@ -112,7 +112,7 @@ def wire_cast(grads, wire, bucket_mb: Optional[float] = None,
 
 def measure_collective_seconds(mesh: Mesh, params, wire,
                                bucket_mb: Optional[float] = None,
-                               axis: str = "data", iters: int = 3) -> float:
+                               axis="data", iters: int = 3) -> float:
     """Measured wall seconds of the gradient wire's collective, standalone.
 
     Builds wire-dtype buffers matching the grad tree's bucket layout, each
@@ -123,9 +123,16 @@ def measure_collective_seconds(mesh: Mesh, params, wire,
     collective exists).  This is the UNOVERLAPPED cost: compare it against
     the measured step time (`collective_fraction`) to see whether the
     scheduler hid it."""
-    dp = mesh.shape.get(axis, 1)
+    # `axis` may be one name or a tuple (a MeshLayout mesh reduces
+    # gradients over data x fsdp — the strategy's batch axes)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    axes = tuple(a for a in axes if a in mesh.axis_names)
+    dp = 1
+    for a in axes:
+        dp *= int(mesh.shape[a])
     if dp <= 1:
         return 0.0
+    axis = axes if len(axes) > 1 else axes[0]
     wire = wire or jnp.float32
     sizes = [int(leaf.size) for leaf in jax.tree.leaves(params)]
     if not sizes:

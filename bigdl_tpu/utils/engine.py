@@ -313,14 +313,45 @@ class Engine:
         if devices is not None:
             devs = list(devices)
             if cls._mesh is not None and len(cls._mesh.axis_names) > 1:
-                raise NotImplementedError(
-                    "Engine.reform(devices=...) re-forms 1-D data meshes "
-                    "only; rebuild multi-axis layouts via Engine.init")
-            cls.set_mesh(Mesh(np.array(devs), (cls.DATA_AXIS,)))
+                cls.set_mesh(cls._shrink_data_axis(cls._mesh, devs))
+            else:
+                cls.set_mesh(Mesh(np.array(devs), (cls.DATA_AXIS,)))
         cls._elastic = {"rank": rank, "survivors": survivors}
         logger.warning("Engine.reform: world -> %d (rank %d, survivors %s)",
                        len(survivors), rank, list(survivors))
         return cls.mesh()
+
+    @classmethod
+    def _shrink_data_axis(cls, mesh: Mesh, devs) -> Mesh:
+        """Re-form a MULTI-AXIS mesh over a surviving device slice by
+        shrinking the 'data' axis and keeping every other axis (the
+        fsdp x tp block of a MeshLayout) intact.  When the survivor
+        count is not a multiple of the non-data block — the fsdp/tp
+        groups cannot be preserved — this raises the typed
+        MeshReformError instead of silently re-laying-out sharded
+        parameters (parallel/layout; drilled by tests/test_layout.py)."""
+        from ..parallel.layout import MeshReformError
+        names = tuple(mesh.axis_names)
+        if cls.DATA_AXIS not in names:
+            raise MeshReformError(
+                f"cannot re-form mesh {dict(mesh.shape)} over "
+                f"{len(devs)} surviving device(s): no '{cls.DATA_AXIS}' "
+                "axis to shrink — rebuild the layout via Engine.init")
+        sizes = [int(mesh.shape[a]) for a in names]
+        di = names.index(cls.DATA_AXIS)
+        block = int(np.prod([s for i, s in enumerate(sizes) if i != di]))
+        if len(devs) < block or len(devs) % block:
+            raise MeshReformError(
+                f"cannot re-form mesh {dict(mesh.shape)} over "
+                f"{len(devs)} surviving device(s): the non-data block "
+                f"({ {a: s for i, (a, s) in enumerate(zip(names, sizes)) if i != di} }"
+                f" = {block} devices) must divide the survivor count to "
+                "keep fsdp/tp shard groups intact; shrink to a multiple "
+                f"of {block} devices or re-init a smaller layout")
+        sizes[di] = len(devs) // block
+        logger.warning("Engine.reform: mesh %s -> %s over %d device(s)",
+                       dict(mesh.shape), dict(zip(names, sizes)), len(devs))
+        return Mesh(np.array(devs).reshape(sizes), names)
 
     # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
 

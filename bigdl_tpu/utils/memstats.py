@@ -1,0 +1,112 @@
+"""Device-memory accounting for the bench/smoke trajectory.
+
+FSDP's whole value proposition is a MEMORY number — per-device
+parameter+slot bytes dropping to ~1/N — and donation's is a PEAK number
+(no second params+slots copy alive during the update).  Neither shows
+up in images/sec, so bench.py records them explicitly in every
+per-config record (satellite of ISSUE 9):
+
+- :func:`device_memory_stats` — the accelerator runtime's own ledger
+  (``device.memory_stats()``: ``bytes_in_use`` / ``peak_bytes_in_use``
+  on TPU/GPU plugins).  Returns None where the backend has no ledger
+  (CPU), in which case callers fall back to
+- :func:`live_device_bytes` — the live-buffer sum: every
+  ``jax.live_arrays()`` leaf's addressable shards on one device.  No
+  peak semantics, but deltas across a step still show donation working
+  (a donated step leaves no second copy alive).
+- :func:`tree_device_bytes` — one pytree's bytes on one device: the
+  per-device parameter (or slot) footprint, == total/N under an FSDP=N
+  layout and == total when replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["device_memory_stats", "live_device_bytes", "tree_device_bytes",
+           "tree_total_bytes", "memory_record"]
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """``device.memory_stats()`` where the backend implements it, else
+    None (CPU devices raise/return nothing useful)."""
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — unimplemented on this backend
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return dict(stats)
+
+
+def _shard_bytes_on(leaf, device) -> int:
+    """Bytes leaf `leaf` occupies on `device` (0 when absent there)."""
+    if not hasattr(leaf, "addressable_shards"):
+        return 0
+    total = 0
+    for s in leaf.addressable_shards:
+        if s.device == device:
+            total += int(s.data.nbytes)
+    return total
+
+
+def live_device_bytes(device=None) -> int:
+    """Sum of all live jax.Array bytes resident on one device — the
+    CPU-measurable stand-in for ``bytes_in_use``.  Deleted (donated)
+    buffers are not live, so a donated train step shows here as NOT
+    doubling params+slots."""
+    dev = device or jax.devices()[0]
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            total += _shard_bytes_on(arr, dev)
+        except Exception:  # noqa: BLE001 — a concurrently deleted array
+            continue
+    return total
+
+
+def tree_device_bytes(tree, device=None) -> int:
+    """One pytree's bytes on one device (per-device param/slot
+    footprint: total/N under FSDP=N, total when replicated)."""
+    dev = device or jax.devices()[0]
+    return sum(_shard_bytes_on(leaf, dev) for leaf in jax.tree.leaves(tree)
+               if hasattr(leaf, "addressable_shards"))
+
+
+def tree_total_bytes(tree) -> int:
+    """The tree's LOGICAL size (global bytes, sharding-independent)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None and hasattr(leaf, "size"):
+            nbytes = int(leaf.size) * leaf.dtype.itemsize
+        total += int(nbytes or 0)
+    return total
+
+
+def memory_record(params=None, opt_state=None, device=None) -> dict:
+    """The bench-record memory block: runtime ledger when available
+    (``source: memory_stats``), live-buffer sum fallback
+    (``source: live_buffer_sum``), plus per-device and total bytes for
+    the given params/opt_state trees."""
+    dev = device or jax.devices()[0]
+    rec: dict = {}
+    stats = device_memory_stats(dev)
+    if stats is not None:
+        rec["source"] = "memory_stats"
+        rec["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+        if "peak_bytes_in_use" in stats:
+            rec["peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+    else:
+        rec["source"] = "live_buffer_sum"
+        rec["bytes_in_use"] = live_device_bytes(dev)
+    if params is not None:
+        rec["param_bytes_per_device"] = tree_device_bytes(params, dev)
+        rec["param_bytes_total"] = tree_total_bytes(params)
+    if opt_state is not None:
+        rec["slot_bytes_per_device"] = tree_device_bytes(opt_state, dev)
+        rec["slot_bytes_total"] = tree_total_bytes(opt_state)
+    return rec

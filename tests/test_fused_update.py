@@ -375,4 +375,5 @@ def test_step_knobs_recorded(monkeypatch):
                     end_trigger=Trigger.max_iteration(1))
     opt.set_optim_method(SGD(0.1))
     opt._build_step(Engine.mesh())
-    assert opt._step_knobs == {"fused_update": True, "wire_bucket_mb": 4.0}
+    assert opt._step_knobs == {"fused_update": True, "wire_bucket_mb": 4.0,
+                               "donate": True}

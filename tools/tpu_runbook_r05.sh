@@ -183,6 +183,20 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] elastic drill FAILED rc=$ELASTIC_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # mesh-layout smoke (cpu only): 4 virtual devices, 5-step MLP under
+  # (2,2,1) and (1,2,2) layouts — per-device param bytes must hit the
+  # 1/fsdp and 1/(fsdp*tp) shard fractions and the loss sequence must
+  # match pure data parallelism within the documented tolerance
+  echo "[runbook] 2j/4 mesh-layout smoke (FSDP/TP shard fractions + DP parity)" >> "$LOG"
+  timeout 300 python tools/shard_smoke.py \
+    > /tmp/shard_smoke.json 2>/tmp/shard_smoke.log
+  SHARD_RC=$?
+  if [ "$SHARD_RC" = 0 ]; then
+    echo "[runbook] shard smoke OK (1/N footprint + DP parity) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] shard smoke FAILED rc=$SHARD_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
