@@ -834,11 +834,13 @@ def main(argv=None):
                          "jax backend, so it is immune to the "
                          "jax.devices() tunnel hang (BENCH_r05.json)")
     ap.add_argument("--serve", action="store_true",
-                    help="online-serving mode: closed- and open-loop load "
-                         "against the serve/ subsystem (dynamic batcher + "
-                         "replica pool) on the LeNet forward — reports "
-                         "requests/s, latency p50/p95/p99, batch fill and "
-                         "shed rate as ONE JSON line")
+                    help="online-serving mode: closed-loop, open-loop and "
+                         "bursty traffic-storm load against the serve/ "
+                         "subsystem (dynamic batcher + replica pool) on "
+                         "the LeNet forward — reports requests/s, latency "
+                         "p50/p95/p99, batch fill, shed rate and per-"
+                         "priority-class storm shed rates as ONE JSON "
+                         "line")
     ap.add_argument("--fused", action="store_true",
                     help="arm the fused train-step arithmetic for this "
                          "run: multi-tensor optimizer update "
@@ -1144,7 +1146,12 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
         throughput against a deliberately small queue + tight deadline,
         so admission (ServerOverloaded) and deadline (RequestTimeout)
         shedding actually engage — the shed rate and served-tail latency
-        are the report.  The record lands alongside the e2e training
+        are the report;
+      traffic storm — bursty arrivals (back-to-back bursts, idle gaps)
+        across three priority classes against a tiny queue, reporting
+        shed rate BY CLASS: the priority-aware-admission measurement
+        (higher classes evict lower ones from a full queue,
+        serve/batcher.py).  The record lands alongside the e2e training
         records in the bench JSON family (runbook stage 2f)."""
     import numpy as np
 
@@ -1251,6 +1258,61 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
                  **_percentiles(open_lat),
                  "batch_fill": open_stats["batch_fill"]}
 
+    # -- traffic storm --------------------------------------------------
+    # bursty open loop against a deliberately tiny queue, requests spread
+    # over three priority classes (2 = interactive, 1 = standard, 0 =
+    # batch/best-effort): each burst slams `burst_n` back-to-back
+    # arrivals (no pacing) then goes idle — the diurnal-peak shape at
+    # 10-100x replay speed.  Under pressure the batcher sheds the
+    # LOWEST-priority queued request first (priority eviction,
+    # serve/batcher.py), so the report is shed rate BY CLASS: the
+    # priority-awareness measurement, not just a scalar shed rate.
+    _beat("serve:storm")
+    bursts = 4
+    burst_n = min(max(requests // 4, 12), 96)
+    by_prio = {p: {"offered": 0, "served": 0, "shed_overload": 0,
+                   "shed_timeout": 0} for p in (0, 1, 2)}
+    storm_lat = []
+    with InferenceServer(model, queue_limit=8,
+                         deadline_ms=max(deadline_ms, 20.0),
+                         example=sample) as server:
+        pending = []
+        for b in range(bursts):
+            for i in range(burst_n):
+                p = (0, 1, 2)[i % 3]
+                by_prio[p]["offered"] += 1
+                try:
+                    pending.append(
+                        (p, time.perf_counter(),
+                         server.submit(xs[i % len(xs)], priority=p,
+                                       tenant=f"class{p}")))
+                except ServerOverloaded:
+                    by_prio[p]["shed_overload"] += 1
+            time.sleep(0.05)  # inter-burst idle gap (the diurnal trough)
+        for p, t0, h in pending:
+            try:
+                h.result(120)
+                by_prio[p]["served"] += 1
+                storm_lat.append(time.perf_counter() - t0)
+            except ServerOverloaded:   # evicted for a higher class
+                by_prio[p]["shed_overload"] += 1
+            except Exception:  # noqa: BLE001 — deadline/typed: counted
+                by_prio[p]["shed_timeout"] += 1
+        storm_stats = server.stats()
+    for p, rec in by_prio.items():
+        sheds = rec["shed_overload"] + rec["shed_timeout"]
+        rec["shed_rate"] = round(sheds / rec["offered"], 4) \
+            if rec["offered"] else 0.0
+    offered = sum(r["offered"] for r in by_prio.values())
+    served = sum(r["served"] for r in by_prio.values())
+    storm = {"bursts": bursts, "burst_n": burst_n,
+             "offered": offered, "served": served,
+             "shed_rate": round(1.0 - served / offered, 4) if offered
+             else 0.0,
+             "by_priority": {str(p): by_prio[p] for p in sorted(by_prio)},
+             "shed_priority_evictions": storm_stats["shed_priority"],
+             **_percentiles(storm_lat)}
+
     out = {"metric": "serve_requests_per_sec", "value": closed_rps,
            "unit": "req/s", "vs_baseline": None, "mode": "serve",
            "model": type(model).__name__,
@@ -1258,6 +1320,7 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
            "buckets": list(server.batcher.buckets),
            "replicas": server.replicas,
            "closed_loop": closed, "open_loop": open_loop,
+           "storm": storm,
            "device": str(jax.devices()[0])}
     _flush_trace()
     print(json.dumps(out))

@@ -8,15 +8,24 @@ worker threads running the same mesh-sharded forward as bulk
 deadlines give typed load shedding (`ServerOverloaded`,
 `RequestTimeout`) instead of latency collapse; `swap()` hot-loads a new
 checkpoint version (optionally int8-quantized) with zero dropped
-requests.  See docs/serving.md.
+requests.  The control plane (serve/control.py) makes the pool
+self-healing: dead/silent replicas restart within a bounded budget,
+`swap(canary_fraction=...)` auto-promotes or auto-rolls-back a canary
+on a rolling p99/error comparison, and admission is tenant/priority
+aware (token-bucket quotas, shed-lowest-priority-first).  See
+docs/serving.md.
 """
 
 from .batcher import (DynamicBatcher, PendingRequest, RequestTimeout,
                       ServeError, ServerClosed, ServerOverloaded,
                       default_buckets, pad_rows, predict_in_fixed_batches)
+from .control import (CanaryController, CanaryRejected, QuotaExceeded,
+                      ReplicaLostError, ReplicaMonitor, TenantQuotas)
 from .server import InferenceServer, ModelVersion
 
 __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "PendingRequest", "ServeError", "ServerOverloaded",
-           "ServerClosed", "RequestTimeout", "default_buckets",
+           "ServerClosed", "RequestTimeout", "ReplicaLostError",
+           "CanaryRejected", "QuotaExceeded", "TenantQuotas",
+           "CanaryController", "ReplicaMonitor", "default_buckets",
            "pad_rows", "predict_in_fixed_batches"]

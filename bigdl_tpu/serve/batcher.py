@@ -20,11 +20,19 @@ This module is the host-side half of the serving subsystem
   and padded up to the bucket, so the device only ever sees shapes it
   has already compiled (warmed up at server start).
 - **Backpressure**: the queue is bounded (``queue_limit``); admission
-  past the bound raises :class:`ServerOverloaded` immediately — typed
-  rejection instead of unbounded latency collapse.
+  past the bound first sweeps queued requests whose deadline already
+  expired (dead slots must shed themselves, not fresh traffic), then
+  sheds the LOWEST-priority queued request if the arrival outranks it,
+  and only then raises :class:`ServerOverloaded` (carrying a
+  ``retry_after_s`` estimate) — typed, priority-aware rejection instead
+  of unbounded latency collapse.
 - **Deadlines**: a request carries an optional absolute deadline; one
   dequeued past it is shed with :class:`RequestTimeout` and never
   reaches the device (a request already executing completes normally).
+- **Priorities/tenants**: requests carry ``priority`` (higher = more
+  important, default 0) and an optional ``tenant`` tag; per-tenant
+  token-bucket quotas live one layer up (serve/control.py), the
+  shed-lowest-first policy lives here where the queue is.
 - The trailing-chunk padding trick UDFPredictor (serving.py) uses for
   bulk DataFrame calls lives here too (:func:`pad_rows`,
   :func:`predict_in_fixed_batches`) — one padding implementation for
@@ -54,9 +62,16 @@ class ServeError(RuntimeError):
 
 
 class ServerOverloaded(ServeError):
-    """Admission rejected: the bounded request queue is full.  The caller
-    should back off / retry against another replica pool — queueing more
-    would only grow everyone's latency (docs/serving.md decision tree)."""
+    """Admission rejected: the bounded request queue is full (or this
+    request was evicted from it for a higher-priority arrival).  The
+    caller should back off / retry against another replica pool —
+    queueing more would only grow everyone's latency (docs/serving.md
+    decision tree).  ``retry_after_s``, when set, estimates when the
+    queue will have drained (HTTP Retry-After in tools/serve_http.py)."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimeout(ServeError, TimeoutError):
@@ -78,14 +93,17 @@ class PendingRequest:
     server recorded (RequestTimeout / ServerOverloaded at dequeue /
     ChaosFault / StallError...)."""
 
-    __slots__ = ("payload", "enqueued", "deadline", "version", "latency_s",
-                 "_event", "_result", "_error")
+    __slots__ = ("payload", "enqueued", "deadline", "tenant", "priority",
+                 "version", "latency_s", "_event", "_result", "_error")
 
     def __init__(self, payload, enqueued: float,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 tenant: Optional[str] = None, priority: int = 0):
         self.payload = payload
         self.enqueued = enqueued
         self.deadline = deadline
+        self.tenant = tenant     # quota/accounting tag (control plane)
+        self.priority = int(priority)  # higher = shed later
         self.version = None      # model version id that answered
         self.latency_s = None    # enqueue -> resolve
         self._event = threading.Event()
@@ -199,28 +217,101 @@ class DynamicBatcher:
         self.submitted = 0
         self.shed_overload = 0
         self.shed_timeout = 0
+        self.shed_priority = 0      # evicted for a higher-priority arrival
+        self.shed_by_priority: dict = {}  # priority class -> total sheds
+        self._row_s_ema = None      # EMA service seconds/row (retry-after)
 
     # -- producers ------------------------------------------------------
 
-    def submit(self, payload, deadline: Optional[float] = None
-               ) -> PendingRequest:
+    def _count_shed(self, req: "PendingRequest") -> None:
+        # caller holds self._cond
+        self.shed_by_priority[req.priority] = \
+            self.shed_by_priority.get(req.priority, 0) + 1
+
+    def _sweep_expired_locked(self, now: float) -> List["PendingRequest"]:
+        """Drop queued requests whose deadline already passed (caller
+        holds the lock; resolution happens outside it).  A stale queue
+        must never hold ``queue_limit`` slots against fresh traffic —
+        the dead requests are shed, not the arrival."""
+        live, expired = collections.deque(), []
+        for r in self._q:
+            if r.deadline is not None and now > r.deadline:
+                expired.append(r)
+                self.shed_timeout += 1
+                self._count_shed(r)
+            else:
+                live.append(r)
+        self._q = live
+        return expired
+
+    def retry_after_s(self) -> float:
+        """Seconds a rejected caller should back off: the estimated time
+        to drain a full queue (EMA service rate from note_service), never
+        below the coalesce window."""
+        per_row = self._row_s_ema or 0.0
+        return round(max(per_row * self.queue_limit, self.max_wait_s,
+                         0.05), 3)
+
+    def note_service(self, rows: int, seconds: float) -> None:
+        """Feed the service-rate EMA (the server calls this after every
+        successful batch) powering the retry-after estimate."""
+        per = seconds / max(rows, 1)
+        self._row_s_ema = per if self._row_s_ema is None else \
+            0.8 * self._row_s_ema + 0.2 * per
+
+    def submit(self, payload, deadline: Optional[float] = None, *,
+               tenant: Optional[str] = None,
+               priority: int = 0) -> PendingRequest:
         """Enqueue one sample; raises :class:`ServerOverloaded` when the
         bounded queue is full, :class:`ServerClosed` after shutdown.
-        ``deadline`` is absolute (this batcher's clock)."""
+        ``deadline`` is absolute (this batcher's clock).  When the queue
+        is full, expired-deadline entries are swept first, then the
+        LOWEST-priority queued request is evicted if this arrival
+        strictly outranks it (shed-lowest-first under pressure)."""
         chaos.fire("serve.request")  # admission-path fault point
+        expired: List[PendingRequest] = []
+        victim: Optional[PendingRequest] = None
         with self._cond:
             if self._closed:
                 raise ServerClosed("serve: server is shutting down")
             if len(self._q) >= self.queue_limit:
-                self.shed_overload += 1
-                raise ServerOverloaded(
-                    f"serve: request queue full ({self.queue_limit} "
-                    "waiting) — shedding at admission")
-            req = PendingRequest(payload, self.clock(), deadline)
+                expired = self._sweep_expired_locked(self.clock())
+            if len(self._q) >= self.queue_limit:
+                # newest of the lowest-priority queued requests: it has
+                # waited least, so evicting it wastes the least work
+                cand = min(reversed(self._q), key=lambda r: r.priority)
+                if cand.priority < int(priority):
+                    self._q.remove(cand)
+                    victim = cand
+                    self.shed_priority += 1
+                    self._count_shed(cand)
+                else:
+                    self.shed_overload += 1
+                    self.shed_by_priority[int(priority)] = \
+                        self.shed_by_priority.get(int(priority), 0) + 1
+                    retry = self.retry_after_s()
+                    raise ServerOverloaded(
+                        f"serve: request queue full ({self.queue_limit} "
+                        f"waiting, none below priority {int(priority)}) "
+                        f"— shedding at admission; retry in {retry}s",
+                        retry_after_s=retry)
+            req = PendingRequest(payload, self.clock(), deadline,
+                                 tenant=tenant, priority=priority)
             self._q.append(req)
             self.submitted += 1
             depth = len(self._q)
             self._cond.notify_all()
+        now = self.clock()
+        for r in expired:
+            r._resolve(error=RequestTimeout(
+                f"serve: deadline expired after {now - r.enqueued:.3f}s "
+                "in queue (swept at admission)"), now=now)
+        if victim is not None:
+            victim._resolve(error=ServerOverloaded(
+                f"serve: shed from a full queue for a priority-"
+                f"{int(priority)} arrival (this request: priority "
+                f"{victim.priority}); retry in {self.retry_after_s()}s",
+                retry_after_s=self.retry_after_s()), now=now)
         telemetry.counter("serve", queue_depth=depth)
         return req
 
@@ -266,6 +357,7 @@ class DynamicBatcher:
             if r.deadline is not None and now > r.deadline:
                 with self._cond:
                     self.shed_timeout += 1
+                    self._count_shed(r)
                 r._resolve(error=RequestTimeout(
                     f"serve: deadline exceeded after "
                     f"{now - r.enqueued:.3f}s in queue"), now=now)
@@ -279,6 +371,46 @@ class DynamicBatcher:
             if b >= n:
                 return b
         return self.buckets[-1]
+
+    def requeue(self, reqs: Sequence["PendingRequest"]) -> None:
+        """Hand collected-but-unserved requests back to the queue HEAD in
+        their original order — a condemned/dying replica (serve/control
+        teardown, the ``serve.replica`` exit drill) must lose zero
+        accepted requests.  After a no-drain close there is nobody left
+        to serve them: they fail typed instead."""
+        reqs = [r for r in reqs if not r.done()]
+        if not reqs:
+            return
+        stranded = None
+        with self._cond:
+            if self._closed and not self._drain:
+                stranded = reqs
+            else:
+                for r in reversed(reqs):
+                    self._q.appendleft(r)
+                self._cond.notify_all()
+        if stranded:
+            now = self.clock()
+            for r in stranded:
+                r._resolve(error=ServerClosed(
+                    "serve: server stopped before this request ran"),
+                    now=now)
+
+    def fail_pending(self, error: Optional[Exception] = None) -> int:
+        """Resolve everything still queued with a typed error (default
+        :class:`ServerClosed`) and return how many there were — the final
+        shutdown sweep for queues nobody is left to drain (dead replica
+        pool, drain interrupted), so no caller ever blocks on
+        ``result()`` forever."""
+        with self._cond:
+            pending = [r for r in self._q if not r.done()]
+            self._q.clear()
+        now = self.clock()
+        err = error if error is not None else ServerClosed(
+            "serve: server stopped before this request ran")
+        for r in pending:
+            r._resolve(error=err, now=now)
+        return len(pending)
 
     # -- shutdown -------------------------------------------------------
 
@@ -307,4 +439,8 @@ class DynamicBatcher:
             return {"queue_depth": len(self._q),
                     "submitted": self.submitted,
                     "shed_overload": self.shed_overload,
-                    "shed_timeout": self.shed_timeout}
+                    "shed_timeout": self.shed_timeout,
+                    "shed_priority": self.shed_priority,
+                    "shed_by_priority": {str(k): v for k, v in
+                                         sorted(self.shed_by_priority
+                                                .items())}}

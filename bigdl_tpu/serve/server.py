@@ -19,11 +19,21 @@ already has into an online server:
   (`bigdl_tpu.quantize`), warms its batch shapes, then flips one
   reference — in-flight batches finish on the old version, queued
   requests run on the new one, zero requests dropped;
+- the **control plane** (serve/control.py) closes the loop the trainer
+  already has: a dead/silent replica is restarted (bounded budget,
+  exponential backoff, bucket ladder re-warmed through the AOT cache),
+  ``swap(..., canary_fraction=f)`` routes a weighted slice of batches to
+  the candidate and auto-promotes or auto-rolls-back on a rolling
+  p99/error-rate comparison, and admission is tenant/priority-aware
+  (token-bucket quotas, shed-lowest-priority-first) — see
+  docs/serving.md "Self-healing & resilience";
 - everything is instrumented: per-batch ``serve.batch`` spans, a
-  ``serve`` counter track (queue depth / batch fill), ``serve.swap``
-  instants, and the ``serve.request``/``serve.batch`` chaos points for
-  fault drills (a ChaosFault in a batch surfaces as a typed per-request
-  error; the server keeps serving).
+  ``serve`` counter track (queue depth / batch fill), ``serve.swap``/
+  ``serve.replica_lost``/``serve.canary`` instants, and the
+  ``serve.request``/``serve.batch``/``serve.replica@<idx>``/
+  ``serve.canary`` chaos points for fault drills (a ChaosFault in a
+  batch surfaces as a typed per-request error; the server keeps
+  serving).
 
 Knobs (utils/config tier; constructor args override):
 
@@ -35,6 +45,15 @@ Knobs (utils/config tier; constructor args override):
 | ``BIGDL_TPU_SERVE_REPLICAS`` | worker threads draining the shared queue | 1 |
 | ``BIGDL_TPU_SERVE_DEADLINE_MS`` | default per-request deadline (0 = none) | 0 |
 | ``BIGDL_TPU_SERVE_STALL_SECONDS`` | per-replica supervision deadline (0 = unwatched) | 0 |
+| ``BIGDL_TPU_SERVE_REPLICA_LOST`` | replica heartbeat-silence seconds before restart (0 = monitor off) | 0 |
+| ``BIGDL_TPU_SERVE_RESTART_BUDGET`` | restarts per replica before the server flips unhealthy | 3 |
+| ``BIGDL_TPU_SERVE_RESTART_BACKOFF`` | base restart backoff seconds (doubles per restart) | 0.1 |
+| ``BIGDL_TPU_SERVE_CANARY_MIN_BATCHES`` | clean canary batches required to promote | 8 |
+| ``BIGDL_TPU_SERVE_CANARY_WINDOW`` | rolling latency-window size per arm (batches) | 64 |
+| ``BIGDL_TPU_SERVE_CANARY_LATENCY_RATIO`` | rollback when canary p99 > ratio x incumbent p99 | 2.0 |
+| ``BIGDL_TPU_SERVE_CANARY_ERROR_MARGIN`` | rollback when canary error rate > incumbent + margin | 0.05 |
+| ``BIGDL_TPU_SERVE_TENANT_QPS`` | per-tenant token-bucket refill, req/s (0 = quotas off) | 0 |
+| ``BIGDL_TPU_SERVE_TENANT_BURST`` | per-tenant bucket depth (0 = 2x qps, min 1) | 0 |
 """
 
 from __future__ import annotations
@@ -48,6 +67,7 @@ import numpy as np
 from ..nn.module import Module
 from ..utils import chaos, config, telemetry
 from ..utils.supervisor import StallError, Supervisor
+from . import control
 from .batcher import (DynamicBatcher, PendingRequest, ServeError,
                       default_buckets, pad_rows)
 
@@ -115,7 +135,16 @@ class InferenceServer:
                  supervisor: Optional[Supervisor] = None,
                  stall_seconds: Optional[float] = None,
                  report_dir: Optional[str] = None,
-                 clock=None):
+                 clock=None,
+                 replica_lost: Optional[float] = None,
+                 restart_budget: Optional[int] = None,
+                 restart_backoff: Optional[float] = None,
+                 tenant_qps: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 canary_min_batches: Optional[int] = None,
+                 canary_window: Optional[int] = None,
+                 canary_latency_ratio: Optional[float] = None,
+                 canary_error_margin: Optional[float] = None):
         self.max_batch = int(max_batch if max_batch is not None
                              else config.get_int("SERVE_MAX_BATCH", 8))
         wait_ms = (max_wait_ms if max_wait_ms is not None
@@ -133,11 +162,52 @@ class InferenceServer:
                                       clock=clock)
         self._example = None if example is None else np.asarray(example)
         self._version = ModelVersion(1, model, "initial", strategy)
+        self._vid = 1                       # monotonic version ids
         self._lock = threading.Lock()       # stats + version flip (brief)
         self._swap_lock = threading.Lock()  # serialize concurrent swaps
         self._threads: list = []
+        # replica lifecycle state (serve/control.ReplicaMonitor): idx ->
+        # [thread, generation, last local heartbeat].  The generation is
+        # the condemnation mechanism — a zombie whose generation moved on
+        # requeues any held batch and exits.
+        self._replica: dict = {}
+        self._monitor: Optional[control.ReplicaMonitor] = None
+        self._unhealthy: Optional[Exception] = None
+        self._canary: Optional[control.CanaryController] = None
+        self._canary_last: Optional[dict] = None
         self._stats = {"batches": 0, "batch_rows": 0, "batch_errors": 0,
-                       "bucket_rows": 0, "swaps": 0}
+                       "bucket_rows": 0, "swaps": 0, "restarts": 0,
+                       "canary_rollbacks": 0}
+        # control-plane knobs (serve/control.py; docs/serving.md)
+        self._replica_lost = float(
+            replica_lost if replica_lost is not None
+            else config.get_float("SERVE_REPLICA_LOST", 0.0))
+        self._restart_budget = int(
+            restart_budget if restart_budget is not None
+            else config.get_int("SERVE_RESTART_BUDGET", 3))
+        self._restart_backoff = float(
+            restart_backoff if restart_backoff is not None
+            else config.get_float("SERVE_RESTART_BACKOFF", 0.1))
+        self._canary_cfg = {
+            "min_batches": int(
+                canary_min_batches if canary_min_batches is not None
+                else config.get_int("SERVE_CANARY_MIN_BATCHES", 8)),
+            "window": int(
+                canary_window if canary_window is not None
+                else config.get_int("SERVE_CANARY_WINDOW", 64)),
+            "latency_ratio": float(
+                canary_latency_ratio if canary_latency_ratio is not None
+                else config.get_float("SERVE_CANARY_LATENCY_RATIO", 2.0)),
+            "error_margin": float(
+                canary_error_margin if canary_error_margin is not None
+                else config.get_float("SERVE_CANARY_ERROR_MARGIN", 0.05))}
+        qps = float(tenant_qps if tenant_qps is not None
+                    else config.get_float("SERVE_TENANT_QPS", 0.0))
+        burst = (tenant_burst if tenant_burst is not None
+                 else config.get_float("SERVE_TENANT_BURST", 0.0))
+        self._quotas = (control.TenantQuotas(qps, burst=burst,
+                                             clock=self.batcher.clock)
+                        if qps > 0 else None)
         # supervision: an embedder-owned Supervisor, or our own from the
         # SERVE_STALL_SECONDS knob — each replica heartbeats a channel
         # under phase 'serve' so a wedged one trips a stall+crash report
@@ -163,27 +233,42 @@ class InferenceServer:
         if self._example is not None:
             self.warmup()
         for i in range(self.replicas):
-            t = threading.Thread(target=self._worker, args=(i,),
-                                 daemon=True,
-                                 name=f"bigdl-serve-replica-{i}")
-            t.start()
-            self._threads.append(t)
+            self._spawn_replica(i)
+        if self._replica_lost > 0:
+            self._monitor = control.ReplicaMonitor(
+                self, self._replica_lost, budget=self._restart_budget,
+                backoff=self._restart_backoff).start()
         logger.info("serve: started %d replica(s), max_batch=%d, "
-                    "buckets=%s, queue_limit=%d", self.replicas,
-                    self.max_batch, self.batcher.buckets, self.queue_limit)
+                    "buckets=%s, queue_limit=%d%s", self.replicas,
+                    self.max_batch, self.batcher.buckets, self.queue_limit,
+                    f", replica_lost={self._replica_lost:g}s"
+                    if self._monitor is not None else "")
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Shut down.  drain=True (graceful) answers everything already
         queued before workers exit; drain=False fails queued requests
-        with ServerClosed.  Idempotent; joins every replica thread."""
-        # with no workers running there is nobody to drain the queue —
+        with ServerClosed.  Idempotent; joins every replica thread.
+        Whatever is STILL queued once the workers are gone — a dead
+        pool, a drain the workers never finished — fails with a typed
+        ServerClosed instead of leaving callers blocked on ``result()``
+        forever."""
+        if self._monitor is not None:
+            # the monitor must not respawn replicas into a shutdown
+            self._monitor.stop()
+        # with no LIVE workers there is nobody to drain the queue —
         # draining would strand queued requests' result() forever
-        self.batcher.close(drain=drain and bool(self._threads))
+        self.batcher.close(
+            drain=drain and any(t.is_alive() for t in self._threads))
         for t in self._threads:
             t.join(timeout=timeout)
         leaked = [t.name for t in self._threads if t.is_alive()]
         self._threads = []
+        stranded = self.batcher.fail_pending()
+        if stranded:
+            logger.warning("serve: failed %d still-queued request(s) "
+                           "with ServerClosed at shutdown (no worker "
+                           "drained them)", stranded)
         if self._own_sup:
             self._sup.stop()
         if leaked:
@@ -199,11 +284,20 @@ class InferenceServer:
 
     # -- request path ---------------------------------------------------
 
-    def submit(self, x, deadline_ms: Optional[float] = None
-               ) -> PendingRequest:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: int = 0) -> PendingRequest:
         """Enqueue one sample (NOT a batch — the batcher owns batching);
         returns a handle whose ``result()`` is the per-sample output row.
-        Raises ServerOverloaded / ServerClosed at admission."""
+        Raises ServerOverloaded / QuotaExceeded / ServerClosed at
+        admission.  ``tenant`` tags the request for token-bucket quotas
+        (``SERVE_TENANT_QPS``); ``priority`` (higher = more important)
+        decides who is shed first under queue pressure."""
+        if self._unhealthy is not None and not self._pool_alive():
+            # the restart budget is spent and nobody is left to serve:
+            # admitting would strand the caller on result() forever
+            raise control.ReplicaLostError(
+                f"serve: pool unhealthy — {self._unhealthy}")
         x = np.asarray(x)
         if self._example is None:
             # remember the sample shape so later swaps can warm up the
@@ -216,33 +310,146 @@ class InferenceServer:
             raise ServeError(
                 f"serve: sample shape {x.shape} does not match the "
                 f"server's example shape {self._example.shape}")
+        if self._quotas is not None:
+            self._quotas.admit(tenant)
         ms = (deadline_ms if deadline_ms is not None
               else self.default_deadline_ms)
         deadline = (self.batcher.clock() + ms / 1000.0) if ms and ms > 0 \
             else None
-        return self.batcher.submit(x, deadline)
+        return self.batcher.submit(x, deadline, tenant=tenant,
+                                   priority=priority)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None) -> np.ndarray:
         """Blocking convenience: submit + wait."""
         return self.submit(x, deadline_ms=deadline_ms).result(timeout)
 
+    # -- replica lifecycle (serve/control.ReplicaMonitor hooks) ---------
+
+    def _spawn_replica(self, idx: int) -> threading.Thread:
+        """Start (or re-start) the worker thread for replica slot `idx`,
+        bumping its generation — any previous incarnation that wakes up
+        later sees the newer generation, requeues its batch and exits."""
+        st = self._replica.setdefault(
+            idx, [None, 0, self.batcher.clock()])
+        st[1] += 1
+        st[2] = self.batcher.clock()
+        t = threading.Thread(target=self._worker, args=(idx, st[1]),
+                             daemon=True,
+                             name=f"bigdl-serve-replica-{idx}")
+        st[0] = t
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def _condemn_replica(self, idx: int) -> None:
+        """Retire the current incarnation of replica `idx` (generation
+        bump, no thread kill — an uninterruptibly wedged thread cannot be
+        killed; it retires itself at its next loop turn)."""
+        st = self._replica.get(idx)
+        if st is not None:
+            st[1] += 1
+
+    def _restart_replica(self, idx: int) -> None:
+        """Respawn replica `idx` on a FRESH forward engine: the current
+        version's module gets a new engine whose bucket ladder is
+        re-warmed before the flip — with the AOT executable cache armed
+        the whole ladder is cache reads (zero fresh lowers), so restart
+        is seconds, not a cold compile.  Runs on the monitor thread; the
+        old engine keeps answering until the flip."""
+        if self.batcher.closed:
+            return
+        with self._lock:
+            old = self._version
+        try:
+            version = ModelVersion(old.id, old.module, old.label,
+                                   self._strategy)
+            if self._example is not None:
+                self._warm_version(version, self._example)
+            with self._lock:
+                if self._version is old:  # a swap may have raced us
+                    self._version = version
+        except Exception:  # noqa: BLE001 — a broken rebuild must not
+            # stop the respawn: the old engine still works
+            logger.exception("serve: replica %d engine rebuild failed; "
+                             "respawning on the existing engine", idx)
+        with self._lock:
+            self._stats["restarts"] += 1
+        self._spawn_replica(idx)
+        telemetry.instant("serve.replica_restart", cat="serve",
+                          replica=idx)
+        logger.info("serve: replica %d restarted (bucket ladder "
+                    "re-warmed)", idx)
+
+    def _mark_unhealthy(self, err: Exception) -> None:
+        """The restart budget is exhausted: stop self-healing, surface it.
+        ``/healthz`` flips 503 so an outer orchestrator replaces the
+        process; with no live worker left, queued requests fail typed
+        instead of hanging."""
+        self._unhealthy = err
+        telemetry.instant("serve.unhealthy", cat="serve", reason=str(err))
+        logger.error("serve: UNHEALTHY — %s (restart budget %d "
+                     "exhausted); /healthz now fails", err,
+                     self._restart_budget)
+        if not self._pool_alive():
+            n = self.batcher.fail_pending(err)
+            if n:
+                logger.error("serve: failed %d queued request(s) with "
+                             "the replica-lost error", n)
+
+    def _pool_alive(self) -> bool:
+        return any(t.is_alive() for t in self._threads)
+
+    def healthy(self) -> bool:
+        """False once the replica restart budget is exhausted — the
+        ``/healthz`` signal for the outer orchestrator."""
+        return self._unhealthy is None
+
     # -- replica workers ------------------------------------------------
 
-    def _worker(self, idx: int) -> None:
+    def _worker(self, idx: int, gen: int = 1) -> None:
         telemetry.thread_name(f"serve replica {idx}")
+        st = self._replica.setdefault(
+            idx, [None, gen, self.batcher.clock()])
         chan = (self._sup.channel(f"serve-replica-{idx}", phase="serve")
                 if self._sup is not None else None)
-        beat = chan.beat if chan is not None else None
+
+        def beat(phase: Optional[str] = None) -> None:
+            # the LOCAL stamp feeds the replica monitor (control plane);
+            # the channel feeds the embedder's supervisor, when armed
+            st[2] = self.batcher.clock()
+            if chan is not None:
+                chan.beat(phase)
+
         try:
             while True:
                 try:
-                    if beat is not None:
-                        beat()
+                    if st[1] != gen:
+                        return  # condemned: a newer incarnation owns idx
+                    beat()
                     reqs = self.batcher.collect(heartbeat=beat)
                     if reqs is None:
                         return
+                    if st[1] != gen:
+                        # condemned while collecting (e.g. woke from a
+                        # wedge): zero accepted-request loss — hand the
+                        # batch back for the replacement to serve
+                        self.batcher.requeue(reqs)
+                        return
                     if reqs:
+                        try:
+                            # replica-loss drill (serve/control.py):
+                            # wedge blocks THIS thread uninterruptibly,
+                            # exit kills it — after requeueing its batch
+                            chaos.fire(f"serve.replica@{idx}",
+                                       thread_exc=control.ReplicaExit)
+                        except control.ReplicaExit as e:
+                            self.batcher.requeue(reqs)
+                            logger.error(
+                                "serve: replica %d killed by chaos drill "
+                                "(%s); batch of %d requeued", idx, e,
+                                len(reqs))
+                            return
                         self._execute(reqs, beat)
                 except StallError:
                     # the supervisor async-raised into this replica while
@@ -267,18 +474,35 @@ class InferenceServer:
                 chan.close()
 
     def _execute(self, reqs, beat) -> None:
-        version = self._version  # one snapshot: a swap mid-batch cannot
-        # split the batch across versions (no misrouted requests)
+        # one version snapshot per batch: a swap mid-batch cannot split
+        # the batch across versions (no misrouted requests).  Canary
+        # routing happens here — per BATCH, deterministic, bounded by the
+        # configured fraction (serve/control.CanaryController).
+        with self._lock:
+            version = self._version
+            canary = self._canary
+            is_canary = False
+            if canary is not None and canary.state == "running" \
+                    and canary.route():
+                version = canary.version
+                is_canary = True
         n = len(reqs)
         bucket = self.batcher.bucket_for(n)
+        t0 = self.batcher.clock()
         try:
             # batch assembly is inside the guard too: a stray payload that
             # defeats admission-time shape checks (or OOMs the stack) must
             # fail ITS batch typed, not kill the replica thread
             batch = pad_rows(np.stack([r.payload for r in reqs]), bucket)
             with telemetry.span("serve.batch", cat="serve", size=n,
-                                bucket=bucket, version=version.id):
+                                bucket=bucket, version=version.id,
+                                canary=is_canary):
                 chaos.fire("serve.batch")
+                if is_canary:
+                    # canary drill point: stall*S@c inflates exactly the
+                    # canary's latency, fail@c its error rate — the
+                    # comparator must roll it back
+                    chaos.fire("serve.canary")
                 out = version.predict(batch)
         except Exception as e:  # noqa: BLE001 — typed per-request error
             # (ChaosFault, StallError, backend error...): the batch fails
@@ -290,6 +514,7 @@ class InferenceServer:
                 self._stats["batch_errors"] += 1
             logger.warning("serve: batch of %d failed: %s: %s", n,
                            type(e).__name__, e)
+            self._canary_observe(canary, is_canary, now - t0, True)
             return
         now = self.batcher.clock()
         for i, r in enumerate(reqs):
@@ -298,10 +523,46 @@ class InferenceServer:
             self._stats["batches"] += 1
             self._stats["batch_rows"] += n
             self._stats["bucket_rows"] += bucket
+        self.batcher.note_service(n, now - t0)
         telemetry.counter("serve", queue_depth=self.batcher.depth(),
                           batch_fill=n / bucket)
+        self._canary_observe(canary, is_canary, now - t0, False)
         if beat is not None:
             beat()
+
+    def _canary_observe(self, canary, is_canary: bool, dur_s: float,
+                        errored: bool) -> None:
+        """Feed one finished batch to the canary comparator and act on
+        its verdict — promotion flips the reference exactly like a plain
+        swap; rollback discards the candidate and records the typed
+        :class:`~bigdl_tpu.serve.control.CanaryRejected` reason."""
+        if canary is None:
+            return
+        with self._lock:
+            if self._canary is not canary or canary.state != "running":
+                return  # already decided (or superseded by a full swap)
+            decision = canary.observe(is_canary, dur_s, errored)
+            if decision is None:
+                return
+            if decision == "promote":
+                canary.state = "promoted"
+                self._version = canary.version
+                self._stats["swaps"] += 1
+            else:
+                canary.state = "rolled_back"
+                self._stats["canary_rollbacks"] += 1
+            self._canary = None
+            self._canary_last = canary.summary()
+        telemetry.instant("serve.canary", cat="serve",
+                          decision=canary.state,
+                          version=canary.version.id,
+                          reason=str(canary.reason or ""))
+        if canary.state == "promoted":
+            logger.info("serve: canary v%d promoted after %d canary "
+                        "batches", canary.version.id, canary.routed)
+        else:
+            logger.error("serve: canary v%d ROLLED BACK — %s",
+                         canary.version.id, canary.reason)
 
     # -- warmup ---------------------------------------------------------
 
@@ -331,7 +592,7 @@ class InferenceServer:
     # -- hot swap -------------------------------------------------------
 
     def swap(self, source, *, quantized: bool = False,
-             state=None) -> int:
+             state=None, canary_fraction: Optional[float] = None) -> int:
         """Install a new model version with ZERO dropped requests.
 
         source: a checkpoint DIRECTORY (newest lineage snapshot via
@@ -343,14 +604,23 @@ class InferenceServer:
         The new version is fully built — loaded, (optionally) quantized,
         engine constructed, batch shapes warmed — BEFORE one reference
         flip makes it live: in-flight batches finish on the old version,
-        every queued/new request runs on the new one."""
+        every queued/new request runs on the new one.
+
+        ``canary_fraction`` in (0, 1) installs the new version as a
+        CANARY instead of flipping: that fraction of device batches
+        routes to it while a rolling p99-latency/error-rate comparator
+        (serve/control.CanaryController) decides — auto-promote after
+        ``SERVE_CANARY_MIN_BATCHES`` clean batches, auto-rollback (typed
+        ``CanaryRejected`` in ``stats()["canary"]``) on a regression.
+        A later plain ``swap()`` supersedes a still-running canary."""
         # the slow build (retried remote IO, quantize, engine, warmup)
         # runs under its OWN lock: _lock guards only the reference flip
         # and per-batch stats, so replicas keep answering traffic for the
         # whole duration of a swap — serialize concurrent swaps, never
         # the data path
         with self._swap_lock:
-            vid = self._version.id + 1
+            self._vid += 1
+            vid = self._vid
             module, label = self._load_module(source, state)
             if quantized:
                 from ..quantize import quantize
@@ -359,8 +629,22 @@ class InferenceServer:
             version = ModelVersion(vid, module, label, self._strategy)
             if self._example is not None:
                 self._warm_version(version, self._example)
+            if canary_fraction is not None:
+                ctl = control.CanaryController(version, canary_fraction,
+                                               **self._canary_cfg)
+                with self._lock:
+                    self._canary = ctl
+                    self._canary_last = None
+                telemetry.instant("serve.swap", cat="serve", version=vid,
+                                  label=label,
+                                  canary_fraction=float(canary_fraction))
+                logger.info("serve: canary version %d (%s) taking %.0f%% "
+                            "of batches", vid, label,
+                            100.0 * float(canary_fraction))
+                return vid
             with self._lock:
                 self._version = version  # the atomic flip
+                self._canary = None      # a full swap supersedes a canary
                 self._stats["swaps"] += 1
         telemetry.instant("serve.swap", cat="serve", version=vid,
                           label=label)
@@ -404,15 +688,30 @@ class InferenceServer:
 
     def stats(self) -> dict:
         """One merged counter snapshot: admission/shed counts (batcher),
-        batch counts/fill, swaps, current version."""
+        batch counts/fill, swaps, restarts, canary/quota/health state,
+        current version."""
         out = self.batcher.stats()
         with self._lock:
             out.update(self._stats)
             out["version"] = self._version.id
             out["version_label"] = self._version.label
+            canary = self._canary
+            canary_last = self._canary_last
         out["batch_fill"] = (round(out["batch_rows"] /
                                    max(out["bucket_rows"], 1), 4))
         out["replicas"] = self.replicas
+        out["healthy"] = self.healthy()
+        if self._unhealthy is not None:
+            out["unhealthy_reason"] = str(self._unhealthy)
+            out["unhealthy_type"] = type(self._unhealthy).__name__
+        if canary is not None:
+            out["canary"] = canary.summary()
+        elif canary_last is not None:
+            out["canary"] = canary_last
+        if self._monitor is not None:
+            out["replica_monitor"] = self._monitor.stats()
+        if self._quotas is not None:
+            out["quota"] = self._quotas.stats()
         from ..utils import aot
         if aot.enabled():
             # warm-start ledger: a freshly swapped/restarted replica that

@@ -23,6 +23,8 @@ Fault points wired into the runtime:
 | ``step.stall``  | once per device step dispatch (driver loop)   | stall     |
 | ``serve.request``| once per request admitted (serve/batcher)    | fail      |
 | ``serve.batch`` | once per online device batch (serve/server)   | fail/stall |
+| ``serve.replica@<idx>`` | once per non-empty batch on replica `<idx>` (serve/server) | wedge/exit (thread-scoped) |
+| ``serve.canary`` | once per canary-routed batch (serve/server)  | fail/stall |
 | ``host.lost@<rank>`` | once per train iteration on rank `<rank>` (driver loop) | exit/wedge |
 
 Schedules (1-based counts):
@@ -60,6 +62,13 @@ Addressing extensions (net-new with the elastic subsystem):
   engages on the addressed one.  Actions: ``exit`` (the process dies
   instantly with code 117) and ``wedge``/``lost`` (stops beating and
   blocks, default 3600s, ``wedge*N`` for N seconds).
+- **thread-scoped exit/wedge** — a fire site may pass ``thread_exc``
+  (serve/server.py's replica loop does, with
+  ``serve.replica@<replica idx>`` points): an ``exit`` schedule then
+  raises that exception class in the CALLING THREAD instead of killing
+  the process, and ``wedge`` blocks uninterruptibly without touching
+  process liveness — the replica-loss drill the serving control plane
+  (serve/control.py) must restart around.
 - **``@epoch:iteration`` addressing** — any schedule's ``@`` list may
   mix plain invocation counts with ``epoch:neval`` pairs
   (``stall*30@2:5`` = hang at epoch 2, iteration 5).  The driver
@@ -86,7 +95,8 @@ __all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
 
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
                 "step.loss_nan", "data.record", "data.stall", "step.stall",
-                "serve.request", "serve.batch", "host.lost")
+                "serve.request", "serve.batch", "serve.replica",
+                "serve.canary", "host.lost")
 
 #: the driver loop's current (epoch, neval), published once per iteration
 #: via at_position() — the coordinate ``@epoch:iteration`` addresses match
@@ -290,6 +300,11 @@ class WedgeAt:
 
     def engage(self) -> None:
         _suspend_liveness()
+        self.block_uninterruptible()
+
+    def block_uninterruptible(self) -> None:
+        """The wedge itself, without the liveness side effect — the
+        thread-scoped variant (``serve.replica`` drills) reuses it."""
         end = time.monotonic() + self.seconds
         while time.monotonic() < end:
             try:
@@ -389,16 +404,30 @@ def _trace_hits(point: str, count: int, hits) -> None:
                       schedules=[repr(s) for s in hits])
 
 
-def fire(point: str) -> None:
+def fire(point: str, thread_exc=None) -> None:
     """Count one invocation; raise ChaosFault if a fail schedule matches,
     block if a stall schedule matches.  Corrupt schedules are ignored here
-    (no payload to mutate)."""
+    (no payload to mutate).
+
+    ``thread_exc`` (an exception class) scopes exit/wedge schedules to
+    the CALLING THREAD: ``exit`` raises ``thread_exc`` instead of
+    ``os._exit`` and ``wedge`` blocks uninterruptibly without suspending
+    process liveness — the serve replica-loss drill
+    (``serve.replica@<idx>``, serve/control.py)."""
     count, hits = _bump(point)
     if hits:
         _trace_hits(point, count, hits)
     for s in hits:
         if getattr(s, "is_exit", False):
-            s.engage()
+            if thread_exc is not None:
+                if isinstance(s, WedgeAt):
+                    s.block_uninterruptible()
+                else:
+                    raise thread_exc(
+                        f"chaos[{point}] thread exit "
+                        f"(invocation {count}, {s!r})")
+            else:
+                s.engage()
         elif getattr(s, "is_stall", False):
             s.block()
         elif s.is_fail:

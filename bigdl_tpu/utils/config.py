@@ -49,6 +49,15 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_SERVE_REPLICAS | (net-new: replica worker threads draining the shared serve queue) | 1 |
 | BIGDL_TPU_SERVE_DEADLINE_MS | (net-new: default per-request deadline; expired queued requests shed with RequestTimeout; 0 = none) | 0 |
 | BIGDL_TPU_SERVE_STALL_SECONDS | (net-new: per-replica supervision deadline — a wedged replica trips a stall + crash report; 0 = unwatched) | 0 |
+| BIGDL_TPU_SERVE_REPLICA_LOST | (net-new: serving control plane, serve/control.py — seconds of replica heartbeat silence before the monitor condemns + restarts it; 0 = monitor off) | 0 (off) |
+| BIGDL_TPU_SERVE_RESTART_BUDGET | (net-new: replica restarts allowed per replica slot before the server flips unhealthy on /healthz) | 3 |
+| BIGDL_TPU_SERVE_RESTART_BACKOFF | (net-new: base seconds between replica restarts, doubling per consecutive restart) | 0.1 |
+| BIGDL_TPU_SERVE_CANARY_MIN_BATCHES | (net-new: clean canary batches — and matching incumbent window — required before auto-promotion) | 8 |
+| BIGDL_TPU_SERVE_CANARY_WINDOW | (net-new: rolling per-arm latency window, batches, for the canary p99 comparator) | 64 |
+| BIGDL_TPU_SERVE_CANARY_LATENCY_RATIO | (net-new: auto-rollback when canary p99 latency exceeds ratio x the incumbent's) | 2.0 |
+| BIGDL_TPU_SERVE_CANARY_ERROR_MARGIN | (net-new: auto-rollback when canary batch error rate exceeds the incumbent's + margin) | 0.05 |
+| BIGDL_TPU_SERVE_TENANT_QPS | (net-new: per-tenant token-bucket admission quota, requests/s; over-quota -> typed QuotaExceeded with retry_after_s; 0 = quotas off) | 0 (off) |
+| BIGDL_TPU_SERVE_TENANT_BURST | (net-new: per-tenant token-bucket depth; 0 = 2x qps, min 1) | 0 (auto) |
 | BIGDL_TPU_AOT_CACHE | (net-new: AOT executable-cache dir, utils/aot.py — serialized compiled executables; warm start = cache read, zero XLA compiles; empty/0 = off) | off |
 | BIGDL_TPU_AOT_CACHE_TAG | (net-new: free-form AOT fingerprint salt; bump to invalidate every entry at once) | "" |
 | BIGDL_TPU_PEAK_FLOPS | (net-new: per-device MFU denominator override, FLOP/s — utils/flops.device_peak_flops; default TPU table / 1e12 CPU-nominal) | 0 (auto) |

@@ -520,8 +520,9 @@ def test_http_front_end_roundtrip():
 
 
 def test_bench_serve_mode_record():
-    """bench.py --serve produces the serving record (closed+open loop,
-    percentiles, shed accounting) — tiny config on the test mesh."""
+    """bench.py --serve produces the serving record (closed+open loop +
+    bursty traffic storm, percentiles, shed accounting by priority
+    class) — tiny config on the test mesh."""
     import bench
 
     Engine.init()
@@ -540,3 +541,76 @@ def test_bench_serve_mode_record():
     assert 0.0 <= open_loop["shed_rate"] <= 1.0
     assert open_loop["served"] + open_loop["shed_overload"] + \
         open_loop["shed_timeout"] == open_loop["offered"]
+    # traffic storm: bursty load over three priority classes, shed rate
+    # reported per class (the priority-aware-admission measurement)
+    storm = rec["storm"]
+    assert set(storm["by_priority"]) == {"0", "1", "2"}
+    assert storm["offered"] == sum(v["offered"] for v in
+                                   storm["by_priority"].values())
+    assert 0.0 <= storm["shed_rate"] <= 1.0
+    for v in storm["by_priority"].values():
+        assert v["offered"] == (v["served"] + v["shed_overload"] +
+                                v["shed_timeout"])
+        assert 0.0 <= v["shed_rate"] <= 1.0
+
+
+# ------------------------------------------- restart x AOT warm start
+
+
+def test_replica_restart_rewarms_ladder_from_aot_cache(tmp_path,
+                                                       monkeypatch):
+    """A respawned replica re-warms its FULL bucket ladder through the
+    AOT executable cache: the rebuilt engine performs zero fresh lowers,
+    zero misses, zero XLA compiles (pure cache reads), asserted via the
+    stats()["aot"] ledger — restart is seconds, not a cold compile.
+
+    The XLA persistent cache is un-latched for the duration (same
+    attribution discipline as tools/lenet_cold.py --aot-cache): an
+    executable that was itself loaded from the XLA disk cache serializes
+    into an unloadable AOT entry on CPU (quarantined + recompiled — the
+    system stays correct, but the zero-fresh-lowers ledger would lie)."""
+    from jax._src import compilation_cache as _cc
+
+    from bigdl_tpu.utils import aot
+
+    monkeypatch.setenv("BIGDL_TPU_AOT_CACHE", str(tmp_path / "aot"))
+    aot.reset()
+    prior_xla = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+    try:
+        Engine.init()
+        x = _rows(4)
+        with chaos.scoped("serve.replica@0=exit@1"):
+            server = InferenceServer(_linear_model(), max_batch=8,
+                                     max_wait_ms=2, queue_limit=32,
+                                     example=x[0], replica_lost=0.3,
+                                     restart_budget=3,
+                                     restart_backoff=0.01).start()
+            # startup warmup populated the cache (fresh lowers + stores)
+            first = aot.stats()
+            assert first["stores"] >= 1 and first["lowers"] >= 1
+            # the exit drill kills replica 0 on its first batch; the
+            # monitor respawns it on a FRESH engine whose warmup must be
+            # pure cache reads
+            out = server.predict(x[0], timeout=60)
+            assert out.shape == (3,)
+            deadline = time.time() + 10
+            while server.stats()["restarts"] < 1 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            stats = server.stats()
+            server.stop()
+        assert stats["restarts"] == 1
+        ledger = stats["aot"]
+        assert ledger["lowers"] == first["lowers"], \
+            "restart re-warm performed a fresh lower"
+        assert ledger["misses"] == first["misses"], \
+            "restart re-warm missed the cache"
+        assert ledger["compiles"] == first["compiles"], \
+            "restart re-warm compiled"
+        assert ledger["hits"] > first["hits"]  # the ladder was cache reads
+    finally:
+        aot.reset()
+        jax.config.update("jax_compilation_cache_dir", prior_xla)
+        _cc.reset_cache()

@@ -1,0 +1,498 @@
+"""Self-healing serving control plane (bigdl_tpu/serve/control.py).
+
+The control-plane contract under test (docs/serving.md "Self-healing &
+resilience"):
+  - a wedged replica (uninterruptible chaos wedge) is detected by
+    heartbeat silence, condemned, and restarted — with zero accepted
+    requests dropped or answered incorrectly (bit-match vs per-sample
+    bulk Predictor.predict);
+  - a dead replica thread (chaos exit drill) requeues its held batch
+    before dying and is respawned — zero loss again;
+  - the restart budget bounds self-healing: past it the server flips
+    unhealthy, queued requests fail typed, /healthz goes 503;
+  - a chaos-degraded canary is auto-rolled-back with a typed
+    CanaryRejected reason and never serves more than its fraction; a
+    healthy canary auto-promotes;
+  - admission is priority/tenant aware: expired queue slots are swept
+    before fresh traffic is shed, a full queue sheds its lowest-priority
+    entry for a higher-priority arrival, per-tenant token buckets raise
+    QuotaExceeded with retry_after_s;
+  - stop() never strands a queued caller on result() forever.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import Engine
+from bigdl_tpu.optim import Predictor
+from bigdl_tpu.serve import (CanaryRejected, DynamicBatcher,
+                             InferenceServer, QuotaExceeded,
+                             ReplicaLostError, RequestTimeout,
+                             ServerClosed, ServerOverloaded, TenantQuotas)
+from bigdl_tpu.utils import chaos
+
+
+def _linear_model(seed=0, din=4, dout=3):
+    return nn.Sequential().add(nn.Linear(din, dout)).build(
+        jax.random.key(seed))
+
+
+def _rows(n, din=4, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, din)) \
+        .astype(np.float32)
+
+
+def _per_sample_ref(model, x):
+    p = Predictor(model)
+    return np.stack([p.predict(x[i:i + 1])[0] for i in range(len(x))])
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return pred()
+
+
+# -------------------------------------------------- admission satellites
+
+
+def test_admission_sweeps_expired_queue_slots():
+    """A queue full of expired-deadline requests must shed ITSELF at
+    admission, not the fresh arrival (the queued-dead-request slot
+    leak)."""
+    clock = [0.0]
+    b = DynamicBatcher(max_batch=4, max_wait_s=0.0, queue_limit=3,
+                       clock=lambda: clock[0])
+    x = _rows(1)[0]
+    stale = [b.submit(x, deadline=5.0) for _ in range(3)]
+    clock[0] = 10.0  # every queued deadline is now past
+    fresh = b.submit(x)  # would have been ServerOverloaded before
+    assert b.depth() == 1 and not fresh.done()
+    for h in stale:
+        with pytest.raises(RequestTimeout):
+            h.result(0)
+    stats = b.stats()
+    assert stats["shed_timeout"] == 3
+    assert stats["shed_overload"] == 0
+
+
+def test_priority_eviction_sheds_lowest_first():
+    """Under queue pressure a strictly-higher-priority arrival evicts the
+    newest lowest-priority queued request (typed ServerOverloaded on the
+    victim); an equal-priority arrival is refused with retry_after_s."""
+    b = DynamicBatcher(max_batch=4, max_wait_s=0.0, queue_limit=2)
+    x = _rows(1)[0]
+    low_old = b.submit(x, priority=0)
+    low_new = b.submit(x, priority=0)
+    high = b.submit(x, priority=2)  # full queue: evicts low_new
+    assert not high.done() and not low_old.done()
+    with pytest.raises(ServerOverloaded):
+        low_new.result(0)
+    assert b.stats()["shed_priority"] == 1
+    # an arrival that outranks nobody is refused, with a retry estimate
+    with pytest.raises(ServerOverloaded) as ei:
+        b.submit(x, priority=0)
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    stats = b.stats()
+    assert stats["shed_overload"] == 1
+    assert stats["shed_by_priority"]["0"] == 2  # victim + refused arrival
+
+
+def test_tenant_token_bucket_quota():
+    """Independent per-tenant buckets: burst tokens, QuotaExceeded with
+    retry_after_s when empty, refill at qps."""
+    clock = [0.0]
+    q = TenantQuotas(qps=2.0, burst=2.0, clock=lambda: clock[0])
+    q.admit("a")
+    q.admit("a")
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("a")
+    assert ei.value.retry_after_s == pytest.approx(0.5)
+    assert isinstance(ei.value, ServerOverloaded)  # HTTP 429 mapping
+    q.admit("b")  # tenant b has its own full bucket
+    clock[0] = 0.5  # one token refilled for a
+    q.admit("a")
+    stats = q.stats()
+    assert stats["denied"] == 1
+    assert stats["denied_by_tenant"] == {"a": 1}
+
+
+def test_server_submit_enforces_quota():
+    Engine.init()
+    server = InferenceServer(_linear_model(), queue_limit=8,
+                             tenant_qps=1.0, tenant_burst=1.0)
+    x = _rows(1)[0]
+    server.submit(x, tenant="t1")
+    with pytest.raises(QuotaExceeded):
+        server.submit(x, tenant="t1")
+    server.submit(x, tenant="t2")  # unaffected
+    assert server.stats()["quota"]["denied"] == 1
+    server.stop(drain=False)
+
+
+# ------------------------------------------------------ replica restart
+
+
+def test_wedged_replica_restarted_zero_loss():
+    """Tier-1 acceptance: a chaos-wedged replica goes heartbeat-silent,
+    the monitor condemns + respawns it, and every accepted request is
+    answered bit-identically to per-sample bulk Predictor.predict —
+    zero dropped, zero wrong."""
+    Engine.init()
+    model = _linear_model()
+    n = 16
+    x = _rows(n)
+    ref = _per_sample_ref(model, x)
+    results, lock = {}, threading.Lock()
+    with chaos.scoped("serve.replica@0=wedge*1.0@2"):
+        server = InferenceServer(model, max_batch=4, max_wait_ms=5,
+                                 queue_limit=2 * n, example=x[0],
+                                 replica_lost=0.25,
+                                 restart_backoff=0.02).start()
+
+        def client(i):
+            h = server.submit(x[i])
+            out = h.result(60)
+            with lock:
+                results[i] = out
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+            time.sleep(0.015)  # sustained trickle spanning the wedge
+        for t in threads:
+            t.join()
+        assert _wait(lambda: server.stats()["restarts"] >= 1)
+        stats = server.stats()
+        server.stop()
+    assert len(results) == n  # zero dropped
+    for i in range(n):
+        np.testing.assert_array_equal(results[i], ref[i])
+    assert stats["restarts"] >= 1
+    assert stats["healthy"] is True
+    assert stats["replica_monitor"]["lost"] >= 1
+    ev = stats["replica_monitor"]["events"][0]
+    assert ev["error_type"] == "ReplicaLostError"
+
+
+def test_dead_replica_requeues_batch_and_respawns():
+    """The exit drill kills exactly one worker THREAD: it hands its held
+    batch back to the queue first (zero accepted-request loss), the
+    monitor detects the dead thread and respawns."""
+    Engine.init()
+    model = _linear_model()
+    n = 10
+    x = _rows(n)
+    ref = _per_sample_ref(model, x)
+    results, lock = {}, threading.Lock()
+    with chaos.scoped("serve.replica@0=exit@2"):
+        server = InferenceServer(model, max_batch=4, max_wait_ms=5,
+                                 queue_limit=2 * n, example=x[0],
+                                 replica_lost=0.3,
+                                 restart_backoff=0.02).start()
+
+        def client(i):
+            h = server.submit(x[i])
+            out = h.result(60)
+            with lock:
+                results[i] = out
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+            time.sleep(0.01)
+        for t in threads:
+            t.join()
+        stats = server.stats()
+        server.stop()
+    assert len(results) == n
+    for i in range(n):
+        np.testing.assert_array_equal(results[i], ref[i])
+    assert stats["restarts"] >= 1
+
+
+def test_restart_budget_exhausted_flips_unhealthy():
+    """A replica that keeps dying consumes its restart budget; past it
+    the server flips unhealthy, fails queued requests typed, and rejects
+    new admissions — no infinite self-healing loop, no hung callers."""
+    Engine.init()
+    with chaos.scoped("serve.replica@0=exit@1,2"):
+        server = InferenceServer(_linear_model(), max_batch=4,
+                                 max_wait_ms=2, queue_limit=8,
+                                 example=_rows(1)[0],
+                                 replica_lost=0.5, restart_budget=1,
+                                 restart_backoff=0.01).start()
+        h = server.submit(_rows(1)[0])
+        with pytest.raises(ReplicaLostError):
+            h.result(30)
+        assert _wait(lambda: not server.healthy())
+        with pytest.raises(ReplicaLostError):
+            server.submit(_rows(1)[0])
+        stats = server.stats()
+        server.stop()
+    assert stats["healthy"] is False
+    assert stats["unhealthy_type"] == "ReplicaLostError"
+    assert stats["restarts"] == 1  # one respawn, then the budget ended
+
+
+def test_stop_never_strands_queued_callers():
+    """stop() — drain or not — must resolve every still-queued request
+    typed even when the whole pool died without draining (the
+    blocked-on-result()-forever fix)."""
+    Engine.init()
+    with chaos.scoped("serve.replica@0=exit@1"):
+        # no monitor armed (replica_lost=0): the dead replica stays dead
+        server = InferenceServer(_linear_model(), max_batch=4,
+                                 max_wait_ms=2, queue_limit=8,
+                                 example=_rows(1)[0]).start()
+        h1 = server.submit(_rows(1)[0])
+        # the worker collects h1, the drill kills it (batch requeued)
+        assert _wait(lambda: not server._pool_alive())
+        h2 = server.submit(_rows(1)[0])  # admitted into a dead pool
+        server.stop(drain=True)  # drain requested, nobody left to drain
+    for h in (h1, h2):
+        with pytest.raises(ServerClosed):
+            h.result(1)
+
+
+# -------------------------------------------------------------- canary
+
+
+def test_canary_latency_regression_rolled_back():
+    """serve.canary chaos inflates exactly the canary's batch latency:
+    the rolling p99 comparator rolls it back (typed CanaryRejected in
+    stats), it never serves past its fraction, and the incumbent stays
+    live."""
+    Engine.init()
+    model = _linear_model(seed=0)
+    x = _rows(24)
+    fraction = 0.25
+    with chaos.scoped("serve.canary=stall*0.3@1,2,3,4,5,6,7,8"):
+        server = InferenceServer(model, max_batch=2, max_wait_ms=1,
+                                 queue_limit=64, example=x[0],
+                                 canary_min_batches=4).start()
+        vid = server.swap(_linear_model(seed=9),
+                          canary_fraction=fraction)
+        assert vid == 2
+        for i in range(60):
+            server.predict(x[i % len(x)], timeout=60)
+            if (server.stats().get("canary") or {}).get("state") \
+                    != "running":
+                break
+        stats = server.stats()
+        server.stop()
+    c = stats["canary"]
+    assert c["state"] == "rolled_back"
+    assert c["reason_type"] == "CanaryRejected"
+    assert "p99" in c["reason"]
+    assert c["routed"] <= fraction * c["total"] + 1  # the fraction bound
+    assert stats["version"] == 1  # incumbent still live
+    assert stats["canary_rollbacks"] == 1
+    assert stats["swaps"] == 0  # a rollback is not a swap
+
+
+def test_canary_error_regression_rolled_back():
+    """An erroring canary (chaos fail on the canary point) trips the
+    error-rate comparator — fast-fail from its second batch."""
+    Engine.init()
+    model = _linear_model(seed=0)
+    x = _rows(16)
+    with chaos.scoped("serve.canary=fail@1,2"):
+        server = InferenceServer(model, max_batch=2, max_wait_ms=1,
+                                 queue_limit=64, example=x[0],
+                                 canary_min_batches=6).start()
+        server.swap(_linear_model(seed=9), canary_fraction=0.34)
+        for i in range(60):
+            try:
+                server.predict(x[i % len(x)], timeout=60)
+            except chaos.ChaosFault:
+                pass  # the canary batch's typed per-request error
+            if (server.stats().get("canary") or {}).get("state") \
+                    != "running":
+                break
+        stats = server.stats()
+        server.stop()
+    c = stats["canary"]
+    assert c["state"] == "rolled_back"
+    assert "error rate" in c["reason"]
+    assert stats["version"] == 1
+
+
+def test_canary_clean_run_promoted():
+    """A healthy canary auto-promotes after min_batches clean batches on
+    both arms; the promotion counts as a swap and the canary version
+    answers afterwards."""
+    Engine.init()
+    model = _linear_model(seed=0)
+    new = _linear_model(seed=9)
+    x = _rows(24)
+    ref_new = _per_sample_ref(new, x)
+    server = InferenceServer(model, max_batch=2, max_wait_ms=1,
+                             queue_limit=64, example=x[0],
+                             canary_min_batches=3,
+                             canary_latency_ratio=100.0,
+                             canary_error_margin=1.0).start()
+    vid = server.swap(new, canary_fraction=0.4)
+    for i in range(120):
+        server.predict(x[i % len(x)], timeout=60)
+        if (server.stats().get("canary") or {}).get("state") \
+                == "promoted":
+            break
+    stats = server.stats()
+    assert stats["canary"]["state"] == "promoted"
+    assert stats["version"] == vid == 2
+    assert stats["swaps"] == 1
+    post = server.submit(x[0])
+    np.testing.assert_array_equal(post.result(30), ref_new[0])
+    assert post.version == vid
+    server.stop()
+
+
+def test_canary_fraction_validated():
+    Engine.init()
+    x = _rows(1)
+    with InferenceServer(_linear_model(), max_wait_ms=2,
+                         example=x[0]) as server:
+        with pytest.raises(ValueError):
+            server.swap(_linear_model(seed=3), canary_fraction=1.5)
+        # a rejected canary must not burn the data path: still serving
+        assert server.predict(x[0], timeout=30).shape == (3,)
+
+
+def test_full_swap_supersedes_running_canary():
+    Engine.init()
+    model = _linear_model(seed=0)
+    x = _rows(4)
+    with InferenceServer(model, max_wait_ms=2, example=x[0]) as server:
+        server.swap(_linear_model(seed=5), canary_fraction=0.5)
+        assert server.stats()["canary"]["state"] == "running"
+        vid = server.swap(_linear_model(seed=7))  # full cutover
+        stats = server.stats()
+        assert stats["version"] == vid == 3
+        # the canary was discarded without a decision record
+        assert stats.get("canary", {}).get("state") in (None, "running") \
+            or stats["canary"]["version"] == 2
+
+
+# ------------------------------------------------------ http front end
+
+
+def test_http_retry_after_and_unhealthy_healthz():
+    """429 rejections carry the typed retry_after_s as a Retry-After
+    header; /healthz flips 503 once the server is unhealthy."""
+    import sys
+    import urllib.error
+    import urllib.request
+
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import serve_http
+
+    Engine.init()
+    x = _rows(4)
+    server = InferenceServer(_linear_model(), max_batch=2, queue_limit=2,
+                             example=x[0])  # NOT started: queue fills
+    httpd = serve_http.serve_forever(server, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, obj):
+        req = urllib.request.Request(base + path,
+                                     data=json.dumps(obj).encode(),
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    try:
+        server.submit(x[0])
+        server.submit(x[1])  # queue_limit reached
+        status, body, headers = post("/v1/predict",
+                                     {"inputs": x[2].tolist()})
+        assert status == 429
+        assert body["type"] == "ServerOverloaded"
+        assert body["retry_after_s"] and body["retry_after_s"] > 0
+        assert int(headers["Retry-After"]) >= 1
+        # healthz: healthy then unhealthy
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["ok"] is True
+        server._mark_unhealthy(ReplicaLostError("drill: budget spent"))
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=30) as r:
+                raise AssertionError(f"healthz returned {r.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["ok"] is False
+            assert body["type"] == "ReplicaLostError"
+    finally:
+        httpd.shutdown()
+        server.stop(drain=False)
+
+
+def test_http_tenant_priority_and_quota_429():
+    """/v1/predict forwards tenant/priority; an over-quota tenant gets
+    the typed QuotaExceeded as a 429 with Retry-After."""
+    import sys
+    import urllib.error
+    import urllib.request
+
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import serve_http
+
+    Engine.init()
+    x = _rows(4)
+    server = InferenceServer(_linear_model(), max_wait_ms=2,
+                             example=x[0], tenant_qps=0.001,
+                             tenant_burst=1.0).start()
+    httpd = serve_http.serve_forever(server, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, obj):
+        req = urllib.request.Request(base + path,
+                                     data=json.dumps(obj).encode(),
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    try:
+        status, body, _ = post("/v1/predict",
+                               {"inputs": x[0].tolist(),
+                                "tenant": "acme", "priority": 2})
+        assert status == 200
+        status, body, headers = post("/v1/predict",
+                                     {"inputs": x[1].tolist(),
+                                      "tenant": "acme"})
+        assert status == 429
+        assert body["type"] == "QuotaExceeded"
+        assert "Retry-After" in headers
+        # another tenant is unaffected
+        status, _, _ = post("/v1/predict", {"inputs": x[2].tolist(),
+                                            "tenant": "other"})
+        assert status == 200
+        assert server.stats()["quota"]["denied_by_tenant"] == {"acme": 1}
+    finally:
+        httpd.shutdown()
+        server.stop()

@@ -6,15 +6,24 @@ framework, just ``http.server.ThreadingHTTPServer`` (one thread per
 connection blocking on its request's handle, while the server's replica
 pool batches across connections).  Endpoints:
 
-    POST /v1/predict   {"inputs": <sample or list of samples>}
+    POST /v1/predict   {"inputs": <sample or list of samples>,
+                        "tenant": "team-a", "priority": 2}
                        -> {"outputs": ..., "version": N, "latency_ms": x}
     POST /v1/swap      {"source": "<ckpt dir | snapshot | module file>",
-                        "quantized": false}  -> {"version": N}
+                        "quantized": false, "canary_fraction": 0.1}
+                       -> {"version": N}
     GET  /v1/stats     -> server.stats()
-    GET  /healthz      -> {"ok": true, "version": N}
+    GET  /healthz      -> {"ok": true, "version": N} — or 503
+                          {"ok": false, "reason": ...} once the replica
+                          restart budget is exhausted (the orchestrator's
+                          replace-this-process signal)
 
-Typed shedding maps onto status codes: 429 ServerOverloaded (back off),
-504 RequestTimeout (deadline passed in queue), 503 ServerClosed.
+Typed shedding maps onto status codes: 429 ServerOverloaded /
+QuotaExceeded (back off; the Retry-After header carries the server's
+typed retry_after_s estimate), 504 RequestTimeout (deadline passed in
+queue), 503 ServerClosed.  `tenant` feeds the per-tenant token-bucket
+quota (BIGDL_TPU_SERVE_TENANT_QPS); `priority` (higher = more
+important) decides who is shed first under queue pressure.
 
 Usage:
     python tools/serve_http.py --model lenet --port 8000
@@ -67,11 +76,13 @@ def make_handler(server):
         def log_message(self, fmt, *args):  # quiet; stats has the counters
             pass
 
-        def _reply(self, code: int, obj: dict) -> None:
+        def _reply(self, code: int, obj: dict, headers=None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -82,6 +93,13 @@ def make_handler(server):
 
         def do_GET(self):
             if self.path == "/healthz":
+                if not server.healthy():
+                    st = server.stats()
+                    return self._reply(503, {
+                        "ok": False,
+                        "reason": st.get("unhealthy_reason"),
+                        "type": st.get("unhealthy_type"),
+                        "version": server.version.id})
                 self._reply(200, {"ok": True,
                                   "version": server.version.id})
             elif self.path == "/v1/stats":
@@ -107,16 +125,26 @@ def make_handler(server):
             batched = x.ndim > server.sample_ndim
             rows = x if batched else x[None]
             deadline = body.get("deadline_ms")
+            tenant = body.get("tenant")
+            priority = int(body.get("priority", 0))
             try:
                 # submit every row FIRST (they coalesce into one bucket),
                 # then wait — a row-at-a-time predict() would serialize
-                handles = [server.submit(r, deadline_ms=deadline)
+                handles = [server.submit(r, deadline_ms=deadline,
+                                         tenant=tenant, priority=priority)
                            for r in rows]
                 outs = [h.result(timeout=body.get("timeout_s", 120))
                         for h in handles]
             except ServerOverloaded as e:
+                # covers QuotaExceeded too (a subclass): typed 429 with
+                # the server's retry estimate in the standard header
+                retry = getattr(e, "retry_after_s", None)
+                hdrs = ({"Retry-After": str(max(1, int(retry + 0.999)))}
+                        if retry else None)
                 return self._reply(429, {"error": str(e),
-                                         "type": "ServerOverloaded"})
+                                         "type": type(e).__name__,
+                                         "retry_after_s": retry},
+                                   headers=hdrs)
             except RequestTimeout as e:
                 return self._reply(504, {"error": str(e),
                                          "type": "RequestTimeout"})
@@ -142,9 +170,12 @@ def make_handler(server):
             src = body.get("source") or body.get("checkpoint")
             if not src:
                 return self._reply(400, {"error": "missing 'source'"})
+            canary = body.get("canary_fraction")
             try:
                 vid = server.swap(src,
-                                  quantized=bool(body.get("quantized")))
+                                  quantized=bool(body.get("quantized")),
+                                  canary_fraction=(float(canary)
+                                                   if canary else None))
             except Exception as e:  # noqa: BLE001 — surface to the client
                 return self._reply(500, {"error": str(e),
                                          "type": type(e).__name__})

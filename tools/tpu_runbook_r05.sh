@@ -197,6 +197,21 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] shard smoke FAILED rc=$SHARD_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # serving control-plane smoke (cpu only): wedge a replica under
+  # closed-loop traffic -> monitor restarts it with zero accepted
+  # requests lost (bit-matched vs bulk Predictor) and the restart
+  # counted; latency-inflate a canary -> auto-rollback with a typed
+  # CanaryRejected reason, never serving past its fraction
+  echo "[runbook] 2k/4 serving resilience drill (replica restart + canary rollback)" >> "$LOG"
+  timeout 300 python tools/resilience_smoke.py --platform cpu \
+    > /tmp/resilience_smoke.json 2>/tmp/resilience_smoke.log
+  RESIL_RC=$?
+  if [ "$RESIL_RC" = 0 ]; then
+    echo "[runbook] resilience smoke OK (restart zero-loss + canary rollback) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] resilience smoke FAILED rc=$RESIL_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -224,7 +239,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
