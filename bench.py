@@ -65,6 +65,12 @@ _SCALING_TIMEOUT = 420  # seconds for the CPU scaling subprocess
 # phase-tagged heartbeats; utils/timing's measure loops notify the active
 # supervisor per rep for free.
 _STALL_STATE = {"results": {}, "errors": {}, "skipped": [], "meta": None}
+# --out artifact state: when armed, every completed config incrementally
+# flushes to `<out>.partial.json` and every exit path (success, stall,
+# backend-init death) leaves SOMETHING on disk — rounds 3-5 each died at
+# jax.devices() with zero artifacts, which is the one outcome this
+# forbids (ROADMAP "artifacts that survive a flaky backend")
+_OUT_STATE = {"path": None, "t_start": None}
 # stages that legitimately hold ONE long silent device/subprocess call and
 # get the --compile-stall-seconds allowance: backend init, XLA compiles,
 # jaxpr tracing, the roofline's compile+timed 8192^3 matmul chains, the
@@ -144,8 +150,71 @@ def _flush_trace():
         pass
 
 
+def _env_snapshot():
+    """The environment knobs a failed-round post-mortem needs: every
+    BIGDL_TPU_* plus the jax/XLA/libtpu selectors."""
+    keep_prefixes = ("BIGDL_TPU_", "JAX_", "TPU_")
+    keep_exact = ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "XLA_PYTHON_CLIENT_MEM_FRACTION")
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(keep_prefixes) or k in keep_exact}
+
+
+def _write_json_atomic(path, obj):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _flush_partial(stage, error=None, tb=None):
+    """Rewrite `<out>.partial.json` with everything concluded so far.
+    Armed by --out; a broken artifact write must never fail the bench."""
+    out = _OUT_STATE.get("path")
+    if not out:
+        return
+    rec = {"metric": "bench_partial", "partial": True, "stage": stage,
+           "platform": sys.platform,
+           "results": dict(_STALL_STATE["results"]),
+           "config_errors": dict(_STALL_STATE["errors"]),
+           "configs_skipped_budget": list(_STALL_STATE["skipped"]),
+           "env": _env_snapshot()}
+    if _OUT_STATE.get("t_start") is not None:
+        rec["elapsed_s"] = round(time.perf_counter() -
+                                 _OUT_STATE["t_start"], 1)
+    if error is not None:
+        rec["error"] = str(error)
+        rec["error_type"] = type(error).__name__ \
+            if isinstance(error, BaseException) else "str"
+    if tb:
+        rec["traceback"] = tb
+    try:
+        _write_json_atomic(f"{out}.partial.json", rec)
+    except Exception as e:  # noqa: BLE001 — artifacts are best-effort
+        print(f"[bench] partial flush failed: {e}", file=sys.stderr)
+
+
+def _write_out(obj):
+    """Write the final JSON record to the --out path (stdout still gets
+    the one-line contract either way)."""
+    out = _OUT_STATE.get("path")
+    if not out:
+        return
+    try:
+        _write_json_atomic(out, obj)
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] --out write failed: {e}", file=sys.stderr)
+
+
 def _fail(err, stage):
     _flush_trace()
+    # leave evidence BEFORE racing for the stdout line: a backend-init
+    # death (`jax.devices()` hang/raise) must still produce an artifact
+    # holding the platform, the env knobs, and the traceback
+    import traceback as _tb
+    tb = None
+    if isinstance(err, BaseException) and err.__traceback__ is not None:
+        tb = "".join(_tb.format_exception(type(err), err, err.__traceback__))
+    _flush_partial(stage, error=err, tb=tb)
     if not _claim_emit():
         # another thread claimed the final line (possibly the watchdog
         # emitting a VALID partial-results record with exit 0) — give it a
@@ -157,6 +226,12 @@ def _fail(err, stage):
         _EMIT_DONE.wait(timeout=120)
         time.sleep(600)
         os._exit(1)
+    err_rec = {"metric": "bench_error", "value": 0.0, "unit": "error",
+               "vs_baseline": None, "stage": stage, "error": str(err),
+               "traceback": tb, "platform": sys.platform,
+               "env": _env_snapshot(),
+               "results": dict(_STALL_STATE["results"])}
+    _write_out(err_rec)
     print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "error",
                       "vs_baseline": None, "stage": stage, "error": str(err)}))
     sys.stdout.flush()
@@ -164,11 +239,20 @@ def _fail(err, stage):
     os._exit(1)
 
 
-def _init_backend(timeout=240, retries=3, backoff=15):
+def _init_backend(timeout=None, retries=3, backoff=15):
     """Bring up the jax backend with a watchdog: jax.devices() can block
     forever when the TPU is unreachable (round-1 rc=124 root cause), and can
-    raise transient UNAVAILABLE during chip handoff."""
+    raise transient UNAVAILABLE during chip handoff.  The probe timeout is
+    tunable (`BIGDL_TPU_BENCH_INIT_TIMEOUT` seconds) so a round driver with
+    a tight window can choose fast-fail-with-artifacts over patience."""
     import jax
+
+    if timeout is None:
+        try:
+            timeout = float(os.environ.get("BIGDL_TPU_BENCH_INIT_TIMEOUT",
+                                           240))
+        except ValueError:
+            timeout = 240
 
     last_err = None
     for attempt in range(retries):
@@ -435,9 +519,22 @@ def _bench_config(name, build, peak_flops):
     # to the old lowered.compile()
     compiled = aot_mod.cached_compile(
         lowered, label=f"bench.{name}", mesh=mesh,
-        example_args=(params, net_state, opt_state, inp, tgt, lr_arr, rng))
+        example_args=(params, net_state, opt_state, inp, tgt, lr_arr, rng),
+        card_extra=dict(opt._card_extra))
     compile_s = time.perf_counter() - t0
     aot_rec = _aot_delta(aot0)
+    # compiled-program self-description (utils/hlostats): the headline op
+    # counts of this config's compile card, embedded in the record so a
+    # bench JSON alone can answer "did the step really have 0 convs /
+    # bucketed wire / donated buffers" without re-running anything
+    card_rec = None
+    from bigdl_tpu.utils import hlostats as _hlostats
+    card = _hlostats.last_card(f"bench.{name}")
+    if card is not None:
+        card_rec = {k: card.get(k) for k in
+                    ("convolutions", "dots", "converts", "collectives",
+                     "custom_calls", "total_ops", "input_output_aliases",
+                     "donation", "source")}
 
     _beat(f"trace:{name}")
     flops_step, flops_detail = _step_flops(
@@ -496,7 +593,8 @@ def _bench_config(name, build, peak_flops):
     return _make_record(name, int(inp.shape[0]), dt, timing, compile_s,
                         flops_step, flops_detail, peak_flops,
                         jnp.dtype(policy.compute_dtype).name,
-                        aot_cache=aot_rec, memory=memory, **step_arith,
+                        aot_cache=aot_rec, memory=memory,
+                        compile_card=card_rec, **step_arith,
                         **e2e)
 
 
@@ -852,6 +950,13 @@ def main(argv=None):
                     help="--serve closed-loop concurrent clients")
     ap.add_argument("--serve-requests", type=int, default=200,
                     help="--serve total closed-loop requests")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the final JSON record to PATH and "
+                         "flush every completed config incrementally to "
+                         "PATH.partial.json — on a backend-init failure "
+                         "the partial file still holds an error record "
+                         "(platform, env knobs, traceback), so a flaky-"
+                         "backend round always leaves evidence")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="emit a run trace (Chrome trace-event JSON, "
                          "bigdl_tpu.utils.telemetry) into DIR for ANY "
@@ -896,6 +1001,10 @@ def main(argv=None):
                             clients=args.serve_clients,
                             requests=args.serve_requests)
     t_start = time.perf_counter()
+    if args.out:
+        _OUT_STATE["path"] = args.out
+        _OUT_STATE["t_start"] = t_start
+        _flush_partial("init")  # evidence exists before the backend is touched
     _beat("init")
     _start_watchdog(args.stall_seconds, args.compile_stall_seconds)
 
@@ -990,6 +1099,10 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001 — recorded per config
             errors[name] = f"{type(e).__name__}: {e}"
             _log(f"config {name} failed: {errors[name]}")
+        # incremental artifact: each config's record (or error) lands on
+        # disk the moment it concludes — a mid-run backend loss costs the
+        # remaining configs, never the completed ones
+        _flush_partial(f"config:{name}")
 
     if not _claim_emit():
         # the watchdog declared a stall and claimed the final line (our
@@ -1057,6 +1170,7 @@ def _assemble_and_print(args, results, errors, skipped, table_peak,
             out["scaling_skipped_budget"] = True
             _log("budget: skipping virtual-mesh scaling table")
     _flush_trace()
+    _write_out(out)
     print(json.dumps(out))
     sys.stdout.flush()
     _EMIT_DONE.set()

@@ -315,6 +315,9 @@ class Optimizer:
         # knobs the compiled step was built with (_build_step fills it;
         # bench embeds it in the per-config record)
         self._step_knobs = {}
+        # the step's compile-card self-description (knobs + wire-bucket +
+        # fused-buffer counts; _build_step fills it, utils/hlostats reads)
+        self._card_extra = {}
         # straggler mitigation (reference: Optimizer.setDropModuleProperty,
         # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
         self.drop_percentage = 0.0
@@ -682,6 +685,22 @@ class Optimizer:
         self._step_knobs = {"fused_update": bool(use_fused),
                             "wire_bucket_mb": bucket_mb,
                             "donate": bool(donate)}
+        # structural self-description for the step's compile card
+        # (utils/hlostats.py): the wire-bucket and fused-buffer counts the
+        # perf gate exact-matches against PERF_BASELINE.json — computed
+        # from the same plan/assignment the traced step will bake in
+        card_extra = dict(self._step_knobs)
+        card_extra["wire_leaves"] = (len(jax.tree.leaves(model.params))
+                                     if wire is not None else 0)
+        card_extra["wire_buckets"] = wire_mod.bucket_count(
+            model.params, wire, bucket_mb)
+        if use_fused:
+            from . import fused as fused_mod
+            card_extra["fused_buffers"] = len(
+                fused_mod.plan(model.params).groups)
+        else:
+            card_extra["fused_buffers"] = 0
+        self._card_extra = card_extra
 
         remat = self.remat_policy
 
@@ -807,14 +826,19 @@ class Optimizer:
                     lowered = jitted.lower(*args)
                 comp = aot_mod.cached_compile(
                     lowered, label="optim.step", mesh=mesh,
-                    example_args=args)
+                    example_args=args, card_extra=self._card_extra)
                 aot_exe[sig] = comp
             with mesh:
                 return comp(*args)
 
         def step_in_mesh(*args):
-            from ..utils import aot as aot_mod
-            if aot_mod.enabled() and not aot_exe.get("disabled"):
+            from ..utils import aot as aot_mod, hlostats
+            # explicit lower+compile path when the AOT cache is armed OR
+            # compile cards are (hlostats): the card needs the Compiled
+            # object, which jit's implicit compile never surfaces.  Both
+            # off (the default) -> the plain pjit call, byte-for-byte.
+            if (aot_mod.enabled() or hlostats.enabled()) \
+                    and not aot_exe.get("disabled"):
                 try:
                     return _aot_step(args)
                 except Exception as e:  # noqa: BLE001 — cache must never
@@ -1936,8 +1960,12 @@ class _ShardedForward:
         n = (inp[0] if isinstance(inp, (list, tuple)) else inp).shape[0]
         placed = _put_batch(jax.tree.map(pad, inp), data_sh)
         out = None
-        from ..utils import aot as aot_mod
-        if aot_mod.enabled() and not self._aot_exe.get("disabled"):
+        from ..utils import aot as aot_mod, hlostats
+        # same gate as the train step: compile cards need the Compiled
+        # object, so an armed hlostats routes the forward through the
+        # explicit lower/compile path even with the AOT cache off
+        if (aot_mod.enabled() or hlostats.enabled()) \
+                and not self._aot_exe.get("disabled"):
             try:
                 out = self._aot_forward(mesh, params, net_state, placed)
             except Exception as e:  # noqa: BLE001 — the cache must never

@@ -43,8 +43,8 @@ from ..utils import config as _config
 
 logger = logging.getLogger("bigdl_tpu")
 
-__all__ = ["bucket_assignment", "wire_cast", "measure_collective_seconds",
-           "wire_bucket_mb"]
+__all__ = ["bucket_assignment", "bucket_count", "wire_cast",
+           "measure_collective_seconds", "wire_bucket_mb"]
 
 
 def wire_bucket_mb() -> float:
@@ -72,6 +72,25 @@ def bucket_assignment(sizes: List[int], itemsize: int,
     if cur:
         buckets.append(cur)
     return buckets
+
+
+def bucket_count(tree, wire, bucket_mb: Optional[float] = None) -> int:
+    """How many wire buckets :func:`wire_cast` will use for ``tree``
+    (0 = per-leaf path: ``wire`` is None or bucketing is off).  This is
+    the structural count the train step's compile card self-reports and
+    ``tools/perf_gate.py`` exact-matches — computed from the SAME
+    assignment ``wire_cast`` bakes into the program."""
+    if wire is None:
+        return 0
+    if bucket_mb is None:
+        bucket_mb = wire_bucket_mb()
+    if bucket_mb <= 0:
+        return 0
+    sizes = [int(leaf.size) for leaf in jax.tree.leaves(tree)]
+    if not sizes:
+        return 0
+    return len(bucket_assignment(sizes, jnp.dtype(wire).itemsize,
+                                 bucket_mb))
 
 
 def wire_cast(grads, wire, bucket_mb: Optional[float] = None,

@@ -712,7 +712,7 @@ class InferenceServer:
             out["replica_monitor"] = self._monitor.stats()
         if self._quotas is not None:
             out["quota"] = self._quotas.stats()
-        from ..utils import aot
+        from ..utils import aot, hlostats
         if aot.enabled():
             # warm-start ledger: a freshly swapped/restarted replica that
             # served its ladder from the AOT cache shows hits==buckets,
@@ -721,4 +721,10 @@ class InferenceServer:
             out["aot"] = {k: int(s[k]) for k in
                           ("hits", "misses", "stores", "lowers",
                            "compiles", "corrupt")}
+        if hlostats.enabled():
+            # compiled-program ledger: one compile card per bucket shape
+            # the ladder warmed (utils/hlostats.py — counts per label plus
+            # capture/write/error totals)
+            out["compile_cards"] = {"labels": hlostats.ledger(),
+                                    **hlostats.stats()}
         return out

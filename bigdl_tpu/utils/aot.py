@@ -77,14 +77,19 @@ _stats: Dict[str, float] = {k: 0 for k in _STATS_KEYS}
 _cache_singleton: Dict[str, Any] = {}
 
 
+_TRACK_KEYS = ("hits", "misses", "stores", "lowers", "compiles")
+
+
 def _bump(key: str, amount: float = 1) -> None:
     from . import telemetry
     with _lock:
         _stats[key] += amount
-        snap = (_stats["hits"], _stats["misses"], _stats["stores"])
-    if key in ("hits", "misses", "stores"):
-        telemetry.counter("aot", hits=snap[0], misses=snap[1],
-                          stores=snap[2])
+        snap = {k: _stats[k] for k in _TRACK_KEYS}
+    if key in _TRACK_KEYS:
+        # the full warm-start ledger rides the `aot` counter track so
+        # trace_report's aot section (and Perfetto) can prove whether a
+        # run compiled anything, not just whether the cache hit
+        telemetry.counter("aot", **snap)
 
 
 def stats() -> Dict[str, float]:
@@ -293,7 +298,7 @@ class AOTCache:
                 # (CRC mismatch, truncated pickle, executable rejected by
                 # this jaxlib): quarantine so the next process does not
                 # trip over it again, then silently recompile
-                self._quarantine(path, e)
+                self._quarantine(path, e, key=key)
                 _bump("corrupt")
                 _bump("misses")
                 return None
@@ -331,8 +336,13 @@ class AOTCache:
         _bump("stores")
         return True
 
-    def _quarantine(self, path: str, err: Exception) -> None:
-        logger.warning("aot: quarantining %s (%s: %s); recompiling", path,
+    def _quarantine(self, path: str, err: Exception,
+                    key: Optional[str] = None) -> None:
+        # the full fingerprint in the log line: corrupt-entry forensics
+        # (which env/model/avals produced this key?) can start from the
+        # entry's meta without attaching a debugger
+        logger.warning("aot: quarantining %s (fingerprint %s; %s: %s); "
+                       "recompiling", path, key or "?",
                        type(err).__name__, err)
         try:
             self._fs.rename(path, path + ".corrupt")
@@ -372,53 +382,80 @@ def _compile_timed(lowered, label: str):
 
 
 def cached_compile(lowered, *, label: str, mesh=None,
-                   example_args=None, extra: Optional[dict] = None):
+                   example_args=None, extra: Optional[dict] = None,
+                   card_extra: Optional[dict] = None):
     """HLO-hash-keyed compile of an already-lowered computation (the train
     step / bench path: tracing+lowering is cheap, the XLA compile is the
-    800s part).  Cache disabled -> plain ``lowered.compile()``."""
+    800s part).  Cache disabled -> plain ``lowered.compile()``.
+
+    Every executable leaving here — freshly compiled OR deserialized from
+    the cache — emits a compile card (utils/hlostats.py) when cards are
+    armed; ``card_extra`` rides in the card (NOT the cache key): the train
+    step's knob/bucket/buffer self-description."""
+    from . import hlostats
     _bump("lowers")
     cache = get_cache()
-    if cache is None:
-        return _compile_timed(lowered, label)
-    fields = dict(base_fingerprint(mesh))
-    fields["label"] = label
-    fields["hlo"] = hlo_hash(lowered)
-    if example_args is not None:
-        fields["args"] = aval_fingerprint(example_args)
-    if extra:
-        fields.update(extra)
-    key = fingerprint(fields)
-    compiled = cache.load(key)
-    if compiled is not None:
-        logger.info("aot: %s warm-started from cache (%s)", label, key[:16])
-        return compiled
+    key = None
+    if cache is not None:
+        fields = dict(base_fingerprint(mesh))
+        fields["label"] = label
+        fields["hlo"] = hlo_hash(lowered)
+        if example_args is not None:
+            fields["args"] = aval_fingerprint(example_args)
+        if extra:
+            fields.update(extra)
+        key = fingerprint(fields)
+        compiled = cache.load(key)
+        if compiled is not None:
+            logger.info("aot: %s warm-started from cache (%s)", label,
+                        key[:16])
+            hlostats.capture(compiled, lowered, label=label, key=key,
+                             example_args=example_args, extra=card_extra,
+                             source="aot-hit")
+            return compiled
     compiled = _compile_timed(lowered, label)
-    cache.store(key, compiled, meta={"label": label,
-                                     "fields": _meta_fields(fields)})
+    if cache is not None:
+        cache.store(key, compiled, meta={"label": label,
+                                         "fields": _meta_fields(fields)})
+    hlostats.capture(compiled, lowered, label=label, key=key,
+                     example_args=example_args, extra=card_extra,
+                     source="compile")
     return compiled
 
 
 def get_or_compile(key_fields: Dict[str, Any], lower_fn: Callable[[], Any],
-                   *, label: str):
+                   *, label: str, card_extra: Optional[dict] = None):
     """Logical-key lookup that skips lowering entirely on a hit (the serve
     bucket-ladder path: ``key_fields`` must identify the computation
     without tracing — module fingerprint + avals + base fingerprint).
-    On miss, ``lower_fn()`` is invoked once and the compile is stored."""
+    On miss, ``lower_fn()`` is invoked once and the compile is stored.
+    Hit or miss, the executable emits a compile card when armed (a hit's
+    card has no StableHLO section — nothing was lowered, by design)."""
+    from . import hlostats
     cache = get_cache()
-    if cache is None:
-        _bump("lowers")
-        return _compile_timed(lower_fn(), label)
     fields = dict(key_fields)
     fields["label"] = label
     key = fingerprint(fields)
+    if cache is None:
+        _bump("lowers")
+        lowered = lower_fn()
+        compiled = _compile_timed(lowered, label)
+        hlostats.capture(compiled, lowered, label=label, key=key,
+                         extra=card_extra, source="compile")
+        return compiled
     compiled = cache.load(key)
     if compiled is not None:
         logger.info("aot: %s warm-started from cache (%s)", label, key[:16])
+        hlostats.capture(compiled, None, label=label, key=key,
+                         extra=card_extra, source="aot-hit")
         return compiled
     _bump("lowers")
-    compiled = _compile_timed(lower_fn(), label)
+    lowered = lower_fn()
+    compiled = _compile_timed(lowered, label)
     cache.store(key, compiled, meta={"label": label,
                                      "fields": _meta_fields(fields)})
+    hlostats.capture(compiled, lowered, label=label, key=key,
+                     extra=card_extra, source="compile")
     return compiled
 
 

@@ -73,7 +73,8 @@ logger = logging.getLogger("bigdl_tpu")
 __all__ = ["Tracer", "enabled", "trace_dir", "maybe_start", "set_active",
            "get_active", "span", "complete", "instant", "counter",
            "thread_name", "merge_traces", "phase_breakdown",
-           "format_report", "TRACE_FILE_RE"]
+           "format_report", "diff_breakdowns", "format_diff",
+           "TRACE_FILE_RE"]
 
 #: the train loop's phase spans — the names phase_breakdown() ranks first
 PHASE_NAMES = ("data", "step", "checkpoint", "validation")
@@ -492,7 +493,14 @@ def phase_breakdown(merged: dict) -> dict:
                           "mean": round(sum(vals) / len(vals), 6),
                           "max": round(max(vals), 6),
                           "last": round(vals[-1], 6)}
+    # the AOT warm-start ledger, promoted out of the counter soup: when
+    # the `aot` track is present its LAST samples are the process totals
+    # (utils/aot._bump emits cumulative counts), so "did this run compile
+    # anything?" is a first-class report section, not a Perfetto hunt
+    aot = {series[len("aot."):]: int(st["last"])
+           for series, st in counters.items() if series.startswith("aot.")}
     return {"phases": phases, "ranks": ranks, "counters": counters,
+            "aot": aot,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -533,10 +541,80 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
     if breakdown.get("counters"):
         lines.append(f"{'counter':<28}{'count':>8}{'mean':>14}{'max':>14}"
                      f"{'last':>14}")
-        for name, st in breakdown["counters"].items():
+        # sorted here too (not just at breakdown build): a breakdown that
+        # round-tripped through JSON (trace_report --json | --diff) must
+        # render the same row order
+        for name in sorted(breakdown["counters"]):
+            st = breakdown["counters"][name]
             lines.append(f"{name:<28}{st['count']:>8}{st['mean']:>14.6g}"
                          f"{st['max']:>14.6g}{st['last']:>14.6g}")
+    if breakdown.get("aot"):
+        lines.append("aot ledger: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(breakdown["aot"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# run-to-run diff (trace_report --diff A B)
+# ---------------------------------------------------------------------------
+
+def diff_breakdowns(a: dict, b: dict) -> dict:
+    """Structured diff of two phase breakdowns (A = baseline, B = new run).
+
+    Per phase: count/total_s/p50 in both runs + the B/A total-time ratio;
+    per counter series: last values in both runs + delta.  Phases or
+    series present in only one run are flagged (``only``)."""
+    phases = {}
+    for name in sorted(set(a.get("phases", {})) | set(b.get("phases", {}))):
+        pa, pb = a.get("phases", {}).get(name), \
+            b.get("phases", {}).get(name)
+        if pa is None or pb is None:
+            phases[name] = {"only": "B" if pa is None else "A"}
+            continue
+        phases[name] = {
+            "count": [pa["count"], pb["count"]],
+            "total_s": [pa["total_s"], pb["total_s"]],
+            "p50_ms": [pa["p50_ms"], pb["p50_ms"]],
+            "total_ratio": round(pb["total_s"] / max(pa["total_s"], 1e-12),
+                                 4)}
+    counters = {}
+    for name in sorted(set(a.get("counters", {})) |
+                       set(b.get("counters", {}))):
+        ca, cb = a.get("counters", {}).get(name), \
+            b.get("counters", {}).get(name)
+        if ca is None or cb is None:
+            counters[name] = {"only": "B" if ca is None else "A"}
+            continue
+        counters[name] = {"last": [ca["last"], cb["last"]],
+                          "delta": round(cb["last"] - ca["last"], 6)}
+    return {"phases": phases, "counters": counters,
+            "data_wait_fraction": [a.get("data_wait_fraction"),
+                                   b.get("data_wait_fraction")]}
+
+
+def format_diff(diff: dict) -> str:
+    """Human-readable rendering of :func:`diff_breakdowns`."""
+    lines = [f"{'phase':<16}{'count A/B':>14}{'total_s A':>12}"
+             f"{'total_s B':>12}{'B/A':>8}"]
+    for name, d in diff["phases"].items():
+        if "only" in d:
+            lines.append(f"{name:<16}  only in run {d['only']}")
+            continue
+        lines.append(f"{name:<16}{'%d/%d' % tuple(d['count']):>14}"
+                     f"{d['total_s'][0]:>12.3f}{d['total_s'][1]:>12.3f}"
+                     f"{d['total_ratio']:>8.2f}")
+    if diff["counters"]:
+        lines.append(f"{'counter':<28}{'last A':>14}{'last B':>14}"
+                     f"{'delta':>12}")
+        for name, d in diff["counters"].items():
+            if "only" in d:
+                lines.append(f"{name:<28}  only in run {d['only']}")
+                continue
+            lines.append(f"{name:<28}{d['last'][0]:>14.6g}"
+                         f"{d['last'][1]:>14.6g}{d['delta']:>12.6g}")
+    dw = diff["data_wait_fraction"]
+    lines.append(f"data_wait_fraction: {dw[0]} -> {dw[1]}")
     return "\n".join(lines)

@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Perf-regression gate: CPU-measurable proxies diffed against a committed
+baseline (ROADMAP open item 1a).
+
+Every perf claim since PR 6 is a *structural property of the compiled
+program* — the matmul conv route deletes every ``convolution`` from the
+train step, the bucketed wire's up-cast count equals the bucket count
+(not the leaf count), the fused update runs over N dtype-homogeneous
+buffers, donation compiles into input/output aliases, and a warm AOT
+cache makes the second compile nearly free.  Bench rounds 3-5 all died at
+backend init with zero artifacts, so none of this is hardware-verified;
+this gate makes each claim a *tested invariant* on CPU, every PR, so the
+next real-TPU round measures exactly what we think it does.
+
+Proxies (all on the LeNet train step, compile cards armed —
+utils/hlostats.py):
+
+1. **conv route**: the compiled step under ``BIGDL_TPU_CONV_ROUTE``
+   (defaulted to ``matmul`` — exporting ``=pad`` is the regression demo)
+   must contain 0 convolutions (``lenet_matmul.conv_ops``), and its
+   steady-state step time must stay within the baseline ratio of the pad
+   route's (``conv_route.step_ratio``, à la ``tools/lenet_cold.py``).
+2. **wire + fused card**: with ``BIGDL_TPU_WIRE_BUCKET_MB=4`` and
+   ``BIGDL_TPU_FUSED_UPDATE=1``, the card must report the expected
+   wire-leaf / wire-bucket counts, a StableHLO up-cast (``f32<-bf16``)
+   count bounded by the BUCKET count, the expected fused-buffer count,
+   and donation aliases present.
+3. **AOT cold/warm**: the same step compiled cold (compile+store) then
+   warm (executable deserialized from a fresh cache dir, jit caches
+   cleared) — warm-over-cold compile-cost ratio under the baseline bound.
+
+``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
+drift fails), ``max`` (time/ratio metrics — measured must stay <=
+``value * slack * BIGDL_TPU_GATE_TIME_SLACK``), ``min`` (measured >=
+value).  Intentional perf changes are a *reviewed diff* to the baseline:
+run ``--update-baseline`` and commit the result (structural values are
+overwritten with the measured program; ratio bounds are preserved).
+
+Prints a readable per-metric diff, then ONE JSON line
+(``metric=perf_gate``), and exits non-zero on any regression — runbook
+cpu-smoke stage 2l asserts on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "PERF_BASELINE.json")
+BASELINE_FORMAT = "bigdl_tpu-perf-baseline-v1"
+
+#: bounds written by --update-baseline for the time-ratio metrics (never
+#: overwritten with a measured value: a lucky fast run must not ratchet
+#: the bound down for every later CI machine)
+DEFAULT_RATIO_BOUNDS = {
+    "conv_route.step_ratio": {"value": 1.25, "match": "max",
+                              "note": "matmul-route steady step time / "
+                                      "pad-route (lenet_cold bound)"},
+    "aot.warm_over_cold": {"value": 0.5, "match": "max",
+                           "note": "warm AOT compile cost / cold "
+                                   "(measured ~0.035 on CPU; CI slack)"},
+}
+
+
+def _build_step(batch_size):
+    """The real compiled train step (Optimizer._build_step) on device 0;
+    fresh Optimizer per call so env knobs re-bake."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(devices=[jax.devices()[0]])
+    mesh = Engine.mesh()
+    model = LeNet5(10)
+    model.build(jax.random.key(0))
+    opt = Optimizer(model, dataset=None, criterion=nn.ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(learning_rate=0.01))
+    step, param_sh, _ = opt._build_step(mesh)
+
+    rng = np.random.default_rng(0)
+    inp = jnp.asarray(rng.normal(size=(batch_size, 28, 28, 1)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, 10, size=batch_size), jnp.int32)
+    params = jax.device_put(model.params, param_sh)
+    args = (params, model.state, opt.optim_method.init_state(params),
+            inp, tgt, jnp.float32(0.01), jax.random.key(1))
+    return step, args
+
+
+def _run_steps(step, args, iters=10):
+    """First call (compile + card) then steady-state seconds/step with
+    the threaded-state pattern from tools/lenet_cold.py (donation-safe:
+    outputs replace the donated inputs every iteration)."""
+    import jax
+    out = step(*args)
+    jax.block_until_ready(out[3])
+    params, net_state, opt_state = out[0], out[1], out[2]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, *args[3:])
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / iters
+
+
+def _fresh(env_updates):
+    """Apply env updates (None = delete) and clear jax caches so the next
+    build re-lowers and re-compiles under the new knobs."""
+    import jax
+    for k, v in env_updates.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    jax.clear_caches()
+
+
+def measure(batch_size=64):
+    """Run every proxy; returns (measured metrics dict, context dict)."""
+    from bigdl_tpu.common import DTypePolicy, set_policy
+    from bigdl_tpu.utils import aot, hlostats
+
+    measured, context = {}, {}
+    set_policy(DTypePolicy())  # default policy: bf16 wire
+
+    # ---- proxy 1: conv route (pad baseline, then the env's route) ----
+    route = os.environ["BIGDL_TPU_CONV_ROUTE"]  # defaulted in main()
+    _fresh({"BIGDL_TPU_CONV_ROUTE": "pad",
+            "BIGDL_TPU_FUSED_UPDATE": None,
+            "BIGDL_TPU_WIRE_BUCKET_MB": None})
+    hlostats.reset()
+    step, args = _build_step(batch_size)
+    pad_step_s = _run_steps(step, args)
+    pad_card = hlostats.last_card("optim.step")
+    context["pad"] = {"conv_ops": pad_card["convolutions"],
+                      "step_s": round(pad_step_s, 6)}
+
+    _fresh({"BIGDL_TPU_CONV_ROUTE": route})
+    hlostats.reset()
+    step, args = _build_step(batch_size)
+    route_step_s = _run_steps(step, args)
+    card = hlostats.last_card("optim.step")
+    measured["lenet_matmul.conv_ops"] = card["convolutions"]
+    measured["conv_route.step_ratio"] = round(
+        route_step_s / max(pad_step_s, 1e-9), 4)
+    context["route"] = {"route": route, "conv_ops": card["convolutions"],
+                        "step_s": round(route_step_s, 6),
+                        "total_ops": card["total_ops"]}
+
+    # ---- proxy 2: wire + fused card ----------------------------------
+    _fresh({"BIGDL_TPU_WIRE_BUCKET_MB": "4",
+            "BIGDL_TPU_FUSED_UPDATE": "1"})
+    hlostats.reset()
+    step, args = _build_step(batch_size)
+    _run_steps(step, args, iters=1)
+    card = hlostats.last_card("optim.step")
+    extra = card.get("extra", {})
+    measured["wire.leaves"] = extra.get("wire_leaves", 0)
+    measured["wire.buckets"] = extra.get("wire_buckets", 0)
+    measured["wire.upcasts"] = card.get(
+        "stablehlo_convert_pairs", {}).get("f32<-bf16", 0)
+    measured["fused.buffers"] = extra.get("fused_buffers", 0)
+    measured["fused.donation_aliases"] = card.get("input_output_aliases", 0)
+    context["wire_fused"] = {"convert_pairs": card.get("convert_pairs"),
+                             "stablehlo_convert_pairs":
+                                 card.get("stablehlo_convert_pairs"),
+                             "step_knobs": {k: extra.get(k) for k in
+                                            ("fused_update",
+                                             "wire_bucket_mb", "donate")}}
+    _fresh({"BIGDL_TPU_WIRE_BUCKET_MB": None,
+            "BIGDL_TPU_FUSED_UPDATE": None})
+
+    # ---- proxy 3: AOT cold vs warm -----------------------------------
+    cache_dir = tempfile.mkdtemp(prefix="perf_gate_aot_")
+    _fresh({"BIGDL_TPU_AOT_CACHE": cache_dir, "BIGDL_TPU_XLA_CACHE": "0"})
+    aot.reset()
+
+    def compile_cost(before, after):
+        return (after["compile_s"] - before["compile_s"] +
+                after["load_s"] - before["load_s"])
+
+    s0 = aot.stats()
+    step, args = _build_step(batch_size)
+    _run_steps(step, args, iters=1)
+    s1 = aot.stats()
+    _fresh({})  # clear jit caches: the warm build must go through disk
+    step, args = _build_step(batch_size)
+    _run_steps(step, args, iters=1)
+    s2 = aot.stats()
+    cold = compile_cost(s0, s1)
+    warm = compile_cost(s1, s2)
+    measured["aot.warm_over_cold"] = round(warm / max(cold, 1e-9), 4)
+    context["aot"] = {"compile_s_cold": round(cold, 3),
+                      "compile_s_warm": round(warm, 3),
+                      "hits": int(s2["hits"]), "misses": int(s2["misses"]),
+                      "stores": int(s2["stores"]),
+                      "cache_dir": cache_dir}
+    _fresh({"BIGDL_TPU_AOT_CACHE": None, "BIGDL_TPU_XLA_CACHE": None})
+    return measured, context
+
+
+def check(measured, baseline, time_slack=1.0):
+    """Diff measured against the baseline metrics.  Returns (rows,
+    regressions): one row per metric with a status, regressions the
+    subset that failed (baseline metrics with no measurement count)."""
+    rows, regressions = [], []
+    metrics = baseline.get("metrics", {})
+    for name in sorted(set(metrics) | set(measured)):
+        spec = metrics.get(name)
+        got = measured.get(name)
+        if spec is None:
+            rows.append((name, None, got, "NEW (not in baseline)"))
+            continue
+        want, match = spec["value"], spec.get("match", "exact")
+        if got is None:
+            rows.append((name, want, None, "MISSING (not measured)"))
+            regressions.append(name)
+            continue
+        if match == "exact":
+            ok = got == want
+            detail = f"exact {want}"
+        elif match == "max":
+            bound = want * float(spec.get("slack", 1.0)) * time_slack
+            ok = got <= bound
+            detail = f"<= {round(bound, 4)}"
+        elif match == "min":
+            ok = got >= want
+            detail = f">= {want}"
+        else:
+            ok, detail = False, f"unknown match kind {match!r}"
+        rows.append((name, want, got, "OK" if ok else f"REGRESSED ({detail})"))
+        if not ok:
+            regressions.append(name)
+    return rows, regressions
+
+
+def update_baseline(measured, path, existing):
+    """Write the measured structural values as the new baseline; ratio
+    bounds keep their existing (or default) values — an intentional perf
+    change is the committed diff of this file."""
+    old = existing.get("metrics", {}) if existing else {}
+    metrics = {}
+    for name in sorted(measured):
+        if name in DEFAULT_RATIO_BOUNDS:
+            metrics[name] = dict(old.get(name, DEFAULT_RATIO_BOUNDS[name]))
+        else:
+            entry = dict(old.get(name, {"match": "exact"}))
+            entry["value"] = measured[name]
+            metrics[name] = entry
+    blob = {"format": BASELINE_FORMAT,
+            "note": "committed perf baseline for tools/perf_gate.py; "
+                    "update ONLY via --update-baseline and review the diff",
+            "metrics": metrics}
+    with open(path, "w") as f:
+        json.dump(blob, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return blob
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path (default: repo "
+                         "PERF_BASELINE.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the measured values as the new baseline "
+                         "(structural counts overwritten, ratio bounds "
+                         "preserved) instead of gating")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) for smoke runs")
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except RuntimeError:
+            pass
+    # the regression demo (ISSUE 11 acceptance): an exported
+    # BIGDL_TPU_CONV_ROUTE=pad wins over this default and the conv-ops
+    # metric names the diff
+    os.environ.setdefault("BIGDL_TPU_CONV_ROUTE", "matmul")
+    # arm the compile-card ledger (in-memory; no artifacts unless the
+    # operator pointed BIGDL_TPU_COMPILE_CARDS at a dir already)
+    os.environ.setdefault("BIGDL_TPU_COMPILE_CARDS", "1")
+    os.environ.pop("BIGDL_TPU_AOT_CACHE", None)  # proxy 3 owns its dir
+
+    from bigdl_tpu.utils import config as _config
+
+    t0 = time.perf_counter()
+    measured, context = measure(args.batch_size)
+
+    existing = None
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            existing = json.load(f)
+
+    if args.update_baseline:
+        blob = update_baseline(measured, args.baseline, existing)
+        print(f"perf_gate: baseline updated -> {args.baseline} "
+              f"({len(blob['metrics'])} metrics)", file=sys.stderr)
+        print(json.dumps({"metric": "perf_gate", "ok": True,
+                          "updated_baseline": args.baseline,
+                          "measured": measured, "context": context}))
+        return 0
+
+    if existing is None:
+        print(f"perf_gate: no baseline at {args.baseline} — run "
+              "--update-baseline and commit the result", file=sys.stderr)
+        print(json.dumps({"metric": "perf_gate", "ok": False,
+                          "error": f"missing baseline {args.baseline}",
+                          "measured": measured}))
+        return 2
+
+    time_slack = _config.get_float("GATE_TIME_SLACK", 1.0)
+    rows, regressions = check(measured, existing, time_slack)
+    width = max(len(r[0]) for r in rows) + 2
+    for name, want, got, status in rows:
+        print(f"  {name:<{width}} baseline={want!r:<10} "
+              f"measured={got!r:<10} {status}", file=sys.stderr)
+    print(json.dumps({"metric": "perf_gate",
+                      "ok": not regressions,
+                      "regressions": regressions,
+                      "measured": measured,
+                      "context": context,
+                      "baseline": args.baseline,
+                      "time_slack": time_slack,
+                      "wall_s": round(time.perf_counter() - t0, 1)}))
+    if regressions:
+        print(f"perf_gate: REGRESSED: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

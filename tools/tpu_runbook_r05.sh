@@ -51,7 +51,10 @@ else
 fi
 
 echo "[runbook] 1/4 full bench (smoke=$SMOKE)" >> "$LOG"
+# --out: per-config incremental flush + error records — a round that dies
+# at backend init (rounds 3-5) still leaves /tmp/bench_r05_out.json.partial.json
 timeout "$BENCH_TIMEOUT" python bench.py "${PLATFORM_ARGS[@]}" "${BENCH_ARGS[@]}" \
+  --out /tmp/bench_r05_out.json \
   > /tmp/bench_r05_warm.json 2>/tmp/bench_r05_warm.log
 echo "[runbook] bench rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
 
@@ -212,6 +215,22 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] resilience smoke FAILED rc=$RESIL_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # perf-regression gate (cpu only): the CPU-measurable proxies (compiled
+  # conv-op count on the matmul route, wire bucket/up-cast counts, fused
+  # buffer count + donation aliases, AOT cold-vs-warm ratio, conv-route
+  # step-time ratio) diffed against the committed PERF_BASELINE.json —
+  # one JSON line, exit non-zero on any regression; intentional changes
+  # go through `perf_gate.py --update-baseline` + a reviewed diff
+  echo "[runbook] 2l/4 perf-regression gate (compile cards vs PERF_BASELINE.json)" >> "$LOG"
+  timeout 300 python tools/perf_gate.py --platform cpu \
+    > /tmp/perf_gate.json 2>/tmp/perf_gate.log
+  GATE_RC=$?
+  if [ "$GATE_RC" = 0 ]; then
+    echo "[runbook] perf gate OK (no metric regressed vs baseline) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] perf gate FAILED rc=$GATE_RC (see /tmp/perf_gate.log for the named metrics) at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -235,11 +254,12 @@ if [ "$SMOKE" != 1 ]; then
   mkdir -p /root/repo/bench_artifacts_r05
   cp -f /tmp/bench_r05_warm.json /root/repo/bench_artifacts_r05/bench_warm.json 2>/dev/null
   cp -f /tmp/bench_r05_warm.log /root/repo/bench_artifacts_r05/bench_warm.log 2>/dev/null
+  cp -f /tmp/bench_r05_out.json /tmp/bench_r05_out.json.partial.json /root/repo/bench_artifacts_r05/ 2>/dev/null
   cp -f /tmp/bn_experiment_r05.log /root/repo/bench_artifacts_r05/bn_experiment.log 2>/dev/null
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi

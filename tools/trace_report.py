@@ -6,19 +6,29 @@ writes ``trace.<rank>.json`` (Chrome trace-event JSON,
 ``bigdl_tpu.utils.telemetry``).  This tool merges all ranks onto one
 wall-clock timeline and prints the diagnosis a TensorBoard-less operator
 needs: per-phase p50/p95/max, the ``data_wait_fraction`` (input-bound vs
-compute-bound — same definition as bench.py's e2e stage), and straggler
-ranks (one slow host's ``step`` spans stand out against the median).
+compute-bound — same definition as bench.py's e2e stage), straggler
+ranks (one slow host's ``step`` spans stand out against the median),
+counter-track series in deterministic (sorted) order — including the
+``compile`` track compile cards emit (utils/hlostats.py) — and, when the
+``aot`` track is present, the AOT warm-start ledger
+(hits/misses/stores/lowers/compiles) as its own section.
 
 Usage::
 
     python tools/trace_report.py <trace-dir> [--out merged.json] [--json]
+    python tools/trace_report.py --diff <trace-dir-A> <trace-dir-B> [--json]
 
 ``--out`` writes the merged timeline (loadable in Perfetto as one file);
-``--json`` prints the breakdown as machine-readable JSON instead of the
-table.  Exit status is non-zero when the dir holds no trace files or the
-breakdown is empty (no spans) — the runbook's smoke stage asserts on it.
+``--json`` prints the breakdown (or diff) as machine-readable JSON
+instead of the table.  ``--diff A B`` compares two runs' phase
+breakdowns and counter tracks (A = baseline, B = new run) — per-phase
+total-time B/A ratios and per-series last-value deltas, the "what did
+this change do to the run" view `tools/perf_gate.py` automates for the
+committed proxies.  Exit status is non-zero when an input dir holds no
+trace files or the breakdown is empty (no spans) — the error names the
+offending path — and the runbook's smoke stage asserts on it.
 
-The heavy lifting (merge + breakdown + formatting) lives in
+The heavy lifting (merge + breakdown + diff + formatting) lives in
 ``bigdl_tpu.utils.telemetry`` so tests exercise it directly; this file is
 the CLI shell, like tools/supervise_smoke.py.
 """
@@ -37,11 +47,26 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
+def _load_breakdown(telemetry, trace_dir):
+    """(breakdown, merged) for one trace dir; exits 2 naming the path
+    when it holds no trace files."""
+    try:
+        merged = telemetry.merge_traces(trace_dir)
+    except FileNotFoundError as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return None, None
+    return telemetry.phase_breakdown(merged), merged
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("trace_dir",
                     help="dir holding trace.<rank>.json files (any file_io "
                          "scheme: local, memory://, gs://, ...)")
+    ap.add_argument("--diff", default=None, metavar="TRACE_DIR_B",
+                    help="compare TWO runs: trace_dir is the baseline (A), "
+                         "this dir the new run (B); prints per-phase B/A "
+                         "ratios and counter-track deltas")
     ap.add_argument("--out", default=None, metavar="MERGED_JSON",
                     help="also write the merged single-timeline trace here")
     ap.add_argument("--json", action="store_true",
@@ -50,23 +75,39 @@ def main(argv=None) -> int:
 
     from bigdl_tpu.utils import telemetry
 
-    try:
-        merged = telemetry.merge_traces(args.trace_dir)
-    except FileNotFoundError as e:
-        print(f"trace_report: {e}", file=sys.stderr)
+    breakdown, merged = _load_breakdown(telemetry, args.trace_dir)
+    if breakdown is None:
         return 2
+
+    if args.diff:
+        breakdown_b, _ = _load_breakdown(telemetry, args.diff)
+        if breakdown_b is None:
+            return 2
+        diff = telemetry.diff_breakdowns(breakdown, breakdown_b)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            print(f"A: {args.trace_dir}\nB: {args.diff}")
+            print(telemetry.format_diff(diff))
+        for name, which in (("A", breakdown), ("B", breakdown_b)):
+            if not which["phases"]:
+                path = args.trace_dir if name == "A" else args.diff
+                print(f"trace_report: {path}: trace holds no spans "
+                      "(empty breakdown)", file=sys.stderr)
+                return 3
+        return 0
+
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f)
         print(f"merged trace -> {args.out}", file=sys.stderr)
-    breakdown = telemetry.phase_breakdown(merged)
     if args.json:
         print(json.dumps(breakdown))
     else:
         print(telemetry.format_report(breakdown, merged))
     if not breakdown["phases"]:
-        print("trace_report: trace holds no spans (empty breakdown)",
-              file=sys.stderr)
+        print(f"trace_report: {args.trace_dir}: trace holds no spans "
+              "(empty breakdown)", file=sys.stderr)
         return 3
     return 0
 
