@@ -476,9 +476,11 @@ def _bench_config(name, build, peak_flops):
     Engine.reset()
     # per-CHIP numbers: bench on device 0 only, so flops/dt is divided by a
     # single device's peak (a mesh over N devices would inflate MFU by N).
-    # BIGDL_TPU_BENCH_LAYOUT="data,fsdp,tp" instead benches the config on a
-    # MeshLayout mesh with role-resolved FSDP/TP shardings
-    # (parallel/layout) — the per-device memory block below is where the
+    # BIGDL_TPU_BENCH_LAYOUT="data,fsdp,tp" (or the 5-axis
+    # "data,fsdp,tp,pipe,expert") instead benches the config on a
+    # MeshLayout mesh with role-resolved FSDP/TP/pipeline/expert
+    # shardings (parallel/layout) — the per-device memory block below is
+    # where the
     # 1/N footprint shows up in the trajectory.
     layout_env = os.environ.get("BIGDL_TPU_BENCH_LAYOUT")
     strategy = None
@@ -561,6 +563,11 @@ def _bench_config(name, build, peak_flops):
         memory = memstats.memory_record(box["params"], box["opt_state"])
         if layout_env:
             memory["layout"] = layout_env
+        # per-stage param bytes for pipelined configs (GPipeSequential):
+        # the pipe axis's 1/n-per-device claim, visible in the record
+        stages = memstats.pipeline_stage_bytes(model, box["params"])
+        if stages:
+            memory["pipeline_stages"] = stages
     except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
         _log(f"{name}: memory stats failed: {type(e).__name__}: {e}")
         memory = {"error": f"{type(e).__name__}: {e}"}
@@ -888,6 +895,50 @@ def _cfg_transformer_lm():
             jnp.ones((b, t), jnp.int32), 0.01)
 
 
+def _cfg_transformer_lm_pipe():
+    """GPipe-pipelined decoder LM: the repeated-block body partitioned
+    over the mesh 'pipe' axis (parallel/pipeline.partition_pipeline).
+    Under BIGDL_TPU_BENCH_LAYOUT=d,f,t,p,e with p>1 each pipe-mesh row
+    owns 1/p of the block stack (the record's memory.pipeline_stages
+    block shows the per-stage bytes); without a pipe axis the partition
+    degrades to the sequential math on one chip."""
+    import jax.numpy as jnp
+    from bigdl_tpu.common import DTypePolicy, set_policy
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    from bigdl_tpu.parallel import MeshLayout, partition_pipeline
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    layout_env = os.environ.get("BIGDL_TPU_BENCH_LAYOUT")
+    stages = MeshLayout.parse(layout_env).pipe if layout_env else 2
+    b, t = 16, 256
+    model = TransformerLM(vocab_size=16000, max_len=t, d_model=512,
+                          num_heads=8, num_layers=8)
+    model = partition_pipeline(model, max(stages, 2))
+    return (model,
+            TimeDistributedCriterion(ClassNLLCriterion(), size_average=True),
+            jnp.zeros((b, t), jnp.int32),
+            jnp.ones((b, t), jnp.int32), 0.01)
+
+
+def _cfg_transformer_moe():
+    """Switch-style MoE LM (parallel/expert.MoEFFN): expert tables carry
+    the expert_table role, so BIGDL_TPU_BENCH_LAYOUT=d,f,t,p,e with e>1
+    shards them 1/e over the 'expert' axis with all-to-all dispatch in
+    the compile card's collective counts."""
+    import jax.numpy as jnp
+    from bigdl_tpu.common import DTypePolicy, set_policy
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
+    b, t = 16, 256
+    return (TransformerLM(vocab_size=16000, max_len=t, d_model=512,
+                          num_heads=8, num_layers=4, num_experts=8,
+                          expert_axis="expert"),
+            TimeDistributedCriterion(ClassNLLCriterion(), size_average=True),
+            jnp.zeros((b, t), jnp.int32),
+            jnp.ones((b, t), jnp.int32), 0.01)
+
+
 def _cfg_lstm():
     import jax.numpy as jnp
     from bigdl_tpu.models.rnn import PTBModel
@@ -903,6 +954,8 @@ CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
            "inception_v1": _cfg_inception_v1,
            "textcnn": _cfg_textcnn, "lstm": _cfg_lstm,
            "transformer_lm": _cfg_transformer_lm,
+           "transformer_lm_pipe": _cfg_transformer_lm_pipe,
+           "transformer_moe": _cfg_transformer_moe,
            # inference (Predictor/Evaluator path, fwd-only MFU); after the
            # fast-compiling train configs so the soft budget prefers them
            "resnet50_infer_bf16": _cfg_resnet50_bf16,

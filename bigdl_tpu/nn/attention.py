@@ -4,7 +4,11 @@ long-context capability, SURVEY.md §5.7/§7).
 MultiHeadAttention: fused qkv projection -> flash attention (Pallas kernel on
 TPU, ops/attention.py) -> output projection.  With `seq_parallel=True` the
 attention core runs as a ring over the mesh 'seq' axis (parallel/ring_attention)
-so sequences sharded across devices never gather.
+so sequences sharded across devices never gather.  `BIGDL_TPU_RING_ATTN=1`
+instead reuses a MeshLayout's 'tp' axis as the sequence axis: on a tp>1
+mesh whose sequence length divides |tp|, the attention core rings over
+'tp' — long contexts shard across the tensor-parallel group with no extra
+mesh axis (parity-pinned on the CPU mesh, tests/test_pipeline_expert.py).
 """
 
 from __future__ import annotations
@@ -71,15 +75,37 @@ class MultiHeadAttention(Module):
             y = y + params["b" + name].astype(c)
         return y
 
+    def _ring_over_tp(self, T):
+        """The env-gated ring-attention seam: a MeshLayout 'tp' axis
+        doubles as the sequence axis when BIGDL_TPU_RING_ATTN is set and
+        the sequence divides it (parallel/ring_attention)."""
+        from ..utils import config
+        if not config.get_bool("RING_ATTN", False):
+            return None
+        from ..parallel.pipeline import _active_mesh
+        mesh = _active_mesh()
+        if mesh is None or "tp" not in mesh.axis_names:
+            return None
+        n = int(mesh.shape["tp"])
+        if n <= 1 or T % n:
+            return None
+        return mesh
+
     def _apply(self, params, x):
         B, T, E = x.shape
         H, D = self.num_heads, self.head_dim
         split = lambda y: y.reshape(B, T, H, D).transpose(0, 2, 1, 3)
         q, k, v = (split(self._proj(params, x, n)) for n in "qkv")
+        ring_mesh = None if self.seq_parallel else self._ring_over_tp(T)
         if self.seq_parallel:
             from ..parallel.ring_attention import ring_attention
             o = ring_attention(q, k, v, seq_axis=self.seq_axis,
                                causal=self.causal)
+        elif ring_mesh is not None:
+            from ..parallel.ring_attention import ring_attention
+            o = ring_attention(q, k, v, mesh=ring_mesh, seq_axis="tp",
+                               causal=self.causal,
+                               batch_axis=("data", "fsdp"))
         else:
             from ..ops.attention import flash_attention
             o = flash_attention(q, k, v, causal=self.causal)

@@ -318,6 +318,10 @@ class Optimizer:
         # the step's compile-card self-description (knobs + wire-bucket +
         # fused-buffer counts; _build_step fills it, utils/hlostats reads)
         self._card_extra = {}
+        # (pipe_axis_size, GPipeSequential) when the model pipelines over
+        # a pipe>1 mesh (_build_step fills it) — arms the per-step
+        # train.pipe_bubble_fraction counter beside mfu
+        self._pipe_info = None
         # straggler mitigation (reference: Optimizer.setDropModuleProperty,
         # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
         self.drop_percentage = 0.0
@@ -700,6 +704,23 @@ class Optimizer:
                 fused_mod.plan(model.params).groups)
         else:
             card_extra["fused_buffers"] = 0
+        # pipeline self-description (parallel/pipeline.GPipeSequential on
+        # a pipe>1 mesh): stage/microbatch counts + the GPipe bubble
+        # bound ride the compile card (perf gate rows) and arm the
+        # per-step train.pipe_bubble_fraction counter
+        from ..parallel import pipeline as pipe_mod
+        self._pipe_info = None
+        pipes = [m for m in model.unique_modules()
+                 if isinstance(m, pipe_mod.GPipeSequential)]
+        pipe_n = (int(mesh.shape["pipe"])
+                  if "pipe" in mesh.axis_names else 1)
+        if pipes and pipe_n > 1:
+            mb = pipes[0].num_microbatches or pipe_mod.pipe_microbatches()
+            self._pipe_info = (pipe_n, pipes[0])
+            card_extra["pipe_stages"] = pipe_n
+            card_extra["pipe_microbatches"] = mb
+            card_extra["pipe_bubble_fraction"] = round(
+                pipe_mod.bubble_fraction(pipe_n, mb), 4)
         self._card_extra = card_extra
 
         remat = self.remat_policy
@@ -1500,6 +1521,17 @@ class Optimizer:
                     counters["collective_s"] = self._collective_s
                     counters["collective_fraction"] = min(
                         1.0, self._collective_s / max(step_dur, 1e-9))
+                if self._pipe_info is not None:
+                    # GPipe idle bound (n-1)/(m+n-1) for the schedule the
+                    # step actually baked in (the configured microbatch
+                    # knob, clamped to divide the local batch)
+                    from ..parallel import pipeline as pipe_mod
+                    n_pipe, pmod = self._pipe_info
+                    mb = (pmod._last_microbatches
+                          or pmod.num_microbatches
+                          or pipe_mod.pipe_microbatches())
+                    counters["pipe_bubble_fraction"] = round(
+                        pipe_mod.bubble_fraction(n_pipe, mb), 4)
                 telemetry.counter("train", **counters)
                 # per-parameter histograms when a "Parameters" trigger is set
                 # (reference: DistriOptimizer.saveSummary :426-456 — off by

@@ -19,7 +19,9 @@ from .layout import (MeshLayout, UnannotatedParameterError, MeshReformError,
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
                        TensorParallel, LayoutSharding)
 from .ring_attention import ring_attention, ulysses_attention
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (pipeline_apply, stack_stage_params, GPipeSequential,
+                       partition_pipeline, PipelinePartitionError,
+                       pipe_microbatches, bubble_fraction)
 from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
                      load_balancing_loss)
 from .elastic import PeerLostError, ElasticNegotiationError
@@ -28,6 +30,8 @@ __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
            "TensorParallel", "LayoutSharding", "MeshLayout",
            "UnannotatedParameterError", "MeshReformError", "assign_specs",
            "assign_shardings", "ring_attention", "ulysses_attention",
-           "pipeline_apply", "stack_stage_params", "MoEFFN",
+           "pipeline_apply", "stack_stage_params", "GPipeSequential",
+           "partition_pipeline", "PipelinePartitionError",
+           "pipe_microbatches", "bubble_fraction", "MoEFFN",
            "expert_parallel_ffn", "top_k_routing", "load_balancing_loss",
            "PeerLostError", "ElasticNegotiationError"]

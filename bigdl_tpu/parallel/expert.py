@@ -111,21 +111,27 @@ class MoEFFN(Module):
     """Mixture-of-experts FFN block: gate → top-k capacity routing →
     per-expert 2-layer ReLU FFN → combine.
 
-    GSPMD integration: on a mesh with an `expert` axis, pass
-    `expert_axis="expert"` — the expert-major dispatch buffers and the
-    stacked expert weights get `with_sharding_constraint(P(axis))` hints
-    and XLA lowers the expert matmuls sharded with all-to-all routing.
-    Off-mesh (tests, single chip) the same math runs dense.
+    GSPMD integration: on a mesh with an `expert` axis the expert-major
+    dispatch buffers and the stacked expert weights get
+    `with_sharding_constraint(P('expert'))` hints and XLA lowers the
+    expert matmuls sharded with all-to-all routing; under LayoutSharding
+    the stacked tables additionally carry the `expert_table` role so the
+    strategy PLACES them 1/E over the axis (parallel/layout — the way
+    `embedding_row` shards LookupTable).  On a legacy or 1-wide mesh (no
+    `expert` axis) the constraint degrades silently to replicated
+    experts with no all-to-all — the same math, dense; single-chip and
+    tier-1 runs cover that path.
 
     capacity_factor: C = ceil(k * T / E * capacity_factor).
     """
 
-    PARAM_ROLES = {"gate": "kernel_in", "w1": "kernel_in",
-                   "w2": "kernel_in", "b1": "bias", "b2": "bias"}
+    PARAM_ROLES = {"gate": "kernel_in", "w1": "expert_table",
+                   "w2": "expert_table", "b1": "expert_table",
+                   "b2": "expert_table"}
 
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  k: int = 1, capacity_factor: float = 1.25,
-                 expert_axis: Optional[str] = None):
+                 expert_axis: Optional[str] = "expert"):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
@@ -166,6 +172,15 @@ class MoEFFN(Module):
 
     def _constrain(self, v):
         if self.expert_axis is None:
+            return v
+        from .pipeline import _active_mesh
+        mesh = _active_mesh()
+        if mesh is not None and (
+                self.expert_axis not in mesh.axis_names
+                or int(mesh.shape[self.expert_axis]) <= 1):
+            # legacy/1-wide mesh: the DOCUMENTED graceful degrade —
+            # replicated expert tables, no all-to-all, same math.  Not a
+            # warning: every single-chip and pure-DP run lands here.
             return v
         try:
             spec = P(self.expert_axis)
@@ -229,8 +244,22 @@ def expert_parallel_ffn(mesh, params, x, *, k: int = 1,
     x: [T, D] global tokens, T divisible by the axis size.
     Returns [T, D], numerically matching the dense MoEFFN math whenever no
     token overflows capacity (the parity tests assert this).
+
+    On a legacy/1-wide mesh (no `axis`, or |axis| == 1) this degrades
+    gracefully to the dense single-shard math — replicated tables, no
+    all-to-all — instead of assuming the axis exists.
     """
     import math
+
+    if axis not in mesh.axis_names or int(mesh.shape[axis]) <= 1:
+        cap = max(1, math.ceil(k * x.shape[0] / params["w1"].shape[0]
+                               * capacity_factor))
+        logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)
+        combine, dispatch, _, _ = top_k_routing(logits, cap, k)
+        buf = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        out = _expert_ffn(buf, params["w1"], params["b1"], params["w2"],
+                          params["b2"])
+        return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
 
     n = mesh.shape[axis]
     E = params["w1"].shape[0]

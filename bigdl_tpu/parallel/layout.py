@@ -1,4 +1,5 @@
-"""MeshLayout: named data/fsdp/tp mesh axes + role-based PartitionSpecs.
+"""MeshLayout: named data/fsdp/tp/pipe/expert mesh axes + role-based
+PartitionSpecs.
 
 The reference BigDL only ever scales out with synchronous data-parallel
 SGD over the Spark block manager: every node holds a FULL parameter
@@ -40,6 +41,19 @@ existing strategies — parallel/sharding.py):
   axes and ``LookupTable`` rows split over it; the batch REPLICATES
   across it (every tp shard sees the same rows and computes a slice of
   the features).
+- ``pipe``: GPipe-style pipeline stages (parallel/pipeline).  A
+  ``GPipeSequential``'s stacked per-stage parameters shard their
+  leading stage axis over it (role ``pipeline_stage``); the batch
+  replicates across it and flows through the stages microbatched.
+- ``expert``: expert parallelism (parallel/expert).  ``MoEFFN``'s
+  stacked per-expert tables shard their leading expert axis over it
+  (role ``expert_table``); tokens reach their experts via the
+  all-to-all GSPMD inserts for the dispatch/combine einsums.
+
+``pipe`` and ``expert`` default to 1 and a layout with both at 1 builds
+the SAME 3-axis ``(data, fsdp, tp)`` mesh as before — every existing
+code path, test, and AOT fingerprint is unchanged until an axis is
+actually requested.
 
 Because sharding under GSPMD never changes program semantics — only
 layout and collective placement — a role assignment is always CORRECT;
@@ -66,6 +80,8 @@ __all__ = ["MeshLayout", "UnannotatedParameterError", "MeshReformError",
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 TP_AXIS = "tp"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 #: the canonical roles (documented in docs/parallelism.md).  Each maps to
 #: (tp_axis_index, fsdp_axis_index) into the LEAF's shape — None = the
@@ -88,6 +104,13 @@ ROLES: Dict[str, Tuple[Optional[int], Optional[int]]] = {
     "norm_scale": (None, None),
     "elementwise": (None, None),
     "scalar": (None, None),
+    # stacked per-stage params [n_stages, ...]: leading axis over 'pipe'
+    # (parallel/pipeline.GPipeSequential; see _spec_for special case)
+    "pipeline_stage": (None, None),
+    # stacked per-expert tables [E, ...]: leading axis over 'expert' the
+    # way embedding_row shards LookupTable rows, with an fsdp fallback on
+    # the remaining axes (parallel/expert.MoEFFN; _spec_for special case)
+    "expert_table": (None, None),
 }
 
 
@@ -115,61 +138,83 @@ def fsdp_min_size() -> int:
 
 @dataclass(frozen=True)
 class MeshLayout:
-    """Axis names + sizes of the canonical ``data x fsdp x tp`` mesh.
+    """Axis names + sizes of the canonical ``data x fsdp x tp x pipe x
+    expert`` mesh.
 
     ``(W, 1, 1)`` is today's pure data parallelism; ``(1, 1, 1)`` the
     single-device case — size-1 axes still EXIST in the mesh (specs can
     always name them; sharding over a 1-axis is the identity), so the
-    same compiled-step code path covers every configuration.
+    same compiled-step code path covers every configuration.  ``pipe``
+    and ``expert`` default to 1 and STAY OUT of the built mesh then
+    (the mesh is the 3-axis triple, byte-for-byte the pre-pipeline
+    behavior — same AOT fingerprints); any 5-axis layout builds the
+    full 5-axis mesh, with size-1 axes present so specs can name them.
     """
 
     data: int = 1
     fsdp: int = 1
     tp: int = 1
+    pipe: int = 1
+    expert: int = 1
 
-    AXES = (DATA_AXIS, FSDP_AXIS, TP_AXIS)
+    AXES = (DATA_AXIS, FSDP_AXIS, TP_AXIS, PIPE_AXIS, EXPERT_AXIS)
+    LEGACY_AXES = (DATA_AXIS, FSDP_AXIS, TP_AXIS)
 
     @property
-    def sizes(self) -> Tuple[int, int, int]:
-        return (self.data, self.fsdp, self.tp)
+    def sizes(self) -> Tuple[int, ...]:
+        """Sizes matching :meth:`axis_names` (3-tuple at
+        pipe=expert=1, else the full 5-tuple)."""
+        if self.pipe == 1 and self.expert == 1:
+            return (self.data, self.fsdp, self.tp)
+        return (self.data, self.fsdp, self.tp, self.pipe, self.expert)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return self.LEGACY_AXES if len(self.sizes) == 3 else self.AXES
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tp
+        return self.data * self.fsdp * self.tp * self.pipe * self.expert
 
     def __post_init__(self):
-        if min(self.sizes) < 1:
+        if min(self.data, self.fsdp, self.tp, self.pipe, self.expert) < 1:
             raise ValueError(f"MeshLayout axis sizes must be >= 1: {self}")
 
     @classmethod
     def parse(cls, text: str) -> "MeshLayout":
-        """'2,2,1' (data,fsdp,tp) -> MeshLayout — the env/CLI spelling
-        (bench.py BIGDL_TPU_BENCH_LAYOUT, tools/shard_smoke.py)."""
+        """'2,2,1' (data,fsdp,tp) or '1,1,1,2,1' (data,fsdp,tp,pipe,
+        expert) -> MeshLayout — the env/CLI spelling (bench.py
+        BIGDL_TPU_BENCH_LAYOUT, tools/shard_smoke.py,
+        tools/pipeline_smoke.py).  3-tuples stay valid: absent axes
+        default to 1."""
         parts = [int(p) for p in str(text).replace("x", ",").split(",")]
-        if len(parts) != 3:
+        if len(parts) not in (3, 5):
             raise ValueError(
-                f"layout {text!r}: expected 'data,fsdp,tp' (3 ints)")
+                f"layout {text!r}: expected 'data,fsdp,tp' (3 ints) or "
+                "'data,fsdp,tp,pipe,expert' (5 ints)")
         return cls(*parts)
 
     @classmethod
     def of_mesh(cls, mesh: Mesh) -> Optional["MeshLayout"]:
         """Recover the layout from a mesh built by build_mesh (axis
-        names are the canonical triple); None for legacy meshes."""
-        if tuple(mesh.axis_names) != cls.AXES:
+        names are the canonical triple or quintuple); None for legacy
+        meshes."""
+        names = tuple(mesh.axis_names)
+        if names not in (cls.AXES, cls.LEGACY_AXES):
             return None
-        return cls(*(int(mesh.shape[a]) for a in cls.AXES))
+        return cls(*(int(mesh.shape[a]) for a in names))
 
     def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
         """The jax Mesh: `devices` (default jax.devices()) reshaped to
-        (data, fsdp, tp).  Extra devices beyond the layout's size are
-        left out (a (2,2,1) layout on an 8-device host uses 4)."""
+        the layout's axis sizes.  Extra devices beyond the layout's size
+        are left out (a (2,2,1) layout on an 8-device host uses 4)."""
         devs = list(devices) if devices is not None else list(jax.devices())
         if len(devs) < self.size:
             raise ValueError(
                 f"MeshLayout {self.sizes} needs {self.size} devices, "
                 f"have {len(devs)}")
         arr = np.array(devs[: self.size]).reshape(self.sizes)
-        return Mesh(arr, self.AXES)
+        return Mesh(arr, self.axis_names)
 
     def install(self, devices: Optional[Sequence] = None) -> Mesh:
         """Build the mesh and make it the Engine's process-wide mesh."""
@@ -182,7 +227,7 @@ class MeshLayout:
 
     def batch_spec(self) -> P:
         """Batch rows shard over data x fsdp (fsdp is a second data
-        axis); tp replicates the batch."""
+        axis); tp, pipe, and expert replicate the batch."""
         return P((DATA_AXIS, FSDP_AXIS))
 
     def spec_for(self, role: str, shape: Sequence[int],
@@ -209,6 +254,28 @@ class MeshLayout:
             return ax if 0 <= ax < ndim else None
 
         tp_ax, fsdp_ax = ROLES[role]
+        if role == "pipeline_stage" and ndim >= 1:
+            # the stacked per-stage leading axis over 'pipe'; a 1-wide
+            # (or legacy) layout leaves the stack replicated — the GPipe
+            # wrapper then runs its stages sequentially, same math
+            if self.pipe > 1 and shape[0] % self.pipe == 0:
+                parts[0] = PIPE_AXIS
+            return P(*parts)
+        if role == "expert_table" and ndim >= 1:
+            # stacked expert tables [E, ...]: experts over 'expert' the
+            # way embedding_row shards vocab rows; the per-expert slices
+            # can additionally fsdp-shard over a remaining divisible
+            # axis (largest first) so a fsdp x expert layout stacks both
+            # memory wins
+            if self.expert > 1 and shape[0] % self.expert == 0 and \
+                    size >= min_size:
+                parts[0] = EXPERT_AXIS
+            if self.fsdp > 1 and size >= min_size:
+                for ax in sorted(range(ndim), key=lambda i: -shape[i]):
+                    if parts[ax] is None and shape[ax] % self.fsdp == 0:
+                        parts[ax] = FSDP_AXIS
+                        break
+            return P(*parts)
         if role == "embedding_row" and ndim >= 1:
             # rows over fsdp x tp together; degrade to fsdp alone, then
             # tp alone, when the vocab axis does not divide the product

@@ -14,20 +14,71 @@ differentiates straight through it — the backward pass is automatically the
 reverse pipeline (ppermute transposes to the reverse ring), with no manual
 1F1B bookkeeping.  Rematerialization: pass remat=True to checkpoint each
 stage application, trading FLOPs for activation memory (HBM).
+
+MeshLayout promotion (ISSUE 12): :class:`GPipeSequential` wraps the raw
+schedule as a Module whose stacked per-stage params carry the
+``pipeline_stage`` role (leading stage axis sharded ``P('pipe')`` by
+LayoutSharding), so the whole existing Optimizer machinery — the jitted
+step, fused update, bf16 wire, donation, AOT cache, compile cards,
+elastic reform — applies to the pipelined step unchanged.
+:func:`partition_pipeline` builds one from any ``Sequential`` (or
+linear-chain ``Graph``) whose children split into structurally identical
+stages.  On a mesh without a >1 ``pipe`` axis the wrapper runs its
+stages sequentially off the stacked axis — same math, no schedule — so
+legacy meshes and single-device tier-1 cover the code path.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..nn.module import Module
+from ..utils import config as _config
 from ..utils.compat import shard_map
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "stack_stage_params", "GPipeSequential",
+           "partition_pipeline", "PipelinePartitionError",
+           "pipe_microbatches", "bubble_fraction"]
+
+
+class PipelinePartitionError(TypeError):
+    """A model cannot be partitioned into pipeline stages (children do
+    not split into structurally identical groups, a stage carries
+    running state, or the stage count disagrees with the mesh's 'pipe'
+    axis).  Deliberately typed and loud: a silently unpartitioned model
+    would train replicated and defeat the pipeline memory claim."""
+
+
+def pipe_microbatches() -> int:
+    """``BIGDL_TPU_PIPE_MICROBATCHES``: microbatches per GPipe schedule
+    tick loop (default 4).  More microbatches shrink the pipeline bubble
+    — fraction (n-1)/(m+n-1) for n stages — at the cost of smaller
+    per-tick matmuls (docs/parallelism.md "Microbatch sizing")."""
+    return max(1, _config.get_int("PIPE_MICROBATCHES", 4))
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the classic GPipe schedule: (n-1)/(m+n-1)."""
+    n, m = int(num_stages), int(num_microbatches)
+    return (n - 1) / max(m + n - 1, 1)
+
+
+def _active_mesh() -> Optional[Mesh]:
+    """The mesh in scope: the `with mesh:` context if any, else the
+    Engine's already-built mesh (never triggers device discovery)."""
+    try:  # private fallback, guarded like ring_attention._current_mesh
+        env = jax._src.mesh.thread_resources.env
+        if env.physical_mesh and not env.physical_mesh.empty:
+            return env.physical_mesh
+    except AttributeError:
+        pass
+    from ..utils.engine import Engine
+    return Engine._mesh
 
 
 def stack_stage_params(param_list):
@@ -112,8 +163,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
     lead = jax.tree.leaves(stacked_params)[0].shape[0]
     if lead != n:
         raise ValueError(f"stacked_params leading axis {lead} != |{pipe_axis}|={n}")
-    batch = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
-        else None
+    # batch_axis may be one axis name or a tuple (MeshLayout batches shard
+    # over data x fsdp); absent axes drop out
+    if batch_axis and not isinstance(batch_axis, (list, tuple)):
+        batch_axis = (batch_axis,)
+    batch = tuple(a for a in (batch_axis or ())
+                  if a and a in mesh.axis_names) or None
     pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     xspec = P(batch)
     from ..utils.compat import has_vma_marking, shard_map_unchecked
@@ -124,8 +179,236 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
     fn = wrap(
         partial(_pipe_local, stage_fn=stage_fn, axis_name=pipe_axis,
                 num_microbatches=num_microbatches, remat=remat,
-                vary_axes=(batch,) if batch else ()),
+                vary_axes=batch or ()),
         mesh=mesh,
         in_specs=(pspec, xspec),
         out_specs=xspec)
     return fn(stacked_params, x)
+
+
+# ---------------------------------------------------------------------------
+# MeshLayout promotion: the pipeline as a first-class Module
+# ---------------------------------------------------------------------------
+
+def _stage_signature(module: Module, params):
+    """Structural identity of one stage candidate: module class chain +
+    the params treedef + leaf shapes/dtypes.  Two stages with equal
+    signatures can share one SPMD stage function."""
+    def classes(m):
+        kids = getattr(m, "modules", None)
+        return (type(m).__name__,
+                tuple(classes(c) for c in kids) if kids is not None else ())
+    leaves, treedef = jax.tree.flatten(params)
+    return (classes(module), str(treedef),
+            tuple((tuple(l.shape), str(getattr(l, "dtype", "?")))
+                  for l in leaves))
+
+
+class GPipeSequential(Module):
+    """N structurally identical stages run as a GPipe pipeline over the
+    mesh 'pipe' axis.
+
+    Params are the stages' param pytrees STACKED along a new leading
+    stage axis (role ``pipeline_stage`` -> ``P('pipe')`` under
+    LayoutSharding), so each pipe-mesh row owns exactly one stage —
+    the per-device parameter footprint is 1/n of the stage stack.  The
+    forward is :func:`pipeline_apply`'s microbatched schedule
+    (``BIGDL_TPU_PIPE_MICROBATCHES`` ticks through ``lax.scan``); on a
+    mesh whose 'pipe' axis is absent or 1-wide the stages run
+    sequentially off the stacked axis — identical math, so legacy
+    meshes degrade gracefully and loss parity holds by construction.
+
+    Restrictions (the standard SPMD-pipeline contract, checked loudly):
+    stages must be structurally identical, stateless (no BatchNorm
+    running stats), shape-preserving, and free of per-stage randomness
+    (dropout inside a stage runs in its eval form).
+    """
+
+    PARAM_ROLES = {"*": "pipeline_stage"}
+
+    def __init__(self, stages: Sequence[Module],
+                 num_microbatches: Optional[int] = None,
+                 pipe_axis: str = "pipe", remat: bool = False):
+        super().__init__()
+        if not stages:
+            raise PipelinePartitionError("GPipeSequential needs >= 1 stage")
+        self.stages: List[Module] = list(stages)
+        self.num_microbatches = num_microbatches
+        self.pipe_axis = pipe_axis
+        self.remat = remat
+        # last microbatch count actually baked into a traced schedule
+        # (the configured knob clamped to divide the batch) — the
+        # Optimizer's pipe_bubble_fraction counter reads it
+        self._last_microbatches: Optional[int] = None
+        self._stage_state = None
+        self._validate_stages()
+
+    def _validate_stages(self):
+        sigs, states = [], []
+        for m in self.stages:
+            p_shape, s_shape = jax.eval_shape(m.init, jax.random.key(0))
+            sigs.append(_stage_signature(m, p_shape))
+            states.append(s_shape)
+        if any(s != sigs[0] for s in sigs[1:]):
+            raise PipelinePartitionError(
+                "GPipeSequential stages are not structurally identical "
+                "(SPMD pipelining stacks stage params along one axis; "
+                "every stage must share the module/param structure): "
+                f"{[s[0] for s in sigs]}")
+        if jax.tree.leaves(states[0]):
+            raise PipelinePartitionError(
+                f"pipeline stage {type(self.stages[0]).__name__} carries "
+                "running state (e.g. BatchNorm statistics); stages must "
+                "be stateless — keep stateful layers outside the "
+                "pipelined region")
+        # array-free state tree: safe to reuse as the per-stage template
+        self._stage_state = states[0]
+
+    def init(self, rng):
+        keys = jax.random.split(rng, len(self.stages))
+        ps = [m.init(k)[0] for m, k in zip(self.stages, keys)]
+        return stack_stage_params(ps), {}
+
+    def _apply_sequential(self, params, x, training):
+        y = x
+        for i in range(len(self.stages)):
+            pi = jax.tree.map(lambda l, _i=i: l[_i], params)
+            y, _ = self.stages[0].apply(pi, self._stage_state, y,
+                                        training=training, rng=None)
+        return y
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        mesh = _active_mesh()
+        n = len(self.stages)
+        pipe_n = (int(mesh.shape[self.pipe_axis])
+                  if mesh is not None and self.pipe_axis in mesh.axis_names
+                  else 1)
+        if pipe_n <= 1:
+            # legacy/1-wide mesh: no schedule, same math
+            return self._apply_sequential(params, x, training), state
+        if pipe_n != n:
+            raise PipelinePartitionError(
+                f"GPipeSequential has {n} stages but the mesh "
+                f"'{self.pipe_axis}' axis is {pipe_n}-wide — re-partition "
+                f"the model (partition_pipeline(model, {pipe_n})) or "
+                "rebuild the layout")
+        batch_axes = tuple(a for a in ("data", "fsdp")
+                           if a in mesh.axis_names)
+        shards = 1
+        for a in batch_axes:
+            shards *= int(mesh.shape[a])
+        local_b = x.shape[0] // max(shards, 1)
+        m = self.num_microbatches or pipe_microbatches()
+        while local_b % m:  # largest feasible count <= the configured knob
+            m -= 1
+        self._last_microbatches = m
+        stage0, st = self.stages[0], self._stage_state
+
+        def stage_fn(p, xm):
+            y, _ = stage0.apply(p, st, xm, training=training, rng=None)
+            return y
+
+        y = pipeline_apply(stage_fn, params, x, mesh=mesh,
+                           pipe_axis=self.pipe_axis, num_microbatches=m,
+                           batch_axis=batch_axes or None, remat=self.remat)
+        return y, state
+
+
+def _chain_modules(model) -> List[Module]:
+    """Ordered child modules of a Sequential or a linear-chain Graph."""
+    from ..nn.containers import Sequential
+    from ..nn.graph import Graph, _InputModule
+    if isinstance(model, Sequential):
+        return list(model.modules)
+    if isinstance(model, Graph):
+        if len(model.input_nodes) != 1 or len(model.output_nodes) != 1:
+            raise PipelinePartitionError(
+                "pipeline partitioning needs a single-input single-output "
+                f"Graph; got {len(model.input_nodes)} inputs / "
+                f"{len(model.output_nodes)} outputs")
+        chain = []
+        for node in model.exec_order:
+            if len(node.prev_nodes) > 1 or len(node.next_nodes) > 1:
+                raise PipelinePartitionError(
+                    "pipeline partitioning needs a LINEAR Graph (every "
+                    "node one predecessor/successor); node "
+                    f"{node.element.name} has {len(node.prev_nodes)} "
+                    f"inputs / {len(node.next_nodes)} outputs — wrap "
+                    "branches inside a single stage module instead")
+            if not isinstance(node.element, _InputModule):
+                chain.append(node.element)
+        return chain
+    raise PipelinePartitionError(
+        f"cannot partition a {type(model).__name__} into pipeline stages "
+        "(need a Sequential or a linear-chain Graph)")
+
+
+def partition_pipeline(model, num_stages: int,
+                       num_microbatches: Optional[int] = None,
+                       remat: bool = False):
+    """Split a Sequential/Graph model over the 'pipe' axis.
+
+    Finds the longest contiguous run of children that divides into
+    `num_stages` structurally identical groups (the repeated-block body
+    of a transformer-style model), wraps it in :class:`GPipeSequential`,
+    and returns ``Sequential(prelude..., pipeline, postlude...)``.
+    Already-built params are carried over (stage groups stacked along
+    the new stage axis), so the partitioned model computes exactly what
+    the original did.  Raises :class:`PipelinePartitionError` when no
+    such run exists.
+    """
+    from ..nn.containers import Sequential
+    num_stages = int(num_stages)
+    if num_stages < 1:
+        raise PipelinePartitionError(f"num_stages must be >= 1, "
+                                     f"got {num_stages}")
+    children = _chain_modules(model)
+    shapes = [jax.eval_shape(m.init, jax.random.key(0))[0]
+              for m in children]
+    sigs = [_stage_signature(m, p) for m, p in zip(children, shapes)]
+    L = len(children)
+    best = None  # (region_len, start, group_len)
+    for g in range(L // num_stages, 0, -1):
+        span = g * num_stages
+        for start in range(0, L - span + 1):
+            groups = [tuple(sigs[start + i * g: start + (i + 1) * g])
+                      for i in range(num_stages)]
+            if all(gr == groups[0] for gr in groups[1:]):
+                cand = (span, start, g)
+                if best is None or cand[0] > best[0]:
+                    best = cand
+        if best is not None:
+            break  # g decreases: the first hit is the longest region
+    if best is None:
+        raise PipelinePartitionError(
+            f"cannot split {L} children into {num_stages} structurally "
+            "identical contiguous stages — pipeline partitioning needs a "
+            "repeated-block body (e.g. N identical transformer blocks); "
+            f"child classes: {[type(m).__name__ for m in children]}")
+    span, start, g = best
+    groups = [children[start + i * g: start + (i + 1) * g]
+              for i in range(num_stages)]
+    stage_mods = [ms[0] if g == 1 else Sequential(*ms) for ms in groups]
+    pipe = GPipeSequential(stage_mods, num_microbatches=num_microbatches,
+                           remat=remat)
+    out = Sequential(*children[:start], pipe, *children[start + span:])
+    if getattr(model, "params", None) is not None and \
+            isinstance(model, Sequential):
+        cp = list(model.params)  # child params, list-aligned
+        if not (isinstance(cp, list) and len(cp) == L):
+            raise PipelinePartitionError(
+                "built model params are not child-aligned; rebuild the "
+                "model before partitioning")
+        stage_params = [cp[start + i * g: start + (i + 1) * g]
+                        for i in range(num_stages)]
+        if g == 1:
+            stage_params = [sp[0] for sp in stage_params]
+        stacked = stack_stage_params(stage_params)
+        out.params = (cp[:start] + [stacked] + cp[start + span:])
+        st = list(model.state) if isinstance(model.state, list) else None
+        out.state = ((st[:start] + [{}] + st[start + span:])
+                     if st is not None and len(st) == L else None)
+        if out.state is None:
+            _, out.state = out.init(jax.random.key(0))
+        out.grads = jax.tree.map(jnp.zeros_like, out.params)
+    return out

@@ -175,13 +175,17 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if mesh is None:
         mesh = _current_mesh()
-    batch = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
-        else None
+    # batch_axis: one name or a tuple (MeshLayout batches span data x
+    # fsdp); absent axes drop out
+    if batch_axis and not isinstance(batch_axis, (list, tuple)):
+        batch_axis = (batch_axis,)
+    batch = tuple(a for a in (batch_axis or ())
+                  if a and a in mesh.axis_names) or None
     spec = P(batch, None, seq_axis, None)
     fn = shard_map(
         partial(_ring_attn_local, axis_name=seq_axis, causal=causal,
                 sm_scale=sm_scale,
-                vary_axes=(batch,) if batch else ()),
+                vary_axes=batch or ()),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

@@ -325,9 +325,9 @@ class Engine:
     def _shrink_data_axis(cls, mesh: Mesh, devs) -> Mesh:
         """Re-form a MULTI-AXIS mesh over a surviving device slice by
         shrinking the 'data' axis and keeping every other axis (the
-        fsdp x tp block of a MeshLayout) intact.  When the survivor
-        count is not a multiple of the non-data block — the fsdp/tp
-        groups cannot be preserved — this raises the typed
+        fsdp x tp x pipe x expert block of a MeshLayout) intact.  When
+        the survivor count is not a multiple of the non-data block —
+        the shard groups cannot be preserved — this raises the typed
         MeshReformError instead of silently re-laying-out sharded
         parameters (parallel/layout; drilled by tests/test_layout.py)."""
         from ..parallel.layout import MeshReformError
@@ -346,8 +346,9 @@ class Engine:
                 f"{len(devs)} surviving device(s): the non-data block "
                 f"({ {a: s for i, (a, s) in enumerate(zip(names, sizes)) if i != di} }"
                 f" = {block} devices) must divide the survivor count to "
-                "keep fsdp/tp shard groups intact; shrink to a multiple "
-                f"of {block} devices or re-init a smaller layout")
+                "keep the fsdp/tp/pipe/expert shard groups intact; shrink "
+                f"to a multiple of {block} devices or re-init a smaller "
+                "layout")
         sizes[di] = len(devs) // block
         logger.warning("Engine.reform: mesh %s -> %s over %d device(s)",
                        dict(mesh.shape), dict(zip(names, sizes)), len(devs))

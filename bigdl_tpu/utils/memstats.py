@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 
 __all__ = ["device_memory_stats", "live_device_bytes", "tree_device_bytes",
-           "tree_total_bytes", "memory_record"]
+           "tree_total_bytes", "memory_record", "pipeline_stage_bytes"]
 
 
 def device_memory_stats(device=None) -> Optional[dict]:
@@ -85,6 +85,37 @@ def tree_total_bytes(tree) -> int:
             nbytes = int(leaf.size) * leaf.dtype.itemsize
         total += int(nbytes or 0)
     return total
+
+
+def pipeline_stage_bytes(model, params, device=None):
+    """Per-stage parameter accounting for every GPipeSequential in the
+    model (parallel/pipeline): the stacked stage params' logical bytes,
+    bytes per stage, and the bytes actually resident on one device —
+    1/n_stages of the stack under a pipe=n layout, the whole stack when
+    replicated.  Walks the module tree parallel to the params pytree
+    (the Container/Graph list-alignment, like layout.role_tree).
+    Returns a list of one dict per pipeline, or None when the model has
+    no pipelined region."""
+    from ..parallel.pipeline import GPipeSequential
+    dev = device or jax.devices()[0]
+    out = []
+
+    def walk(mod, p):
+        if isinstance(mod, GPipeSequential):
+            total = tree_total_bytes(p)
+            n = len(mod.stages)
+            out.append({"stages": n,
+                        "stage_param_bytes": total // max(n, 1),
+                        "stacked_param_bytes": total,
+                        "param_bytes_per_device": tree_device_bytes(p, dev)})
+            return
+        kids = getattr(mod, "modules", None)
+        if kids is not None and isinstance(p, list) and len(kids) == len(p):
+            for m, cp in zip(kids, p):
+                walk(m, cp)
+
+    walk(model, params)
+    return out or None
 
 
 def memory_record(params=None, opt_state=None, device=None) -> dict:

@@ -406,7 +406,8 @@ class TestMultiAxisReform:
 
     def test_typed_error_when_block_cannot_survive(self):
         MeshLayout(2, 2, 1).install(jax.devices()[:4])
-        with pytest.raises(MeshReformError, match="fsdp/tp shard groups"):
+        with pytest.raises(MeshReformError,
+                           match="shard groups intact"):
             Engine.reform(world=1, rank=0, survivors=[0],
                           devices=jax.devices()[:3])
         # fewer devices than the fsdp x tp block itself

@@ -28,6 +28,15 @@ utils/hlostats.py):
 3. **AOT cold/warm**: the same step compiled cold (compile+store) then
    warm (executable deserialized from a fresh cache dir, jit caches
    cleared) — warm-over-cold compile-cost ratio under the baseline bound.
+4. **pipeline step card** (needs >= 2 devices — the cpu platform runs on
+   a forced 4-virtual-device host): a ``partition_pipeline``'d MLP train
+   step on a ``(1,1,1,2,1)`` MeshLayout — the card's ``pipe_microbatches``
+   count, the GPipe ``pipe_bubble_fraction`` bound, and the schedule's
+   ``collective-permute`` ops in the compiled program.
+5. **expert step card**: a ``MoEFFN`` train step on ``(1,1,1,1,2)`` — the
+   GSPMD expert-sharded step's collective count — plus the explicit
+   ``expert_parallel_ffn`` program's ``all-to-all`` op count, so the next
+   TPU round measures the dispatch/combine schedule we think it does.
 
 ``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
 drift fails), ``max`` (time/ratio metrics — measured must stay <=
@@ -67,6 +76,10 @@ DEFAULT_RATIO_BOUNDS = {
     "aot.warm_over_cold": {"value": 0.5, "match": "max",
                            "note": "warm AOT compile cost / cold "
                                    "(measured ~0.035 on CPU; CI slack)"},
+    "pipe.bubble_fraction": {"value": 0.25, "match": "max",
+                             "note": "GPipe idle bound (n-1)/(m+n-1) for "
+                                     "the pipe=2 proxy step (0.2 at the "
+                                     "default 4 microbatches)"},
 }
 
 
@@ -99,6 +112,63 @@ def _build_step(batch_size):
     args = (params, model.state, opt.optim_method.init_state(params),
             inp, tgt, jnp.float32(0.01), jax.random.key(1))
     return step, args
+
+
+def _build_layout_step(layout_sizes, model_fn, batch_size=32, in_dim=64,
+                       classes=8):
+    """A real compiled train step (Optimizer._build_step) on a MeshLayout
+    mesh — the pipe/expert proxies' harness."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel import LayoutSharding, MeshLayout
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    layout = MeshLayout(*layout_sizes)
+    mesh = layout.install(jax.devices()[: layout.size])
+    model = model_fn()
+    model.build(jax.random.key(0))
+    opt = Optimizer(model, dataset=None, criterion=nn.CrossEntropyCriterion(),
+                    end_trigger=Trigger.max_iteration(1),
+                    strategy=LayoutSharding(model, min_size=0))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    step, param_sh, data_sh = opt._build_step(mesh)
+    rng = np.random.default_rng(0)
+    inp = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch_size, in_dim)), jnp.float32),
+        data_sh)
+    tgt = jax.device_put(
+        jnp.asarray(rng.integers(0, classes, size=batch_size), jnp.int32),
+        data_sh)
+    params = jax.device_put(model.params, param_sh)
+    opt_state = jax.device_put(opt.optim_method.init_state(model.params),
+                               opt._opt_sh)
+    args = (params, model.state, opt_state, inp, tgt, jnp.float32(0.05),
+            jax.random.key(1))
+    return step, args
+
+
+def _pipe_model():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.parallel import partition_pipeline
+    model = nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 8, with_bias=False))
+    return partition_pipeline(model, 2)
+
+
+def _moe_model():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.parallel import MoEFFN
+    return nn.Sequential(
+        nn.Linear(64, 32, with_bias=False), nn.ReLU(),
+        MoEFFN(32, 64, num_experts=4, capacity_factor=4.0),
+        nn.Linear(32, 8, with_bias=False))
 
 
 def _run_steps(step, args, iters=10):
@@ -210,6 +280,64 @@ def measure(batch_size=64):
                       "stores": int(s2["stores"]),
                       "cache_dir": cache_dir}
     _fresh({"BIGDL_TPU_AOT_CACHE": None, "BIGDL_TPU_XLA_CACHE": None})
+
+    # ---- proxies 4+5: pipeline + expert step shapes ------------------
+    import jax
+    if jax.device_count() < 2:
+        context["pipe_expert"] = {
+            "skipped": f"need >= 2 devices, have {jax.device_count()} "
+                       "(run with --platform cpu for the forced "
+                       "4-virtual-device host)"}
+        return measured, context
+
+    # pipe=2: the partitioned step's card carries the schedule's
+    # self-description (Optimizer._build_step card_extra) and the
+    # compiled program carries the GPipe ring's collective-permutes
+    hlostats.reset()
+    step, args = _build_layout_step((1, 1, 1, 2, 1), _pipe_model)
+    _run_steps(step, args, iters=1)
+    card = hlostats.last_card("optim.step")
+    extra = card.get("extra", {})
+    measured["pipe.microbatches"] = extra.get("pipe_microbatches", 0)
+    measured["pipe.bubble_fraction"] = extra.get("pipe_bubble_fraction", 1.0)
+    measured["pipe.collective_permutes"] = card.get("ops", {}).get(
+        "collective-permute", 0)
+    context["pipe"] = {"stages": extra.get("pipe_stages"),
+                       "collectives": card.get("collectives"),
+                       "total_ops": card.get("total_ops")}
+
+    # expert=2: the GSPMD expert-sharded step's collective count, plus
+    # the explicit shard_map dispatch/combine program's all-to-alls
+    hlostats.reset()
+    step, args = _build_layout_step((1, 1, 1, 1, 2), _moe_model)
+    _run_steps(step, args, iters=1)
+    card = hlostats.last_card("optim.step")
+    measured["moe.step_collectives"] = card.get("collectives", 0)
+    context["expert"] = {"ops_sample": {k: v for k, v in
+                                        card.get("ops", {}).items()
+                                        if "all-" in k or "collective" in k},
+                         "total_ops": card.get("total_ops")}
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.parallel import MoEFFN, expert_parallel_ffn
+    from bigdl_tpu.utils.engine import Engine
+    mesh = Engine.mesh()  # the (1,1,1,1,2) layout mesh from above
+    m = MoEFFN(16, 32, num_experts=4, capacity_factor=4.0)
+    m.build(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(32, 16)),
+                    jnp.float32)
+
+    def ep(params, xs):
+        return expert_parallel_ffn(mesh, params, xs, k=1,
+                                   capacity_factor=4.0)
+
+    lowered = jax.jit(ep).lower(m.params, x)
+    compiled = lowered.compile()
+    ep_card = hlostats.compile_card(compiled, lowered, label="moe.ep")
+    measured["moe.all_to_all"] = ep_card.get("ops", {}).get("all-to-all", 0)
+    context["expert"]["ep_collectives"] = ep_card.get("collectives")
     return measured, context
 
 
@@ -291,6 +419,11 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", args.platform)
         except RuntimeError:
             pass
+        if args.platform == "cpu":
+            # proxies 4/5 (pipe=2 / expert=2 mesh) need a multi-device
+            # host: force 4 virtual CPU devices before backend init
+            from bigdl_tpu.utils.platform import force_cpu
+            force_cpu(4)
     # the regression demo (ISSUE 11 acceptance): an exported
     # BIGDL_TPU_CONV_ROUTE=pad wins over this default and the conv-ops
     # metric names the diff

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Pipeline + expert-parallel smoke: prove the 5-axis MeshLayout's two
+new axes on a simulated 4-device host mesh (parallel/pipeline +
+parallel/expert + LayoutSharding — docs/parallelism.md).
+
+Runs 5-step trainings in one process on 4 virtual CPU devices:
+
+- **pipe**: a Sequential MLP is split by ``partition_pipeline`` into 2
+  structurally identical stages and trained on a ``(1,1,1,2,1)`` layout
+  — stacked stage params shard ``P('pipe')``, the GPipe microbatched
+  schedule runs inside the ordinary compiled step.  Asserts per-device
+  stage-stack bytes == 1/2, loss parity vs the unpartitioned ``(4,1,1)``
+  DP baseline, and that the traced run emits the
+  ``train.pipe_bubble_fraction`` counter.
+- **expert**: the same body with a capacity-routed ``MoEFFN`` trained on
+  ``(1,1,1,1,2)`` — expert tables (role ``expert_table``) shard
+  ``P('expert')``.  Asserts per-device table bytes == 1/2 and loss
+  parity vs the single-device run of the identical model.
+
+Prints ONE JSON line:
+
+    {"metric": "pipeline_smoke", "ok": true, "runs": {...}, ...}
+
+Used by tools/tpu_runbook_r05.sh's cpu smoke mode (stage 2m) so the
+pipeline/expert promotion is proven before tunnel time; safe anywhere
+(tiny models, seconds of wall clock).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: |loss(layout) - loss(baseline)| bound per step: sharded programs
+#: reduce in a different association order (docs/parallelism.md)
+LOSS_TOL = 2e-3
+
+
+def _mlp():
+    """Two identical blocks + a head: the repeated-block body
+    partition_pipeline needs; every dim divides 4, bias-free so the
+    shard-fraction arithmetic is exact."""
+    import bigdl_tpu.nn as nn
+    return nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 8, with_bias=False))
+
+
+def _moe_mlp():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.parallel import MoEFFN
+    return nn.Sequential(
+        nn.Linear(64, 32, with_bias=False), nn.ReLU(),
+        MoEFFN(32, 64, num_experts=4, capacity_factor=4.0),
+        nn.Linear(32, 8, with_bias=False))
+
+
+def _dataset(steps, batch):
+    import numpy as np
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    rng = np.random.default_rng(0)
+    n = batch * steps
+    xs = rng.normal(0.0, 1.0, size=(n, 64)).astype(np.float32)
+    ys = rng.integers(0, 8, size=n)
+    return DataSet.array(
+        [Sample(x, np.int32(y)) for x, y in zip(xs, ys)]).transform(
+        SampleToMiniBatch(batch, drop_last=True))
+
+
+def _train(model, layout_sizes, steps, batch):
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel import LayoutSharding, MeshLayout
+    from bigdl_tpu.utils.engine import Engine
+
+    layout = MeshLayout(*layout_sizes)
+    Engine.reset()
+    layout.install(jax.devices()[: layout.size])
+
+    losses = []
+
+    class Cap:
+        def add_scalar(self, name, value, step):
+            if name == "Loss":
+                losses.append(float(value))
+
+    opt = (Optimizer(model, _dataset(steps, batch), nn.CrossEntropyCriterion(),
+                     strategy=LayoutSharding(model, min_size=0))
+           .set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
+           .set_end_when(Trigger.max_iteration(steps))
+           .set_log_interval(1)
+           .set_train_summary(Cap()))
+    opt.optimize()
+    return losses, opt
+
+
+def _frac(tree):
+    from bigdl_tpu.utils import memstats
+    return (memstats.tree_device_bytes(tree)
+            / max(memstats.tree_total_bytes(tree), 1))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--devices", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.utils.platform import force_cpu
+    force_cpu(args.devices)
+    import jax
+
+    if jax.device_count() < args.devices:
+        print(json.dumps({"metric": "pipeline_smoke", "ok": False,
+                          "error": f"need {args.devices} devices, have "
+                                   f"{jax.device_count()} (backend "
+                                   "initialized early?)"}))
+        return 1
+
+    from bigdl_tpu.common import set_seed
+    from bigdl_tpu.parallel import GPipeSequential, partition_pipeline
+
+    t0 = time.perf_counter()
+    runs = {}
+
+    # ---- pipe=2 vs the (4,1,1) DP baseline ---------------------------
+    set_seed(7)
+    base = _mlp()
+    base_losses, _ = _train(base, (4, 1, 1), args.steps, args.batch_size)
+    set_seed(7)
+    plain = _mlp()
+    plain.build()  # same seed -> identical init as the baseline run
+    piped = partition_pipeline(plain, 2)
+    # the traced run must emit the bubble counter: arm the tracer
+    trace_dir = tempfile.mkdtemp(prefix="pipeline_smoke_trace_")
+    os.environ["BIGDL_TPU_TRACE"] = trace_dir
+    try:
+        pipe_losses, _ = _train(piped, (1, 1, 1, 2, 1), args.steps,
+                                args.batch_size)
+    finally:
+        os.environ.pop("BIGDL_TPU_TRACE", None)
+    trace_blob = ""
+    for name in os.listdir(trace_dir):
+        if name.startswith("trace."):
+            with open(os.path.join(trace_dir, name)) as f:
+                trace_blob += f.read()
+    bubble_emitted = "pipe_bubble_fraction" in trace_blob
+    stacked = next(p for c, p in zip(piped.modules, piped.params)
+                   if isinstance(c, GPipeSequential))
+    pipe_frac = _frac(stacked)
+    pipe_diff = (max(abs(a - b) for a, b in zip(pipe_losses, base_losses))
+                 if len(pipe_losses) == len(base_losses) and pipe_losses
+                 else None)
+    runs["pipe_1x1x1x2x1"] = {
+        "stage_param_fraction_per_device": round(pipe_frac, 4),
+        "fraction_ok": abs(pipe_frac - 0.5) < 0.01,
+        "max_loss_diff_vs_dp": pipe_diff,
+        "parity_ok": pipe_diff is not None and pipe_diff <= LOSS_TOL,
+        "pipe_bubble_fraction_emitted": bubble_emitted,
+    }
+
+    # ---- expert=2 vs the single-device run of the same model ---------
+    set_seed(7)
+    moe_base = _moe_mlp()
+    moe_base_losses, _ = _train(moe_base, (1, 1, 1), args.steps,
+                                args.batch_size)
+    set_seed(7)
+    moe = _moe_mlp()
+    moe_losses, _ = _train(moe, (1, 1, 1, 1, 2), args.steps,
+                           args.batch_size)
+    tables = {k: moe.params[2][k] for k in ("w1", "w2", "b1", "b2")}
+    moe_frac = _frac(tables)
+    moe_diff = (max(abs(a - b) for a, b in zip(moe_losses, moe_base_losses))
+                if len(moe_losses) == len(moe_base_losses) and moe_losses
+                else None)
+    runs["expert_1x1x1x1x2"] = {
+        "table_param_fraction_per_device": round(moe_frac, 4),
+        "fraction_ok": abs(moe_frac - 0.5) < 0.01,
+        "max_loss_diff_vs_dense": moe_diff,
+        "parity_ok": moe_diff is not None and moe_diff <= LOSS_TOL,
+    }
+
+    ok = (len(base_losses) >= args.steps
+          and all(r.get("fraction_ok") and r.get("parity_ok")
+                  for r in runs.values())
+          and bubble_emitted)
+    print(json.dumps({
+        "metric": "pipeline_smoke",
+        "ok": ok,
+        "steps": args.steps,
+        "loss_tol": LOSS_TOL,
+        "runs": runs,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "backend": jax.default_backend(),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,6 +231,21 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] perf gate FAILED rc=$GATE_RC (see /tmp/perf_gate.log for the named metrics) at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # pipeline + expert smoke (cpu only): 4 virtual devices — a pipe=2
+  # GPipe-partitioned MLP and an expert=2 MoEFFN each train 5 steps
+  # with 1/2-per-device shard fractions, loss parity vs the
+  # unpartitioned baselines, and the pipe run emitting the
+  # train.pipe_bubble_fraction counter (mirrors stage 2j)
+  echo "[runbook] 2m/4 pipeline+expert smoke (pipe/expert shard fractions + parity)" >> "$LOG"
+  timeout 300 python tools/pipeline_smoke.py \
+    > /tmp/pipeline_smoke.json 2>/tmp/pipeline_smoke.log
+  PIPE_RC=$?
+  if [ "$PIPE_RC" = 0 ]; then
+    echo "[runbook] pipeline smoke OK (1/2 footprints + parity + bubble counter) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] pipeline smoke FAILED rc=$PIPE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
