@@ -516,12 +516,16 @@ def _bench_config(name, build, peak_flops):
     aot0 = aot_mod.stats()
     t0 = time.perf_counter()
     lowered = step.lower(params, net_state, opt_state, inp, tgt, lr_arr, rng)
+    # tracing just ran any pipeline microbatch clamp: fold the effective
+    # count into the card/knobs before either is recorded
+    opt._refresh_pipe_effective()
     # AOT executable cache (BIGDL_TPU_AOT_CACHE): a warm config's
     # compile_seconds collapses to one cache read; disabled -> identical
     # to the old lowered.compile()
     compiled = aot_mod.cached_compile(
         lowered, label=f"bench.{name}", mesh=mesh,
         example_args=(params, net_state, opt_state, inp, tgt, lr_arr, rng),
+        extra=opt._aot_extra,
         card_extra=dict(opt._card_extra))
     compile_s = time.perf_counter() - t0
     aot_rec = _aot_delta(aot0)
@@ -568,6 +572,22 @@ def _bench_config(name, build, peak_flops):
         stages = memstats.pipeline_stage_bytes(model, box["params"])
         if stages:
             memory["pipeline_stages"] = stages
+            # schedule attribution beside the per-stage memory block
+            # (ISSUE 13): which schedule the step baked in, how many
+            # interleaved slices, and the measured bubble of the ACTUAL
+            # (clamped) microbatch count — one artifact is enough to
+            # A/B gpipe vs 1f1b on the next real-TPU round
+            if opt._pipe_info is not None:
+                _, _pmod = opt._pipe_info
+                memory["pipe_schedule"] = opt._step_knobs.get(
+                    "pipe_schedule")
+                memory["pipe_virtual_stages"] = opt._step_knobs.get(
+                    "pipe_virtual_stages")
+                memory["pipe_microbatches"] = opt._step_knobs.get(
+                    "pipe_microbatches")
+                if _pmod._last_bubble is not None:
+                    memory["pipe_bubble_fraction"] = round(
+                        _pmod._last_bubble, 4)
     except Exception as e:  # noqa: BLE001 — diagnostics, never fatal
         _log(f"{name}: memory stats failed: {type(e).__name__}: {e}")
         memory = {"error": f"{type(e).__name__}: {e}"}
@@ -896,24 +916,29 @@ def _cfg_transformer_lm():
 
 
 def _cfg_transformer_lm_pipe():
-    """GPipe-pipelined decoder LM: the repeated-block body partitioned
-    over the mesh 'pipe' axis (parallel/pipeline.partition_pipeline).
-    Under BIGDL_TPU_BENCH_LAYOUT=d,f,t,p,e with p>1 each pipe-mesh row
-    owns 1/p of the block stack (the record's memory.pipeline_stages
-    block shows the per-stage bytes); without a pipe axis the partition
-    degrades to the sequential math on one chip."""
+    """Pipelined decoder LM: the repeated-block body partitioned over
+    the mesh 'pipe' axis (parallel/pipeline.partition_pipeline) into
+    pipe * BIGDL_TPU_PIPE_VIRTUAL_STAGES slices, scheduled per
+    BIGDL_TPU_PIPE_SCHEDULE (gpipe default; 1f1b = table-driven
+    one-forward-one-backward).  Under BIGDL_TPU_BENCH_LAYOUT=d,f,t,p,e
+    with p>1 each pipe-mesh row owns 1/p of the block stack (the
+    record's memory.pipeline_stages block shows the per-stage bytes
+    beside pipe_schedule/pipe_virtual_stages/pipe_bubble_fraction);
+    without a pipe axis the partition degrades to the sequential math
+    on one chip."""
     import jax.numpy as jnp
     from bigdl_tpu.common import DTypePolicy, set_policy
     from bigdl_tpu.models.transformer_lm import TransformerLM
     from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
-    from bigdl_tpu.parallel import MeshLayout, partition_pipeline
+    from bigdl_tpu.parallel import (MeshLayout, partition_pipeline,
+                                    pipe_virtual_stages)
     set_policy(DTypePolicy(compute_dtype=jnp.bfloat16))
     layout_env = os.environ.get("BIGDL_TPU_BENCH_LAYOUT")
-    stages = MeshLayout.parse(layout_env).pipe if layout_env else 2
+    pipe_n = MeshLayout.parse(layout_env).pipe if layout_env else 2
     b, t = 16, 256
     model = TransformerLM(vocab_size=16000, max_len=t, d_model=512,
                           num_heads=8, num_layers=8)
-    model = partition_pipeline(model, max(stages, 2))
+    model = partition_pipeline(model, max(pipe_n, 2) * pipe_virtual_stages())
     return (model,
             TimeDistributedCriterion(ClassNLLCriterion(), size_average=True),
             jnp.zeros((b, t), jnp.int32),

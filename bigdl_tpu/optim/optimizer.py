@@ -320,8 +320,10 @@ class Optimizer:
         self._card_extra = {}
         # (pipe_axis_size, GPipeSequential) when the model pipelines over
         # a pipe>1 mesh (_build_step fills it) — arms the per-step
-        # train.pipe_bubble_fraction counter beside mfu
+        # train.pipe_bubble_fraction counter beside mfu; _aot_extra adds
+        # the schedule knobs to the AOT cache fingerprint
         self._pipe_info = None
+        self._aot_extra = None
         # straggler mitigation (reference: Optimizer.setDropModuleProperty,
         # optim/Optimizer.scala:255; loop logic DistriOptimizer.scala:302-330)
         self.drop_percentage = 0.0
@@ -705,22 +707,37 @@ class Optimizer:
         else:
             card_extra["fused_buffers"] = 0
         # pipeline self-description (parallel/pipeline.GPipeSequential on
-        # a pipe>1 mesh): stage/microbatch counts + the GPipe bubble
-        # bound ride the compile card (perf gate rows) and arm the
-        # per-step train.pipe_bubble_fraction counter
+        # a pipe>1 mesh): schedule/stage/microbatch knobs + the
+        # schedule's bubble ride the compile card (perf gate rows), the
+        # AOT fingerprint, and arm the per-step
+        # train.pipe_bubble_fraction counter
         from ..parallel import pipeline as pipe_mod
         self._pipe_info = None
+        self._aot_extra = None
         pipes = [m for m in model.unique_modules()
                  if isinstance(m, pipe_mod.GPipeSequential)]
         pipe_n = (int(mesh.shape["pipe"])
                   if "pipe" in mesh.axis_names else 1)
         if pipes and pipe_n > 1:
-            mb = pipes[0].num_microbatches or pipe_mod.pipe_microbatches()
-            self._pipe_info = (pipe_n, pipes[0])
+            pmod = pipes[0]
+            mb = pmod.num_microbatches or pipe_mod.pipe_microbatches()
+            sched = pmod.schedule or pipe_mod.pipe_schedule()
+            virt = pmod.virtual_stages
+            self._pipe_info = (pipe_n, pmod)
             card_extra["pipe_stages"] = pipe_n
+            card_extra["pipe_schedule"] = sched
+            card_extra["pipe_virtual_stages"] = virt
             card_extra["pipe_microbatches"] = mb
             card_extra["pipe_bubble_fraction"] = round(
-                pipe_mod.bubble_fraction(pipe_n, mb), 4)
+                pipe_mod.bubble_fraction(pipe_n, mb, sched, virt), 4)
+            self._step_knobs.update(pipe_schedule=sched,
+                                    pipe_virtual_stages=virt,
+                                    pipe_microbatches=mb)
+            # the AOT cache key gains the schedule knobs explicitly (the
+            # HLO hash would differ anyway; the fingerprint makes a
+            # schedule flip a NAMED invalidation instead of a silent one)
+            self._aot_extra = {"pipe_schedule": sched,
+                               "pipe_virtual_stages": virt}
         self._card_extra = card_extra
 
         remat = self.remat_policy
@@ -845,9 +862,13 @@ class Optimizer:
             if comp is None:
                 with mesh:
                     lowered = jitted.lower(*args)
+                # tracing just ran the pipeline's microbatch clamp: fold
+                # the EFFECTIVE count into the card before it is emitted
+                self._refresh_pipe_effective()
                 comp = aot_mod.cached_compile(
                     lowered, label="optim.step", mesh=mesh,
-                    example_args=args, card_extra=self._card_extra)
+                    example_args=args, extra=self._aot_extra,
+                    card_extra=self._card_extra)
                 aot_exe[sig] = comp
             with mesh:
                 return comp(*args)
@@ -888,6 +909,27 @@ class Optimizer:
         # at compile time
         step_in_mesh.raw = step
         return step_in_mesh, param_sh, data_sh
+
+    def _refresh_pipe_effective(self) -> None:
+        """Fold the pipeline's EFFECTIVE microbatch count (the knob
+        clamped to divide the local batch — set by the traced apply)
+        into step_knobs / the compile card, so bench records and cards
+        agree with what the schedule actually baked in (the
+        silent-clamp satellite, ISSUE 13)."""
+        if self._pipe_info is None:
+            return
+        from ..parallel import pipeline as pipe_mod
+        pipe_n, pmod = self._pipe_info
+        m_eff = pmod._last_microbatches
+        if not m_eff or m_eff == self._card_extra.get("pipe_microbatches"):
+            return
+        sched = pmod._last_schedule or self._card_extra.get(
+            "pipe_schedule", "gpipe")
+        virt = pmod.virtual_stages
+        self._card_extra["pipe_microbatches"] = m_eff
+        self._card_extra["pipe_bubble_fraction"] = round(
+            pipe_mod.bubble_fraction(pipe_n, m_eff, sched, virt), 4)
+        self._step_knobs["pipe_microbatches"] = m_eff
 
     def _build_forward(self, mesh):
         model = self.model
@@ -1522,16 +1564,24 @@ class Optimizer:
                     counters["collective_fraction"] = min(
                         1.0, self._collective_s / max(step_dur, 1e-9))
                 if self._pipe_info is not None:
-                    # GPipe idle bound (n-1)/(m+n-1) for the schedule the
-                    # step actually baked in (the configured microbatch
-                    # knob, clamped to divide the local batch)
+                    # the idle fraction of the schedule the step actually
+                    # baked in: (n-1)/(m+n-1) under gpipe, the measured
+                    # table fraction under 1f1b / virtual stages
+                    # (parallel/schedule.py) — microbatch knob clamped to
+                    # divide the local batch
                     from ..parallel import pipeline as pipe_mod
                     n_pipe, pmod = self._pipe_info
-                    mb = (pmod._last_microbatches
-                          or pmod.num_microbatches
-                          or pipe_mod.pipe_microbatches())
-                    counters["pipe_bubble_fraction"] = round(
-                        pipe_mod.bubble_fraction(n_pipe, mb), 4)
+                    self._refresh_pipe_effective()
+                    if pmod._last_bubble is not None:
+                        bubble = pmod._last_bubble
+                    else:
+                        mb = (pmod._last_microbatches
+                              or pmod.num_microbatches
+                              or pipe_mod.pipe_microbatches())
+                        bubble = pipe_mod.bubble_fraction(
+                            n_pipe, mb, pmod.schedule or
+                            pipe_mod.pipe_schedule(), pmod.virtual_stages)
+                    counters["pipe_bubble_fraction"] = round(bubble, 4)
                 telemetry.counter("train", **counters)
                 # per-parameter histograms when a "Parameters" trigger is set
                 # (reference: DistriOptimizer.saveSummary :426-456 — off by

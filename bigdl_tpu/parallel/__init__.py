@@ -19,9 +19,12 @@ from .layout import (MeshLayout, UnannotatedParameterError, MeshReformError,
 from .sharding import (ShardingStrategy, DataParallel, ShardedDataParallel,
                        TensorParallel, LayoutSharding)
 from .ring_attention import ring_attention, ulysses_attention
-from .pipeline import (pipeline_apply, stack_stage_params, GPipeSequential,
+from .pipeline import (pipeline_apply, pipeline_apply_scheduled,
+                       stack_stage_params, GPipeSequential,
                        partition_pipeline, PipelinePartitionError,
-                       pipe_microbatches, bubble_fraction)
+                       pipe_microbatches, pipe_schedule,
+                       pipe_virtual_stages, bubble_fraction)
+from .schedule import ScheduleTable, build_schedule
 from .expert import (MoEFFN, expert_parallel_ffn, top_k_routing,
                      load_balancing_loss)
 from .elastic import PeerLostError, ElasticNegotiationError
@@ -30,8 +33,10 @@ __all__ = ["ShardingStrategy", "DataParallel", "ShardedDataParallel",
            "TensorParallel", "LayoutSharding", "MeshLayout",
            "UnannotatedParameterError", "MeshReformError", "assign_specs",
            "assign_shardings", "ring_attention", "ulysses_attention",
-           "pipeline_apply", "stack_stage_params", "GPipeSequential",
+           "pipeline_apply", "pipeline_apply_scheduled",
+           "stack_stage_params", "GPipeSequential",
            "partition_pipeline", "PipelinePartitionError",
-           "pipe_microbatches", "bubble_fraction", "MoEFFN",
+           "pipe_microbatches", "pipe_schedule", "pipe_virtual_stages",
+           "bubble_fraction", "ScheduleTable", "build_schedule", "MoEFFN",
            "expert_parallel_ffn", "top_k_routing", "load_balancing_loss",
            "PeerLostError", "ElasticNegotiationError"]

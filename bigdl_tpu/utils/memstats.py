@@ -26,7 +26,8 @@ from typing import Optional
 import jax
 
 __all__ = ["device_memory_stats", "live_device_bytes", "tree_device_bytes",
-           "tree_total_bytes", "memory_record", "pipeline_stage_bytes"]
+           "tree_total_bytes", "memory_record", "pipeline_stage_bytes",
+           "compiled_memory_analysis"]
 
 
 def device_memory_stats(device=None) -> Optional[dict]:
@@ -115,6 +116,36 @@ def pipeline_stage_bytes(model, params, device=None):
                 walk(m, cp)
 
     walk(model, params)
+    return out or None
+
+
+def compiled_memory_analysis(compiled) -> Optional[dict]:
+    """XLA's own memory budget for one compiled executable
+    (``Compiled.memory_analysis()``) as a plain dict, or None where the
+    backend doesn't expose it.
+
+    ``temp_bytes`` is the compiler's peak scratch estimate — every
+    intermediate the program keeps alive at once, which for a train step
+    is dominated by saved-for-backward activations.  This is the
+    CPU-measurable proxy for the pipeline-schedule memory claim
+    (ISSUE 13): a 1F1B step's bounded in-flight stash must budget no
+    more temp than the GPipe step's keep-every-microbatch backward
+    (``tools/pipeline_smoke.py`` + tests assert the ≤)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — unimplemented on this backend
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for name, key in (("temp_size_in_bytes", "temp_bytes"),
+                      ("argument_size_in_bytes", "argument_bytes"),
+                      ("output_size_in_bytes", "output_bytes"),
+                      ("alias_size_in_bytes", "alias_bytes"),
+                      ("generated_code_size_in_bytes", "code_bytes")):
+        val = getattr(ma, name, None)
+        if val is not None:
+            out[key] = int(val)
     return out or None
 
 

@@ -478,3 +478,228 @@ class TestRingAttnSeam:
             y, _ = mha.apply(mha.params, mha.state, x)  # T=15: flash path
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    atol=1e-6)
+
+
+def _mlp4():
+    """Four identical blocks + a head: splits into 2 GPipe stages or 4
+    interleaved 1F1B slices of the same params."""
+    return nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 8, with_bias=False))
+
+
+def _pipe_step_temp_bytes(num_stages, batch=256):
+    """XLA temp (peak scratch) budget of the real compiled train step
+    under the CURRENT schedule env knobs (memstats proxy for peak live
+    activations — never executed)."""
+    jax.clear_caches()
+    Engine.reset()
+    mesh = MeshLayout(1, 1, 1, 2, 1).install(jax.devices()[:2])
+    model = _mlp4()
+    model.build(jax.random.key(0))
+    model = partition_pipeline(model, num_stages)
+    from bigdl_tpu.optim import Optimizer as _Opt
+    opt = _Opt(model, dataset=None, criterion=nn.CrossEntropyCriterion(),
+               end_trigger=Trigger.max_iteration(1),
+               strategy=LayoutSharding(model, min_size=0))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    step, param_sh, data_sh = opt._build_step(mesh)
+    rng = np.random.default_rng(0)
+    inp = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch, 64)), jnp.float32), data_sh)
+    tgt = jax.device_put(
+        jnp.asarray(rng.integers(0, 8, size=batch), jnp.int32), data_sh)
+    params = jax.device_put(model.params, param_sh)
+    opt_state = jax.device_put(opt.optim_method.init_state(model.params),
+                               opt._opt_sh)
+    args = (params, model.state, opt_state, inp, tgt, jnp.float32(0.05),
+            jax.random.key(1))
+    ma = memstats.compiled_memory_analysis(step.lower(*args).compile())
+    return (ma or {}).get("temp_bytes")
+
+
+@multidev
+class TestOneFOneB:
+    """The 1F1B schedule + interleaved virtual stages (ISSUE 13
+    tentpole): parity vs GPipe at the pinned tolerance, the bubble and
+    activation-memory claims, remat/AOT composition, and the
+    microbatch-clamp surfacing."""
+
+    def _run(self, num_stages, steps=5, monkeypatch=None, **env):
+        for k, val in env.items():
+            monkeypatch.setenv(k, str(val))
+        set_seed(13)
+        model = _mlp4()
+        model.build()
+        piped = partition_pipeline(model, num_stages)
+        Engine.reset()
+        MeshLayout(1, 1, 1, 2, 1).install(jax.devices()[:2])
+        return _train(piped, _dataset(16 * steps * 2, 16),
+                      LayoutSharding(piped, min_size=0), steps)
+
+    def test_1f1b_v1_loss_parity_vs_gpipe(self, monkeypatch):
+        """pipe=2, equal m=8: 1F1B's explicitly staged backward must
+        reproduce GPipe's losses within the pinned reassociation
+        tolerance (docs/parallelism.md 'Choosing a schedule')."""
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        g_losses, _ = self._run(2, monkeypatch=monkeypatch)
+        f_losses, fopt = self._run(
+            2, monkeypatch=monkeypatch, BIGDL_TPU_PIPE_SCHEDULE="1f1b")
+        assert len(f_losses) == len(g_losses) == 5
+        np.testing.assert_allclose(f_losses, g_losses, atol=LOSS_TOL)
+        assert fopt._card_extra["pipe_schedule"] == "1f1b"
+        assert fopt._card_extra["pipe_virtual_stages"] == 1
+        # v=1 1F1B keeps GPipe's bubble — the win is memory
+        assert fopt._card_extra["pipe_bubble_fraction"] == pytest.approx(
+            bubble_fraction(2, 8), abs=1e-4)
+
+    def test_1f1b_interleaved_parity_and_lower_bubble(self, monkeypatch):
+        """pipe=2 with v=2 (4 interleaved slices): losses still match,
+        and the card reports the strictly lower interleaved bubble
+        (1/17 vs GPipe's 1/9 at m=8) — the acceptance geometry."""
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        g_losses, gopt = self._run(2, monkeypatch=monkeypatch)
+        f_losses, fopt = self._run(
+            4, monkeypatch=monkeypatch, BIGDL_TPU_PIPE_SCHEDULE="1f1b",
+            BIGDL_TPU_PIPE_VIRTUAL_STAGES="2")
+        np.testing.assert_allclose(f_losses, g_losses, atol=LOSS_TOL)
+        g_bubble = gopt._card_extra["pipe_bubble_fraction"]
+        f_bubble = fopt._card_extra["pipe_bubble_fraction"]
+        assert g_bubble == pytest.approx(1 / 9, abs=1e-4)
+        assert f_bubble == pytest.approx(1 / 17, abs=1e-4)
+        assert f_bubble < g_bubble
+        assert fopt._step_knobs["pipe_schedule"] == "1f1b"
+        assert fopt._step_knobs["pipe_virtual_stages"] == 2
+        # per-device stage stack still 1/2 of the logical params
+        stacked = next(p for c, p in zip(fopt.model.modules,
+                                         fopt.model.params)
+                       if isinstance(c, GPipeSequential))
+        assert memstats.tree_device_bytes(stacked) * 2 == \
+            memstats.tree_total_bytes(stacked)
+
+    def test_1f1b_bubble_counter_from_actual_schedule(self, tmp_path,
+                                                      monkeypatch):
+        """The traced run emits the TABLE's bubble (1/17), not the
+        gpipe closed form — the counter reads the realized schedule."""
+        import json as _json
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        monkeypatch.setenv("BIGDL_TPU_TRACE", str(tmp_path))
+        self._run(4, steps=2, monkeypatch=monkeypatch,
+                  BIGDL_TPU_PIPE_SCHEDULE="1f1b",
+                  BIGDL_TPU_PIPE_VIRTUAL_STAGES="2")
+        vals = []
+        for name in os.listdir(tmp_path):
+            if not name.startswith("trace."):
+                continue
+            blob = _json.loads((tmp_path / name).read_text())
+            for ev in blob.get("traceEvents", []):
+                if ev.get("ph") == "C" and ev.get("name") == "train":
+                    v = ev.get("args", {}).get("pipe_bubble_fraction")
+                    if v is not None:
+                        vals.append(float(v))
+        assert vals, "no pipe_bubble_fraction samples in the trace"
+        assert all(v == pytest.approx(1 / 17, abs=1e-4) for v in vals)
+
+    def test_activation_memory_bound(self, monkeypatch):
+        """The memory claim, twice: the schedule table's analytic
+        in-flight count is m-independent and below GPipe's keep-all,
+        and XLA's own temp budget for the compiled 1F1B step is <= the
+        GPipe step's at an activation-dominated batch."""
+        from bigdl_tpu.parallel import build_schedule
+        tbl = build_schedule("1f1b", 2, 8, 2)
+        assert tbl.peak_inflight == 5 < 16  # GPipe keeps m*v
+        assert build_schedule("1f1b", 2, 16, 2).peak_inflight == 5
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        g_temp = _pipe_step_temp_bytes(2)
+        monkeypatch.setenv("BIGDL_TPU_PIPE_SCHEDULE", "1f1b")
+        f1_temp = _pipe_step_temp_bytes(2)
+        monkeypatch.setenv("BIGDL_TPU_PIPE_VIRTUAL_STAGES", "2")
+        f2_temp = _pipe_step_temp_bytes(4)
+        if g_temp is None:
+            pytest.skip("backend exposes no memory_analysis")
+        assert f1_temp <= g_temp
+        assert f2_temp <= g_temp
+
+    def test_remat_composes_with_1f1b(self, monkeypatch):
+        """remat=True (stage-level jax.checkpoint on the forward
+        schedule) must compose with the 1F1B backward — parity held;
+        the 1F1B backward already recomputes (full-remat by design)."""
+        monkeypatch.setenv("BIGDL_TPU_PIPE_SCHEDULE", "1f1b")
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        set_seed(13)
+        model = _mlp4()
+        model.build()
+        piped = partition_pipeline(model, 2, remat=True)
+        Engine.reset()
+        MeshLayout(1, 1, 1, 2, 1).install(jax.devices()[:2])
+        r_losses, _ = _train(piped, _dataset(160, 16),
+                             LayoutSharding(piped, min_size=0), 5)
+        p_losses, _ = self._run(2, monkeypatch=monkeypatch)
+        assert len(r_losses) == 5 and all(np.isfinite(r_losses))
+        np.testing.assert_allclose(r_losses, p_losses, atol=LOSS_TOL)
+
+    def test_microbatch_clamp_logged_and_surfaced(self, monkeypatch,
+                                                  caplog):
+        """The silent-clamp satellite: a knob that does not divide the
+        local batch is clamped, logged ONCE (requested -> effective),
+        and the effective count lands in step_knobs + the compile card
+        so bench records agree with reality."""
+        import logging as _logging
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "7")
+        with caplog.at_level(_logging.WARNING, logger="bigdl_tpu"):
+            _, opt = self._run(2, steps=3, monkeypatch=monkeypatch,
+                               BIGDL_TPU_PIPE_SCHEDULE="1f1b")
+        clamp_logs = [r for r in caplog.records
+                      if "clamped to 4 microbatches" in r.getMessage()]
+        assert len(clamp_logs) == 1  # once, not per trace/step
+        # local batch 16: 7 -> 4 (largest feasible <= the knob)
+        assert opt._step_knobs["pipe_microbatches"] == 4
+        assert opt._card_extra["pipe_microbatches"] == 4
+        assert opt._card_extra["pipe_bubble_fraction"] == pytest.approx(
+            bubble_fraction(2, 4, "1f1b", 1), abs=1e-4)
+
+    def test_aot_warm_run_zero_fresh_compiles_1f1b(self, tmp_path,
+                                                   monkeypatch):
+        """The AOT cache composes with the new schedule knobs (the
+        fingerprint carries pipe_schedule/pipe_virtual_stages): a warm
+        run of the 1F1B step performs zero fresh XLA compiles."""
+        from jax._src import compilation_cache as _cc
+
+        from bigdl_tpu.utils import aot
+        monkeypatch.setenv("BIGDL_TPU_AOT_CACHE", str(tmp_path))
+        monkeypatch.setenv("BIGDL_TPU_XLA_CACHE", "0")
+        monkeypatch.setenv("BIGDL_TPU_PIPE_SCHEDULE", "1f1b")
+        monkeypatch.setenv("BIGDL_TPU_PIPE_VIRTUAL_STAGES", "2")
+        monkeypatch.setenv("BIGDL_TPU_PIPE_MICROBATCHES", "8")
+        aot.reset()
+        prior_xla = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
+
+        def run():
+            set_seed(11)
+            model = _mlp4()
+            model.build()
+            piped = partition_pipeline(model, 4)
+            Engine.reset()
+            MeshLayout(1, 1, 1, 2, 1).install(jax.devices()[:2])
+            return _train(piped, _dataset(64, 16),
+                          LayoutSharding(piped, min_size=0), 2)
+
+        try:
+            run()
+            s1 = aot.stats()
+            assert s1["compiles"] >= 1 and s1["stores"] >= 1
+            jax.clear_caches()
+            run()
+            s2 = aot.stats()
+            assert s2["compiles"] == s1["compiles"], \
+                "warm 1F1B step must not compile again"
+            assert s2["misses"] == s1["misses"]
+            assert s2["hits"] > s1["hits"]
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prior_xla)
+            _cc.reset_cache()

@@ -1,0 +1,130 @@
+"""Pipeline schedule tables (parallel/schedule.py, ISSUE 13): tick
+counts, bubble fractions per (schedule, virtual_stages), in-flight
+activation bounds, the stack-order permutation, and the always-on table
+verifier.  Pure host-side — no devices, no jax programs."""
+
+import pytest
+
+from bigdl_tpu.parallel.schedule import (FWD, IDLE, ScheduleTable,
+                                         build_schedule, bubble_fraction,
+                                         stack_index, stage_of_stack_index)
+
+
+class TestBubbleFraction:
+    def test_gpipe_closed_form_back_compat(self):
+        """The original two-arg spelling keeps its exact closed form
+        (callers from ISSUE 12 pass (pipe_n, m) positionally)."""
+        for n, m in [(2, 4), (2, 8), (4, 8), (4, 16), (8, 32)]:
+            assert bubble_fraction(n, m) == (n - 1) / (m + n - 1)
+        assert bubble_fraction(1, 8) == 0.0
+        assert bubble_fraction(1, 8, "1f1b", 1) == 0.0
+
+    def test_gpipe_table_matches_closed_form_at_v1(self):
+        for n, m in [(2, 4), (2, 8), (4, 8), (3, 6)]:
+            tbl = build_schedule("gpipe", n, m, 1)
+            assert tbl.ticks == m + n - 1
+            assert tbl.bubble_fraction == pytest.approx(
+                (n - 1) / (m + n - 1))
+
+    def test_1f1b_v1_equals_gpipe_bubble(self):
+        """Classic 1F1B keeps GPipe's bubble — its win is memory, not
+        idle time (docs/parallelism.md 'Choosing a schedule')."""
+        for n, m in [(2, 8), (4, 16), (2, 4)]:
+            assert bubble_fraction(n, m, "1f1b", 1) == pytest.approx(
+                bubble_fraction(n, m))
+
+    def test_interleaving_strictly_lowers_the_bubble(self):
+        """The acceptance geometry: (n=2, m=8) — 1F1B at v=2 is 1/17
+        vs GPipe's 1/9, and more slices keep helping."""
+        g = bubble_fraction(2, 8)
+        f2 = bubble_fraction(2, 8, "1f1b", 2)
+        f4 = bubble_fraction(2, 8, "1f1b", 4)
+        assert g == pytest.approx(1 / 9)
+        assert f2 == pytest.approx(1 / 17)
+        assert f2 < g
+        assert f4 < f2
+        # deeper pipeline too
+        assert bubble_fraction(4, 16, "1f1b", 2) < bubble_fraction(4, 16)
+
+
+class TestInflight:
+    def test_1f1b_v1_peak_is_pipeline_depth(self):
+        """Steady state holds <= n microbatch activations per device —
+        the O(n)-instead-of-O(m) memory claim, exact at v=1."""
+        for n, m in [(2, 8), (4, 16), (3, 9)]:
+            tbl = build_schedule("1f1b", n, m, 1)
+            assert tbl.peak_inflight == n
+            assert tbl.peak_inflight_per_device[0] == n
+            # later devices drain sooner
+            assert tbl.peak_inflight_per_device[-1] <= n
+
+    def test_interleaved_peak_bounded_and_below_gpipe(self):
+        tbl = build_schedule("1f1b", 2, 8, 2)
+        # warmup bound 2(n-1) + (v-1)n + 1 = 5 for n=2, v=2
+        assert tbl.peak_inflight == 5
+        assert tbl.peak_inflight < 8 * 2  # GPipe keeps all m*v
+        # m-independence: doubling m does not grow the stash
+        assert build_schedule("1f1b", 2, 16, 2).peak_inflight == 5
+
+    def test_gpipe_table_reports_keep_all(self):
+        assert build_schedule("gpipe", 2, 8, 1).peak_inflight == 8
+        assert build_schedule("gpipe", 2, 8, 2).peak_inflight == 16
+
+
+class TestTableStructure:
+    @pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("n,m,v", [
+        (2, 8, 1), (2, 8, 2), (4, 16, 2), (3, 6, 2),
+        (2, 3, 2),   # ragged: m not a multiple of n
+        (2, 1, 1), (2, 1, 2),  # single microbatch
+    ])
+    def test_build_verifies(self, sched, n, m, v):
+        """build_schedule always re-verifies: every unit exactly once,
+        every stash read/write consistent (ScheduleTable.verify)."""
+        tbl = build_schedule(sched, n, m, v)
+        assert tbl.ticks > 0
+        assert 0.0 <= tbl.bubble_fraction < 1.0
+        work = n * v * m * (2 if sched == "1f1b" else 1)
+        busy = sum(1 for row in tbl.act for a in row if a != IDLE)
+        assert busy == work
+
+    def test_verifier_has_teeth(self):
+        """Corrupting a built table must fail verification — the
+        verifier is the correctness proof for every new geometry."""
+        tbl = build_schedule("1f1b", 2, 4, 1)
+        broken = ScheduleTable(**{**tbl.__dict__})
+        broken.mb = [list(r) for r in tbl.mb]
+        for t in range(broken.ticks):
+            if broken.act[t][0] == FWD:
+                broken.mb[t][0] = (broken.mb[t][0] + 1) % 4
+                break
+        with pytest.raises(AssertionError):
+            broken.verify()
+
+    def test_build_rejects_bad_inputs(self):
+        with pytest.raises(ValueError):
+            build_schedule("pipedream", 2, 4, 1)
+        with pytest.raises(ValueError):
+            build_schedule("1f1b", 0, 4, 1)
+        with pytest.raises(ValueError):
+            build_schedule("1f1b", 2, 0, 1)
+
+
+class TestStackOrder:
+    def test_roundtrip_and_identity_at_v1(self):
+        for n, v in [(2, 1), (2, 2), (4, 3)]:
+            rows = [stack_index(s, n, v) for s in range(n * v)]
+            assert sorted(rows) == list(range(n * v))
+            for s in range(n * v):
+                assert stage_of_stack_index(stack_index(s, n, v), n, v) == s
+        # v=1 is the identity: ISSUE 12 layouts are untouched
+        assert [stack_index(s, 4, 1) for s in range(4)] == [0, 1, 2, 3]
+
+    def test_device_major_blocks(self):
+        """P('pipe') splits the stack into contiguous per-device blocks:
+        device d's rows must hold exactly its interleaved stages."""
+        n, v = 2, 2
+        for d in range(n):
+            rows = range(d * v, (d + 1) * v)
+            stages = {stage_of_stack_index(k, n, v) for k in rows}
+            assert stages == {j * n + d for j in range(v)}

@@ -37,6 +37,15 @@ utils/hlostats.py):
    GSPMD expert-sharded step's collective count — plus the explicit
    ``expert_parallel_ffn`` program's ``all-to-all`` op count, so the next
    TPU round measures the dispatch/combine schedule we think it does.
+6. **1F1B schedule card** (ISSUE 13): the same pipe=2 mesh running the
+   interleaved 1F1B schedule (``BIGDL_TPU_PIPE_SCHEDULE=1f1b``, v=2,
+   m=8) — the card's bubble fraction must stay under the interleaved
+   bound, the compiled program's ``collective-permute`` count is pinned
+   (fwd ring + the two bwd-table rings), the schedule table's analytic
+   peak in-flight microbatches and their ratio to GPipe's keep-all
+   ``m*v`` are pinned, and the XLA temp budget of the 1F1B step over the
+   GPipe step (batch 256, activations dominating) must stay <= 1 — a
+   schedule memory regression fails the gate.
 
 ``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
 drift fails), ``max`` (time/ratio metrics — measured must stay <=
@@ -80,6 +89,20 @@ DEFAULT_RATIO_BOUNDS = {
                              "note": "GPipe idle bound (n-1)/(m+n-1) for "
                                      "the pipe=2 proxy step (0.2 at the "
                                      "default 4 microbatches)"},
+    "pipe_1f1b.bubble_fraction": {
+        "value": 0.1, "match": "max",
+        "note": "interleaved 1F1B idle bound for the pipe=2, v=2, m=8 "
+                "proxy (schedule table gives 1/17 ~= 0.0588)"},
+    "pipe.inflight_bytes_ratio": {
+        "value": 0.5, "match": "max",
+        "note": "1F1B peak in-flight stage-input activations / GPipe's "
+                "keep-all m*v at equal stage granularity (table gives "
+                "5/16 = 0.3125 for the proxy)"},
+    "pipe_1f1b.temp_bytes_ratio": {
+        "value": 1.0, "match": "max",
+        "note": "XLA temp budget of the compiled 1F1B step / GPipe step "
+                "at batch 256 (activations dominate) — the schedule "
+                "memory claim as a compiled-program invariant"},
 }
 
 
@@ -169,6 +192,39 @@ def _moe_model():
         nn.Linear(64, 32, with_bias=False), nn.ReLU(),
         MoEFFN(32, 64, num_experts=4, capacity_factor=4.0),
         nn.Linear(32, 8, with_bias=False))
+
+
+def _mlp4():
+    import bigdl_tpu.nn as nn
+    return nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 8, with_bias=False))
+
+
+def _pipe4_gpipe_model():
+    """4 identical blocks as 2 GPipe stages of 2 (the v=1 comparator)."""
+    from bigdl_tpu.parallel import partition_pipeline
+    return partition_pipeline(_mlp4(), 2)
+
+
+def _pipe4_1f1b_model():
+    """4 identical blocks as 4 interleaved slices, 2 per device (reads
+    the 1f1b/v=2 env knobs set around the proxy)."""
+    from bigdl_tpu.parallel import partition_pipeline
+    return partition_pipeline(_mlp4(), 4)
+
+
+def _step_temp_bytes(layout_sizes, model_fn, batch_size):
+    """XLA temp (peak scratch) bytes of the compiled step under the
+    CURRENT env knobs — lower+compile only, never executed."""
+    from bigdl_tpu.utils import memstats
+    step, args = _build_layout_step(layout_sizes, model_fn,
+                                    batch_size=batch_size)
+    ma = memstats.compiled_memory_analysis(step.lower(*args).compile())
+    return (ma or {}).get("temp_bytes")
 
 
 def _run_steps(step, args, iters=10):
@@ -338,6 +394,45 @@ def measure(batch_size=64):
     ep_card = hlostats.compile_card(compiled, lowered, label="moe.ep")
     measured["moe.all_to_all"] = ep_card.get("ops", {}).get("all-to-all", 0)
     context["expert"]["ep_collectives"] = ep_card.get("collectives")
+
+    # ---- proxy 6: 1F1B schedule card + memory ratio (ISSUE 13) -------
+    from bigdl_tpu.parallel import build_schedule
+    _fresh({"BIGDL_TPU_PIPE_MICROBATCHES": "8",
+            "BIGDL_TPU_PIPE_SCHEDULE": "1f1b",
+            "BIGDL_TPU_PIPE_VIRTUAL_STAGES": "2"})
+    hlostats.reset()
+    step, args = _build_layout_step((1, 1, 1, 2, 1), _pipe4_1f1b_model)
+    _run_steps(step, args, iters=1)
+    card = hlostats.last_card("optim.step")
+    extra = card.get("extra", {})
+    measured["pipe_1f1b.bubble_fraction"] = extra.get(
+        "pipe_bubble_fraction", 1.0)
+    measured["pipe_1f1b.collective_permutes"] = card.get("ops", {}).get(
+        "collective-permute", 0)
+    tbl = build_schedule("1f1b", 2, 8, 2)
+    measured["pipe_1f1b.peak_inflight_microbatches"] = tbl.peak_inflight
+    measured["pipe.inflight_bytes_ratio"] = round(
+        tbl.peak_inflight / (8 * 2), 4)
+    # XLA's own memory budget: 1F1B's bounded stash vs GPipe's
+    # keep-every-microbatch autodiff backward, batch large enough for
+    # activations to dominate the fixed schedule buffers
+    mem_batch = 256
+    f_temp = _step_temp_bytes((1, 1, 1, 2, 1), _pipe4_1f1b_model, mem_batch)
+    _fresh({"BIGDL_TPU_PIPE_SCHEDULE": None,
+            "BIGDL_TPU_PIPE_VIRTUAL_STAGES": None})
+    g_temp = _step_temp_bytes((1, 1, 1, 2, 1), _pipe4_gpipe_model, mem_batch)
+    if f_temp and g_temp:
+        measured["pipe_1f1b.temp_bytes_ratio"] = round(f_temp / g_temp, 4)
+    context["pipe_1f1b"] = {
+        "schedule": extra.get("pipe_schedule"),
+        "virtual_stages": extra.get("pipe_virtual_stages"),
+        "microbatches": extra.get("pipe_microbatches"),
+        "collectives": card.get("collectives"),
+        "schedule_ticks": tbl.ticks,
+        "temp_bytes": {"1f1b": f_temp, "gpipe": g_temp,
+                       "batch": mem_batch},
+    }
+    _fresh({"BIGDL_TPU_PIPE_MICROBATCHES": None})
     return measured, context
 
 

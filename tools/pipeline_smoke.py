@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Pipeline + expert-parallel smoke: prove the 5-axis MeshLayout's two
-new axes on a simulated 4-device host mesh (parallel/pipeline +
-parallel/expert + LayoutSharding — docs/parallelism.md).
+new axes AND the pipeline-schedule A/B on a simulated 4-device host mesh
+(parallel/pipeline + parallel/schedule + parallel/expert + LayoutSharding
+— docs/parallelism.md).
 
 Runs 5-step trainings in one process on 4 virtual CPU devices:
 
@@ -16,14 +17,22 @@ Runs 5-step trainings in one process on 4 virtual CPU devices:
   ``(1,1,1,1,2)`` — expert tables (role ``expert_table``) shard
   ``P('expert')``.  Asserts per-device table bytes == 1/2 and loss
   parity vs the single-device run of the identical model.
+- **schedule A/B (ISSUE 13)**: a 4-block MLP trained twice at equal
+  m=8 on the pipe=2 mesh — classic GPipe (2 stages) vs 1F1B with 2
+  virtual stages per device (4 interleaved slices).  Asserts the
+  emitted ``train.pipe_bubble_fraction`` is STRICTLY lower under 1F1B
+  (1/17 vs 1/9), the 5-step loss sequences match within the pinned
+  reassociation tolerance, the compiled step's XLA temp budget (peak
+  live activations) is <= GPipe's, and the schedule table's analytic
+  in-flight microbatch count is below GPipe's keep-all-m.
 
 Prints ONE JSON line:
 
     {"metric": "pipeline_smoke", "ok": true, "runs": {...}, ...}
 
 Used by tools/tpu_runbook_r05.sh's cpu smoke mode (stage 2m) so the
-pipeline/expert promotion is proven before tunnel time; safe anywhere
-(tiny models, seconds of wall clock).
+pipeline/expert promotion AND the schedule claims are proven before
+tunnel time; safe anywhere (tiny models, seconds of wall clock).
 """
 
 from __future__ import annotations
@@ -40,7 +49,9 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 #: |loss(layout) - loss(baseline)| bound per step: sharded programs
-#: reduce in a different association order (docs/parallelism.md)
+#: reduce in a different association order (docs/parallelism.md); the
+#: 1F1B backward accumulates stage grads in its own deterministic
+#: (schedule) order, pinned by the same bound
 LOSS_TOL = 2e-3
 
 
@@ -50,6 +61,18 @@ def _mlp():
     shard-fraction arithmetic is exact."""
     import bigdl_tpu.nn as nn
     return nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 8, with_bias=False))
+
+
+def _mlp4():
+    """Four identical blocks + a head — splits into 2 stages (GPipe)
+    or 4 virtual slices (interleaved 1F1B) of the same params."""
+    import bigdl_tpu.nn as nn
+    return nn.Sequential(
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
+        nn.Linear(64, 64, with_bias=False), nn.ReLU(),
         nn.Linear(64, 64, with_bias=False), nn.ReLU(),
         nn.Linear(64, 64, with_bias=False), nn.ReLU(),
         nn.Linear(64, 8, with_bias=False))
@@ -111,11 +134,91 @@ def _frac(tree):
             / max(memstats.tree_total_bytes(tree), 1))
 
 
+def _traced_train(model, layout_sizes, steps, batch):
+    """_train under an armed tracer; returns (losses, opt, trace blob,
+    last emitted train.pipe_bubble_fraction counter value)."""
+    trace_dir = tempfile.mkdtemp(prefix="pipeline_smoke_trace_")
+    os.environ["BIGDL_TPU_TRACE"] = trace_dir
+    try:
+        losses, opt = _train(model, layout_sizes, steps, batch)
+    finally:
+        os.environ.pop("BIGDL_TPU_TRACE", None)
+    blob, bubble = "", None
+    for name in os.listdir(trace_dir):
+        if not name.startswith("trace."):
+            continue
+        with open(os.path.join(trace_dir, name)) as f:
+            text = f.read()
+        blob += text
+        try:
+            for ev in json.loads(text).get("traceEvents", []):
+                if ev.get("ph") == "C" and ev.get("name") == "train":
+                    val = ev.get("args", {}).get("pipe_bubble_fraction")
+                    if val is not None:
+                        bubble = float(val)
+        except ValueError:
+            pass
+    return losses, opt, blob, bubble
+
+
+def _compiled_temp_bytes(model_fn, num_stages, batch):
+    """XLA temp (peak scratch) budget of the real compiled train step
+    for the CURRENT schedule env knobs — the memstats proxy the A/B
+    memory claim is asserted on."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel import (LayoutSharding, MeshLayout,
+                                    partition_pipeline)
+    from bigdl_tpu.utils import memstats
+    from bigdl_tpu.utils.engine import Engine
+
+    jax.clear_caches()
+    Engine.reset()
+    mesh = MeshLayout(1, 1, 1, 2, 1).install(jax.devices()[:2])
+    model = model_fn()
+    model.build(jax.random.key(0))
+    model = partition_pipeline(model, num_stages)
+    opt = Optimizer(model, dataset=None, criterion=nn.CrossEntropyCriterion(),
+                    end_trigger=Trigger.max_iteration(1),
+                    strategy=LayoutSharding(model, min_size=0))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    step, param_sh, data_sh = opt._build_step(mesh)
+    rng = np.random.default_rng(0)
+    inp = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch, 64)), jnp.float32), data_sh)
+    tgt = jax.device_put(
+        jnp.asarray(rng.integers(0, 8, size=batch), jnp.int32), data_sh)
+    params = jax.device_put(model.params, param_sh)
+    opt_state = jax.device_put(opt.optim_method.init_state(model.params),
+                               opt._opt_sh)
+    args = (params, model.state, opt_state, inp, tgt, jnp.float32(0.05),
+            jax.random.key(1))
+    ma = memstats.compiled_memory_analysis(step.lower(*args).compile())
+    return (ma or {}).get("temp_bytes")
+
+
+def _set_env(**kv):
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--ab-microbatches", type=int, default=8)
+    ap.add_argument("--ab-mem-batch", type=int, default=256,
+                    help="batch for the A/B compiled-memory comparison "
+                         "(activations must dominate the fixed stash)")
     args = ap.parse_args(argv)
 
     from bigdl_tpu.utils.platform import force_cpu
@@ -130,7 +233,8 @@ def main(argv=None):
         return 1
 
     from bigdl_tpu.common import set_seed
-    from bigdl_tpu.parallel import GPipeSequential, partition_pipeline
+    from bigdl_tpu.parallel import (GPipeSequential, build_schedule,
+                                    partition_pipeline)
 
     t0 = time.perf_counter()
     runs = {}
@@ -144,18 +248,8 @@ def main(argv=None):
     plain.build()  # same seed -> identical init as the baseline run
     piped = partition_pipeline(plain, 2)
     # the traced run must emit the bubble counter: arm the tracer
-    trace_dir = tempfile.mkdtemp(prefix="pipeline_smoke_trace_")
-    os.environ["BIGDL_TPU_TRACE"] = trace_dir
-    try:
-        pipe_losses, _ = _train(piped, (1, 1, 1, 2, 1), args.steps,
-                                args.batch_size)
-    finally:
-        os.environ.pop("BIGDL_TPU_TRACE", None)
-    trace_blob = ""
-    for name in os.listdir(trace_dir):
-        if name.startswith("trace."):
-            with open(os.path.join(trace_dir, name)) as f:
-                trace_blob += f.read()
+    pipe_losses, _, trace_blob, _ = _traced_train(
+        piped, (1, 1, 1, 2, 1), args.steps, args.batch_size)
     bubble_emitted = "pipe_bubble_fraction" in trace_blob
     stacked = next(p for c, p in zip(piped.modules, piped.params)
                    if isinstance(c, GPipeSequential))
@@ -192,10 +286,67 @@ def main(argv=None):
         "parity_ok": moe_diff is not None and moe_diff <= LOSS_TOL,
     }
 
+    # ---- schedule A/B: GPipe vs interleaved 1F1B at equal m ----------
+    m_ab = args.ab_microbatches
+    virt = 2
+    _set_env(BIGDL_TPU_PIPE_MICROBATCHES=m_ab,
+             BIGDL_TPU_PIPE_SCHEDULE=None,
+             BIGDL_TPU_PIPE_VIRTUAL_STAGES=None)
+    set_seed(13)
+    g_model = _mlp4()
+    g_model.build()
+    g_piped = partition_pipeline(g_model, 2)
+    g_losses, _, _, g_bubble = _traced_train(
+        g_piped, (1, 1, 1, 2, 1), args.steps, args.batch_size)
+    g_temp = _compiled_temp_bytes(_mlp4, 2, args.ab_mem_batch)
+
+    _set_env(BIGDL_TPU_PIPE_SCHEDULE="1f1b",
+             BIGDL_TPU_PIPE_VIRTUAL_STAGES=virt)
+    set_seed(13)
+    f_model = _mlp4()
+    f_model.build()
+    f_piped = partition_pipeline(f_model, 2 * virt)
+    f_losses, _, _, f_bubble = _traced_train(
+        f_piped, (1, 1, 1, 2, 1), args.steps, args.batch_size)
+    f_temp = _compiled_temp_bytes(_mlp4, 2 * virt, args.ab_mem_batch)
+    _set_env(BIGDL_TPU_PIPE_SCHEDULE=None,
+             BIGDL_TPU_PIPE_VIRTUAL_STAGES=None,
+             BIGDL_TPU_PIPE_MICROBATCHES=None)
+
+    ab_diff = (max(abs(a - b) for a, b in zip(f_losses, g_losses))
+               if len(f_losses) == len(g_losses) and f_losses else None)
+    # analytic in-flight bound off the actual table: GPipe's autodiff
+    # backward keeps every microbatch's activations (m * v slices)
+    f_inflight = build_schedule("1f1b", 2, m_ab, virt).peak_inflight
+    g_inflight = m_ab  # v=1: one stage slice per device, all m live
+    runs["ab_gpipe_vs_1f1b"] = {
+        "microbatches": m_ab,
+        "virtual_stages": virt,
+        "gpipe_bubble_fraction": g_bubble,
+        "onef1b_bubble_fraction": f_bubble,
+        "bubble_strictly_lower": (g_bubble is not None
+                                  and f_bubble is not None
+                                  and f_bubble < g_bubble),
+        "max_loss_diff": ab_diff,
+        "parity_ok": ab_diff is not None and ab_diff <= LOSS_TOL,
+        "gpipe_step_temp_bytes": g_temp,
+        "onef1b_step_temp_bytes": f_temp,
+        "mem_batch": args.ab_mem_batch,
+        "temp_bytes_ok": (g_temp is not None and f_temp is not None
+                          and f_temp <= g_temp),
+        "gpipe_inflight_microbatches": g_inflight,
+        "onef1b_inflight_microbatches": f_inflight,
+        "inflight_ok": f_inflight < g_inflight,
+    }
+
+    ab = runs["ab_gpipe_vs_1f1b"]
     ok = (len(base_losses) >= args.steps
-          and all(r.get("fraction_ok") and r.get("parity_ok")
+          and all(r.get("fraction_ok", True) and r.get("parity_ok")
                   for r in runs.values())
-          and bubble_emitted)
+          and bubble_emitted
+          and ab["bubble_strictly_lower"]
+          and ab["temp_bytes_ok"]
+          and ab["inflight_ok"])
     print(json.dumps({
         "metric": "pipeline_smoke",
         "ok": ok,

@@ -151,11 +151,14 @@ if [ "$SMOKE" = 1 ]; then
 
   # fused step-arithmetic smoke (cpu only): 5-step LeNet with
   # BIGDL_TPU_FUSED_UPDATE=1 + bucketed wire must be BIT-identical to
-  # the unfused baseline (loss sequence + final params), then the
+  # the unfused baseline (loss sequence + final params), plus the
+  # collective-overlap verification (the emitted collective_s/
+  # collective_fraction counters checked against an independent
+  # wire.measure_collective_seconds probe on a (2,2,1) mesh), then the
   # conv-lowering A/B — the matmul route must eliminate every conv from
   # the compiled train step with step time no worse
-  echo "[runbook] 2h/4 fused-arithmetic smoke (fused_smoke + conv-route A/B)" >> "$LOG"
-  timeout 300 python tools/fused_smoke.py --platform cpu \
+  echo "[runbook] 2h/4 fused-arithmetic smoke (fused_smoke + collective check + conv-route A/B)" >> "$LOG"
+  timeout 300 python tools/fused_smoke.py --platform cpu --collective-check \
     > /tmp/fused_smoke.json 2>/tmp/fused_smoke.log
   FUSED_RC=$?
   if [ "$FUSED_RC" = 0 ]; then
@@ -236,8 +239,11 @@ if [ "$SMOKE" = 1 ]; then
   # GPipe-partitioned MLP and an expert=2 MoEFFN each train 5 steps
   # with 1/2-per-device shard fractions, loss parity vs the
   # unpartitioned baselines, and the pipe run emitting the
-  # train.pipe_bubble_fraction counter (mirrors stage 2j)
-  echo "[runbook] 2m/4 pipeline+expert smoke (pipe/expert shard fractions + parity)" >> "$LOG"
+  # train.pipe_bubble_fraction counter (mirrors stage 2j); then the
+  # schedule A/B — interleaved 1F1B at equal m must report a strictly
+  # lower bubble than GPipe, match its losses, and budget no more XLA
+  # temp (peak live activations) than the GPipe step
+  echo "[runbook] 2m/4 pipeline+expert smoke (shard fractions + parity + GPipe-vs-1F1B A/B)" >> "$LOG"
   timeout 300 python tools/pipeline_smoke.py \
     > /tmp/pipeline_smoke.json 2>/tmp/pipeline_smoke.log
   PIPE_RC=$?
