@@ -1028,6 +1028,31 @@ def main(argv=None):
                     help="--serve closed-loop concurrent clients")
     ap.add_argument("--serve-requests", type=int, default=200,
                     help="--serve total closed-loop requests")
+    ap.add_argument("--replay", default=None, metavar="TRACE",
+                    help="with --serve: replay a RECORDED request trace "
+                         "(serve/tracefile.py recordio format — arrival "
+                         "deltas, payloads, tenants, priorities, "
+                         "deadlines) with open-loop pacing instead of "
+                         "synthetic load, reporting per-tenant/per-"
+                         "priority SLO attainment beside p50/p95/p99 "
+                         "and shed-by-cause")
+    ap.add_argument("--speed", type=float, default=10.0,
+                    help="--replay time compression: offer the trace at "
+                         "K x its recorded rate (the 10-100x regime the "
+                         "scale-out layer is sized for)")
+    ap.add_argument("--replay-compare", action="store_true",
+                    help="with --replay: ALSO replay against a fixed "
+                         "1-replica pool and report both attainments "
+                         "(the autoscaled-vs-static measurement "
+                         "tools/scale_smoke.py gates on)")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="--replay pool ceiling: > 1 arms the queue-"
+                         "driven autoscaler (serve/autoscale.py) for "
+                         "the replayed pool; 1 = fixed pool")
+    ap.add_argument("--record-trace", default=None, metavar="PATH",
+                    help="with --serve (synthetic modes): record the "
+                         "offered open-loop + storm traffic into PATH "
+                         "as a replayable trace")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write the final JSON record to PATH and "
                          "flush every completed config incrementally to "
@@ -1075,9 +1100,16 @@ def main(argv=None):
     if args.data:
         return _data_micro_bench()
     if args.serve:
+        if args.replay:
+            return _serve_replay_bench(platform=args.platform,
+                                       trace_path=args.replay,
+                                       speed=args.speed,
+                                       compare=args.replay_compare,
+                                       autoscale_max=args.autoscale_max)
         return _serve_bench(platform=args.platform,
                             clients=args.serve_clients,
-                            requests=args.serve_requests)
+                            requests=args.serve_requests,
+                            record_trace=args.record_trace)
     t_start = time.perf_counter()
     if args.out:
         _OUT_STATE["path"] = args.out
@@ -1327,7 +1359,125 @@ def _percentiles(latencies):
             "p99_ms": round(pick(0.99) * 1e3, 2)}
 
 
-def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
+def _replay_model_for(header, model_builder=None):
+    """A servable model matching the trace's recorded sample shape: the
+    caller's builder, LeNet for image-shaped traces, a small Linear head
+    for flat feature rows — a trace whose shape matches nothing is a
+    typed error, not a garbage benchmark."""
+    import jax
+    import numpy as np
+
+    if model_builder is not None:
+        return model_builder()
+    shape = tuple(header.get("sample_shape") or ())
+    dtype = header.get("sample_dtype", "float32")
+    if shape == (28, 28, 1):
+        from bigdl_tpu.models.lenet import LeNet5
+        return (LeNet5(10).build(jax.random.key(0)),
+                np.zeros(shape, np.float32))
+    if len(shape) == 1 and shape[0] >= 1:
+        import bigdl_tpu.nn as nn
+        d = int(shape[0])
+        model = nn.Sequential().add(
+            nn.Linear(d, max(2, min(d, 8)))).build(jax.random.key(0))
+        return model, np.zeros(shape, np.dtype(dtype))
+    raise SystemExit(
+        f"bench --replay: no builtin model serves sample shape {shape} "
+        "(record traces against lenet-shaped or flat-feature models, or "
+        "extend _replay_model_for)")
+
+
+def _serve_replay_bench(platform=None, trace_path=None, speed=10.0,
+                        compare=False, autoscale_max=4,
+                        model_builder=None):
+    """`--serve --replay TRACE --speed K`: recorded-traffic replay.
+
+    Replays a recorded request stream (serve/tracefile.py — arrival
+    deltas, payloads, tenants, priorities, deadlines) with OPEN-LOOP
+    pacing at K x the recorded rate against the serving stack, and
+    reports **per-tenant / per-priority SLO attainment** (fraction of
+    offered requests answered within their own deadline) beside
+    p50/p95/p99, shed-by-cause (overload / timeout / a separate real-
+    `errors` bucket), the autoscaler's decisions, and the AOT ledger
+    delta across the scale-up window (the zero-fresh-lowers receipt).
+    `--replay-compare` additionally replays the same trace against a
+    FIXED 1-replica pool — the elasticity win as one JSON record."""
+    import numpy as np
+
+    if platform:
+        import jax as _jax
+        try:
+            _jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            pass
+    import jax
+
+    from bigdl_tpu.serve import (InferenceServer, read_trace, replay,
+                                 resolve_outcomes, slo_report)
+    from bigdl_tpu.utils import aot as aot_mod
+    from bigdl_tpu.utils.engine import Engine
+
+    _beat("init")
+    Engine.reset()
+    Engine.init()
+    header, events = read_trace(trace_path)
+    if not events:
+        _fail(ValueError(f"trace {trace_path} holds zero events"),
+              "serve-replay")
+    model, sample = _replay_model_for(header, model_builder)
+
+    def run_pool(tag, ceiling):
+        _beat(f"serve:replay:{tag}")
+        server = InferenceServer(
+            model, example=sample, replicas=1,
+            autoscale_min=1, autoscale_max=ceiling)
+        with server:
+            aot0 = aot_mod.stats() if aot_mod.enabled() else None
+
+            def submit(e):
+                return server.submit(e.payload, deadline_ms=e.deadline_ms,
+                                     tenant=e.tenant, priority=e.priority)
+
+            outcomes = replay(events, submit, speed=speed, progress=_beat)
+            resolve_outcomes(outcomes)
+            rec = slo_report(outcomes)
+            stats = server.stats()
+        rec["pool"] = {"autoscale_max": ceiling,
+                       "replicas_final": stats["replicas"]}
+        if "autoscale" in stats:
+            rec["autoscale"] = stats["autoscale"]
+        if aot0 is not None:
+            rec["aot_delta"] = _aot_delta(aot0)
+        return rec
+
+    autoscaled = autoscale_max and autoscale_max > 1
+    primary = run_pool("autoscaled" if autoscaled else "fixed",
+                       autoscale_max if autoscaled else 0)
+    out = {"metric": "serve_replay_slo_attainment",
+           "value": primary["attainment"], "unit": "fraction",
+           "vs_baseline": None, "mode": "serve-replay",
+           "trace": trace_path, "speed": speed,
+           "events": len(events),
+           "recorded_duration_s": header.get("duration_s"),
+           "model": type(model).__name__,
+           "replay": primary,
+           "device": str(jax.devices()[0])}
+    if compare:
+        fixed = run_pool("fixed-1", 0)
+        out["fixed"] = fixed
+        if primary["attainment"] is not None and \
+                fixed["attainment"] is not None:
+            out["attainment_gain"] = round(
+                primary["attainment"] - fixed["attainment"], 4)
+    _flush_trace()
+    print(json.dumps(out))
+    sys.stdout.flush()
+    _EMIT_DONE.set()
+    return out
+
+
+def _serve_bench(platform=None, clients=8, requests=200, model_builder=None,
+                 record_trace=None):
     """`--serve`: online-serving load bench (bigdl_tpu.serve).
 
     Two load shapes against the LeNet forward, ONE JSON line:
@@ -1431,14 +1581,22 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
             except ServerOverloaded:
                 shed_overload += 1
         shed_timeout = 0
+        open_errors, open_error_samples = 0, []
         for t0, h in handles:
             try:
                 h.result(120)
                 open_lat.append(time.perf_counter() - t0)
             except RequestTimeout:
                 shed_timeout += 1
-            except Exception:  # noqa: BLE001 — counted as shed
-                shed_timeout += 1
+            except ServerOverloaded:  # evicted from the queue post-admit
+                shed_overload += 1
+            except Exception as e:  # noqa: BLE001 — a REAL failure, not
+                # intentional shedding: reported in its own bucket so a
+                # broken replica can never masquerade as load shedding
+                open_errors += 1
+                if len(open_error_samples) < 5:
+                    open_error_samples.append(
+                        f"{type(e).__name__}: {e}")
         open_stats = server.stats()
     shed = shed_overload + shed_timeout
     open_loop = {"offered_rps": round(target_rps, 1),
@@ -1446,9 +1604,12 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
                  "deadline_ms": round(deadline_ms, 1),
                  "shed_overload": shed_overload,
                  "shed_timeout": shed_timeout,
+                 "errors": open_errors,
                  "shed_rate": round(shed / n_open, 4) if n_open else 0.0,
                  **_percentiles(open_lat),
                  "batch_fill": open_stats["batch_fill"]}
+    if open_error_samples:
+        open_loop["error_samples"] = open_error_samples
 
     # -- traffic storm --------------------------------------------------
     # bursty open loop against a deliberately tiny queue, requests spread
@@ -1463,11 +1624,16 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
     bursts = 4
     burst_n = min(max(requests // 4, 12), 96)
     by_prio = {p: {"offered": 0, "served": 0, "shed_overload": 0,
-                   "shed_timeout": 0} for p in (0, 1, 2)}
+                   "shed_timeout": 0, "errors": 0} for p in (0, 1, 2)}
     storm_lat = []
     with InferenceServer(model, queue_limit=8,
                          deadline_ms=max(deadline_ms, 20.0),
                          example=sample) as server:
+        if record_trace:
+            # capture the storm's offered stream (the bursty diurnal
+            # shape worth replaying) as a serve/tracefile.py trace —
+            # written when the server stops
+            server.record_trace(record_trace)
         pending = []
         for b in range(bursts):
             for i in range(burst_n):
@@ -1488,8 +1654,11 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
                 storm_lat.append(time.perf_counter() - t0)
             except ServerOverloaded:   # evicted for a higher class
                 by_prio[p]["shed_overload"] += 1
-            except Exception:  # noqa: BLE001 — deadline/typed: counted
+            except RequestTimeout:     # deadline passed while queued
                 by_prio[p]["shed_timeout"] += 1
+            except Exception:  # noqa: BLE001 — real failures get their
+                # own bucket, never reported as intentional shedding
+                by_prio[p]["errors"] += 1
         storm_stats = server.stats()
     for p, rec in by_prio.items():
         sheds = rec["shed_overload"] + rec["shed_timeout"]
@@ -1499,6 +1668,7 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
     served = sum(r["served"] for r in by_prio.values())
     storm = {"bursts": bursts, "burst_n": burst_n,
              "offered": offered, "served": served,
+             "errors": sum(r["errors"] for r in by_prio.values()),
              "shed_rate": round(1.0 - served / offered, 4) if offered
              else 0.0,
              "by_priority": {str(p): by_prio[p] for p in sorted(by_prio)},
@@ -1514,6 +1684,8 @@ def _serve_bench(platform=None, clients=8, requests=200, model_builder=None):
            "closed_loop": closed, "open_loop": open_loop,
            "storm": storm,
            "device": str(jax.devices()[0])}
+    if record_trace:
+        out["recorded_trace"] = record_trace
     _flush_trace()
     print(json.dumps(out))
     sys.stdout.flush()

@@ -1983,9 +1983,16 @@ class _ShardedForward:
     forward runs as one SPMD program over the whole Engine mesh; params are
     placed replicated once and cached."""
 
-    def __init__(self, model: Module, strategy: ShardingStrategy = None):
+    def __init__(self, model: Module, strategy: ShardingStrategy = None,
+                 mesh=None):
         self.model = model
         self.strategy = strategy or DataParallel()
+        #: optional pinned mesh: the serving topology router
+        #: (serve/router.py) places each replica's engine on a DISJOINT
+        #: device subset of the host instead of the process-wide
+        #: Engine.mesh() — everything else (padding, sharding, AOT)
+        #: derives from whichever mesh is live here
+        self._pin_mesh = mesh
         self._fwd = None
         self._placed = None      # (mesh, params, net_state)
         self._placed_src = None  # identity of model.params at placement time
@@ -1995,11 +2002,15 @@ class _ShardedForward:
         self._aot_exe: dict = {}
         self._aot_fp = None
 
+    def _mesh(self):
+        return self._pin_mesh if self._pin_mesh is not None \
+            else Engine.mesh()
+
     def _ensure(self):
         model = self.model
         if model.params is None:
             model.build()
-        mesh = Engine.mesh()
+        mesh = self._mesh()
         # re-place when the mesh changed OR the facade's params were replaced
         # (e.g. by a training run) — a stale cache would silently evaluate
         # old weights
@@ -2023,7 +2034,7 @@ class _ShardedForward:
     def dp_size(self) -> int:
         # the padding multiple: how many ways the strategy splits the
         # batch rows (data, and fsdp on MeshLayout meshes)
-        return self.strategy.batch_shard_count(Engine.mesh())
+        return self.strategy.batch_shard_count(self._mesh())
 
     def __call__(self, inp):
         """Pad batch dim to a multiple of the data axis, forward sharded,
@@ -2082,6 +2093,14 @@ class _ShardedForward:
             fields = dict(aot_mod.base_fingerprint(mesh))
             fields["kind"] = "forward"
             fields["model"] = self._aot_fp
+            if self._pin_mesh is not None:
+                # a serialized executable is bound to its device
+                # assignment: a subset-pinned engine (topology router)
+                # must never hit an entry compiled for a DIFFERENT
+                # subset of the same shape — the device ids join the key
+                # (the default Engine.mesh() path keeps its stable key)
+                fields["devices"] = [int(d.id)
+                                     for d in mesh.devices.flat]
             fields["args"] = aot_mod.aval_fingerprint(
                 (params, net_state, placed))
 

@@ -12,20 +12,36 @@ requests.  The control plane (serve/control.py) makes the pool
 self-healing: dead/silent replicas restart within a bounded budget,
 `swap(canary_fraction=...)` auto-promotes or auto-rolls-back a canary
 on a rolling p99/error comparison, and admission is tenant/priority
-aware (token-bucket quotas, shed-lowest-priority-first).  See
+aware (token-bucket quotas, shed-lowest-priority-first).  The
+scale-out layer makes the pool elastic and placement topology-aware:
+a queue-wait-driven autoscaler (serve/autoscale.py) grows/shrinks the
+pool between bounds with AOT-warm spawn, a `TopologyRouter`
+(serve/router.py) places mesh-sharded replicas on disjoint device
+subsets and routes by (bucket, per-replica queue depth), and recorded
+request traces (serve/tracefile.py) replay at 10-100x in `bench.py
+--serve --replay` reporting per-tenant SLO attainment.  See
 docs/serving.md.
 """
 
+from .autoscale import AutoScaler
 from .batcher import (DynamicBatcher, PendingRequest, RequestTimeout,
                       ServeError, ServerClosed, ServerOverloaded,
                       default_buckets, pad_rows, predict_in_fixed_batches)
 from .control import (CanaryController, CanaryRejected, QuotaExceeded,
                       ReplicaLostError, ReplicaMonitor, TenantQuotas)
+from .router import PlacementError, TopologyRouter, plan_subsets
 from .server import InferenceServer, ModelVersion
+from .tracefile import (TraceEvent, TraceFormatError, TraceRecorder,
+                        read_trace, replay, resolve_outcomes, slo_report,
+                        write_trace)
 
 __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "PendingRequest", "ServeError", "ServerOverloaded",
            "ServerClosed", "RequestTimeout", "ReplicaLostError",
            "CanaryRejected", "QuotaExceeded", "TenantQuotas",
            "CanaryController", "ReplicaMonitor", "default_buckets",
-           "pad_rows", "predict_in_fixed_batches"]
+           "pad_rows", "predict_in_fixed_batches",
+           "AutoScaler", "TopologyRouter", "PlacementError",
+           "plan_subsets", "TraceEvent", "TraceFormatError",
+           "TraceRecorder", "read_trace", "write_trace", "replay",
+           "resolve_outcomes", "slo_report"]

@@ -259,6 +259,12 @@ class DynamicBatcher:
         self._row_s_ema = per if self._row_s_ema is None else \
             0.8 * self._row_s_ema + 0.2 * per
 
+    def service_row_seconds(self) -> Optional[float]:
+        """The EMA seconds/row (None before the first served batch) —
+        the service-rate signal behind retry-after and the autoscaler's
+        queue-wait estimate (serve/autoscale.py)."""
+        return self._row_s_ema
+
     def submit(self, payload, deadline: Optional[float] = None, *,
                tenant: Optional[str] = None,
                priority: int = 0) -> PendingRequest:
@@ -321,17 +327,25 @@ class DynamicBatcher:
 
     # -- workers --------------------------------------------------------
 
-    def collect(self, heartbeat: Optional[Callable] = None
+    def collect(self, heartbeat: Optional[Callable] = None,
+                stop_when: Optional[Callable] = None
                 ) -> Optional[List[PendingRequest]]:
         """Block until a batch is ready, the coalesce window expires, or
         shutdown.  Returns up to ``max_batch`` live requests (may be []
         when every dequeued request had expired — the caller just loops),
         or None when the batcher is closed and (if draining) empty.
         ``heartbeat`` is called on every wait slice so the worker's
-        supervisor channel stays live while parked."""
+        supervisor channel stays live while parked.  ``stop_when`` (a
+        predicate checked per wait slice) lets a caller retire a worker
+        parked on an EMPTY queue without closing the batcher — the pool
+        shrink path (serve/autoscale.py): a condemned replica must not
+        stay parked until the next request arrives just to notice its
+        condemnation."""
         with self._cond:
             while not self._q:
                 if self._closed:
+                    return None
+                if stop_when is not None and stop_when():
                     return None
                 self._cond.wait(self._SLICE)
                 if heartbeat is not None:

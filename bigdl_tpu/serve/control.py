@@ -363,6 +363,13 @@ class ReplicaMonitor:
             return
         now = self.clock()
         for idx, st in list(srv._replica.items()):
+            if idx >= srv.replicas:
+                # retired by a pool shrink (serve/autoscale.py): a slot
+                # the autoscaler deliberately emptied is not a lost
+                # replica — healing it back would fight the controller
+                # and burn restart budget
+                self._pending.pop(idx, None)
+                continue
             due = self._pending.get(idx)
             if due is not None:
                 # condemned and waiting out its backoff: respawn when due

@@ -1,0 +1,479 @@
+"""Topology-aware replica routing: mesh-sharded replicas on device subsets.
+
+The PR 5/10 server is one shared queue drained by worker THREADS over
+one engine on the process-wide ``Engine.mesh()`` — fine for a single
+chip, wrong for a host with many: every request pays the full-mesh
+padding multiple, one wedged collective stalls the only engine, and the
+pool cannot grow past the thread count usefully.  This module places
+REAL replicas instead:
+
+- **placement**: the host's devices are partitioned into DISJOINT
+  subsets, one per replica, each of the member layout's size
+  (``MeshLayout(data, fsdp, tp)`` per member — a tp=2 member owns 2
+  devices and serves its version fsdp/tp-sharded through
+  ``LayoutSharding``, exactly like training).  Subsets are contiguous
+  device runs (devices enumerate locality-ordered), never overlap, and
+  a layout that does not fit the host raises a typed
+  :class:`PlacementError` at construction, not at traffic time.
+- **routing**: each member owns its own
+  :class:`~bigdl_tpu.serve.batcher.DynamicBatcher` and worker; a request
+  routes by **(bucket, per-replica queue depth)** instead of one shared
+  queue: fewest pending full buckets first, then prefer JOINING an
+  already-coalescing partial batch (it raises fill and that batch's
+  flush window is already ticking) over opening a fresh window, then
+  lowest depth, then index.  Answers stay bit-identical to bulk
+  ``Predictor.predict`` — same ``_ShardedForward`` arithmetic, just
+  pinned to the member's mesh.
+- **degradation**: each member runs the PR 10 control plane on its own
+  subset (heartbeat monitor, bounded restart budget).  A member whose
+  budget is spent flips unhealthy and the router simply stops routing
+  to it — traffic degrades to the surviving subsets; only when NO
+  member survives does admission raise
+  :class:`~bigdl_tpu.serve.control.ReplicaLostError`.
+- **elasticity**: ``scale_to(n)`` activates/retires members;
+  activation builds a fresh engine on the next free subset and warms
+  its bucket ladder through the AOT executable cache — with
+  ``prewarm`` (default: on whenever the cache is armed) every subset's
+  ladder is compiled-and-stored once at ``start()``, so a later
+  scale-up is pure cache READS: zero fresh lowers, asserted by
+  ``tools/scale_smoke.py`` via ``stats()["aot"]``.  The
+  :class:`~bigdl_tpu.serve.autoscale.AutoScaler` drives ``scale_to``
+  through the same signal protocol the plain server implements.
+
+Tenant quotas live at the ROUTER (one bucket per tenant across the
+whole pool — members get quotas off), shed-priority stays inside each
+member's queue where the eviction candidate lives.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import config, telemetry
+from . import control
+from .batcher import ServeError
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["PlacementError", "TopologyRouter", "plan_subsets"]
+
+
+class PlacementError(ServeError):
+    """The requested replica layout cannot be placed: not enough devices
+    for `replicas` disjoint subsets of the member layout's size.  Raised
+    at construction — a placement that cannot exist must not fail at
+    traffic time."""
+
+
+def plan_subsets(devices: Sequence, per_replica: int,
+                 replicas: int) -> List[list]:
+    """Partition ``devices`` into ``replicas`` DISJOINT contiguous runs
+    of ``per_replica`` devices (contiguous = locality: jax enumerates
+    devices neighbor-ordered).  Typed :class:`PlacementError` when the
+    host cannot hold them."""
+    devices = list(devices)
+    need = per_replica * replicas
+    if per_replica < 1 or replicas < 1:
+        raise PlacementError(
+            f"serve: placement needs >= 1 device per replica and >= 1 "
+            f"replica (got {per_replica} x {replicas})")
+    if need > len(devices):
+        raise PlacementError(
+            f"serve: cannot place {replicas} replica(s) x {per_replica} "
+            f"device(s) = {need} on a {len(devices)}-device host — "
+            "shrink the member layout or the replica count")
+    return [devices[i * per_replica:(i + 1) * per_replica]
+            for i in range(replicas)]
+
+
+class TopologyRouter:
+    """Route requests over mesh-sharded replicas on disjoint device
+    subsets (see module docstring).
+
+    Duck-type compatible with :class:`InferenceServer` where the HTTP
+    front end and the autoscaler need it: ``submit`` / ``predict`` /
+    ``stats`` / ``healthy`` / ``version`` / ``swap`` / ``warmup`` /
+    ``start`` / ``stop`` / ``scale_to`` / ``autoscale_signals`` /
+    ``record_trace`` / ``stop_trace``."""
+
+    def __init__(self, model, *, layout=None, replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 devices: Optional[Sequence] = None,
+                 example: Optional[np.ndarray] = None,
+                 prewarm: Optional[bool] = None,
+                 tenant_qps: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 autoscale_min: Optional[int] = None,
+                 autoscale_max: Optional[int] = None,
+                 autoscale_target_wait_ms: Optional[float] = None,
+                 autoscale_idle_s: Optional[float] = None,
+                 autoscale_cooldown_s: Optional[float] = None,
+                 autoscale_up_polls: Optional[int] = None,
+                 autoscale_step: Optional[int] = None,
+                 autoscale_poll_s: Optional[float] = None,
+                 clock=None, **member_kwargs):
+        import jax
+
+        from ..parallel.layout import MeshLayout
+        from . import autoscale as autoscale_mod
+        self.model = model
+        self.layout = layout if layout is not None else MeshLayout(1, 1, 1)
+        if isinstance(self.layout, str):
+            self.layout = MeshLayout.parse(self.layout)
+        self.replicas = int(replicas if replicas is not None
+                            else config.get_int("SERVE_REPLICAS", 1))
+        self._example = None if example is None else np.asarray(example)
+        self._member_kwargs = dict(member_kwargs)
+        self._member_kwargs.pop("replicas", None)
+        self._member_kwargs.pop("mesh", None)
+        # quotas are ROUTER-level (one bucket per tenant across the
+        # pool); members run with quotas off
+        self._member_kwargs["tenant_qps"] = 0.0
+        import time as _time
+        self.clock = clock or _time.monotonic
+        qps = float(tenant_qps if tenant_qps is not None
+                    else config.get_float("SERVE_TENANT_QPS", 0.0))
+        burst = (tenant_burst if tenant_burst is not None
+                 else config.get_float("SERVE_TENANT_BURST", 0.0))
+        self._quotas = (control.TenantQuotas(qps, burst=burst,
+                                             clock=self.clock)
+                        if qps > 0 else None)
+        self._autoscale_cfg = autoscale_mod.autoscale_knobs(
+            self.replicas,
+            {"min_replicas": autoscale_min, "max_replicas": autoscale_max,
+             "target_wait_ms": autoscale_target_wait_ms,
+             "idle_s": autoscale_idle_s,
+             "cooldown_s": autoscale_cooldown_s,
+             "up_polls": autoscale_up_polls, "step": autoscale_step,
+             "poll_s": autoscale_poll_s})
+        self._autoscaler = None
+        self._recorder = None
+        cap = max(self.replicas, self._autoscale_cfg["max_replicas"],
+                  int(max_replicas or 0))
+        devs = list(devices) if devices is not None else list(jax.devices())
+        # every POTENTIAL member's subset is planned up front: scale-up
+        # must never discover at traffic time that the host is too small
+        self._subsets = plan_subsets(devs, self.layout.size, cap)
+        self._meshes = [self.layout.build_mesh(s) for s in self._subsets]
+        self._members: List[Optional[object]] = [None] * len(self._subsets)
+        self._prewarm = prewarm
+        self._lock = threading.Lock()   # member list mutations
+        self._routed = [0] * len(self._subsets)
+        self._started = False
+        self._closed = False
+
+    # -- members --------------------------------------------------------
+
+    def _member_strategy(self):
+        if (self.layout.fsdp, self.layout.tp) == (1, 1):
+            return None  # plain data-parallel member (usually 1 device)
+        from ..parallel import LayoutSharding
+        return LayoutSharding(self.model)
+
+    def _build_member(self, i: int):
+        """One replica = one InferenceServer pinned to subset ``i``'s
+        mesh, with its own queue, worker, and PR 10 monitor.  The warmup
+        inside ``start()`` goes through the AOT cache — a subset whose
+        ladder was prewarmed (or warmed by any earlier process) spawns
+        with zero fresh lowers."""
+        from .server import InferenceServer
+        member = InferenceServer(
+            self.model, example=self._example, replicas=1,
+            strategy=self._member_strategy(), mesh=self._meshes[i],
+            autoscale_max=0,  # one controller (the router's), not N
+            **self._member_kwargs)
+        return member
+
+    def _activate(self, i: int) -> None:
+        member = self._build_member(i)
+        member.start()
+        with self._lock:
+            self._members[i] = member
+        telemetry.instant("serve.router", cat="serve", action="activate",
+                          member=i,
+                          devices=[int(d.id) for d in self._subsets[i]])
+
+    def _deactivate(self, i: int) -> None:
+        with self._lock:
+            member, self._members[i] = self._members[i], None
+        if member is not None:
+            # graceful: everything already queued on this member is
+            # answered before its worker exits; new traffic routes to
+            # the survivors the moment it leaves the member list
+            member.stop(drain=True)
+            telemetry.instant("serve.router", cat="serve",
+                              action="retire", member=i)
+
+    def _prewarm_subset(self, i: int) -> None:
+        """Compile-and-store subset ``i``'s bucket ladder without
+        activating it: one throwaway version per subset populates the
+        AOT cache, so a later scale-up onto this subset is pure cache
+        reads (zero fresh lowers)."""
+        from .server import ModelVersion
+        if self._example is None:
+            return
+        version = ModelVersion(0, self.model, f"prewarm:{i}",
+                               self._member_strategy(),
+                               mesh=self._meshes[i])
+        from .batcher import default_buckets
+        mb = self._member_kwargs.get("max_batch") or \
+            config.get_int("SERVE_MAX_BATCH", 8)
+        for b in (self._member_kwargs.get("buckets")
+                  or default_buckets(int(mb))):
+            version.predict(np.stack([self._example] * int(b)))
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "TopologyRouter":
+        if self._started:
+            return self
+        if self._closed:
+            raise ServeError("serve: cannot restart a stopped router")
+        for i in range(self.replicas):
+            self._activate(i)
+        from ..utils import aot
+        prewarm = self._prewarm if self._prewarm is not None \
+            else aot.enabled()
+        if prewarm:
+            for i in range(self.replicas, len(self._subsets)):
+                self._prewarm_subset(i)
+        if self._autoscale_cfg["max_replicas"] > 0:
+            from . import autoscale as autoscale_mod
+            cfg = dict(self._autoscale_cfg)
+            cfg["min_replicas"] = min(cfg["min_replicas"], self.replicas)
+            cfg["max_replicas"] = min(
+                max(cfg["max_replicas"], cfg["min_replicas"]),
+                len(self._subsets))
+            poll = cfg.pop("poll_s")
+            self._autoscaler = autoscale_mod.AutoScaler(
+                self, poll_s=poll, clock=self.clock, **cfg).start()
+        self._started = True
+        logger.info(
+            "serve: router started — %d/%d replica(s) live, %d device(s) "
+            "per replica (layout %s)%s", self.replicas, len(self._subsets),
+            self.layout.size, self.layout.sizes,
+            " [all subsets prewarmed]" if prewarm else "")
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self._closed = True
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
+        for i, m in enumerate(self._members):
+            if m is not None:
+                m.stop(drain=drain, timeout=timeout)
+        if self._recorder is not None and self._recorder.path:
+            try:
+                self._recorder.save()
+            except Exception:  # noqa: BLE001 — recording is best-effort
+                logger.exception("serve: trace flush failed at shutdown")
+
+    def __enter__(self) -> "TopologyRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- routing --------------------------------------------------------
+
+    def _live_members(self):
+        with self._lock:
+            return [(i, m) for i, m in enumerate(self._members)
+                    if m is not None]
+
+    def _pick(self) -> Optional[int]:
+        """The dispatch decision: (bucket, per-replica queue depth).
+
+        Key, in order: fewest pending FULL buckets (``depth //
+        max_batch`` — whole batches already owed to the device), then
+        prefer a member with a PARTIAL batch coalescing (joining it
+        raises fill and that batch's flush window is already ticking —
+        opening a fresh window elsewhere would pay a whole
+        ``max_wait`` again), then raw depth, then index (determinism).
+        Unhealthy/closed members never receive traffic — replica loss
+        degrades the pool to the surviving subsets."""
+        best = best_key = None
+        for i, m in self._live_members():
+            if not m.healthy() or m.batcher.closed:
+                continue
+            d = m.batcher.depth()
+            key = (d // m.max_batch, 0 if d % m.max_batch else 1, d, i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None, priority: int = 0):
+        """Route one sample to the chosen member's queue.  Raises the
+        member's typed admission errors (ServerOverloaded /
+        RequestTimeout downstream), router-level QuotaExceeded, or
+        ReplicaLostError when no member survives."""
+        x = np.asarray(x)
+        if self._recorder is not None:
+            self._recorder.note(x, tenant=tenant, priority=priority,
+                                deadline_ms=deadline_ms)
+        if self._quotas is not None:
+            self._quotas.admit(tenant)
+        i = self._pick()
+        if i is None:
+            raise control.ReplicaLostError(
+                "serve: router has no live healthy replica — every "
+                "member is lost or retired")
+        self._routed[i] += 1
+        return self._members[i].submit(x, deadline_ms=deadline_ms,
+                                       tenant=tenant, priority=priority)
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    # -- pool size (serve/autoscale.AutoScaler hooks) -------------------
+
+    def scale_to(self, n: int) -> int:
+        """Activate/retire members.  Growth builds a FRESH engine on the
+        next planned subset and warms its ladder through the AOT cache
+        (cache reads when prewarmed — the spawn path is deliberately the
+        same one a PR 10 restart takes); shrink drains and retires the
+        highest members, whose queued requests are answered before the
+        worker exits."""
+        n = max(min(int(n), len(self._subsets)), 1)
+        cur = self.replicas
+        if n == cur or self._closed:
+            return cur
+        if n > cur:
+            for i in range(cur, n):
+                self._activate(i)
+        else:
+            for i in range(n, cur):
+                self._deactivate(i)
+        self.replicas = n
+        logger.info("serve: router scaled %d -> %d replica(s)", cur, n)
+        return n
+
+    def autoscale_signals(self) -> dict:
+        depth = 0
+        batches = 0
+        emas = []
+        live = 0
+        for _i, m in self._live_members():
+            sig = m.autoscale_signals()
+            depth += sig["depth"]
+            batches += sig["batches"]
+            live += sig["live"]
+            if sig["row_s_ema"]:
+                emas.append(sig["row_s_ema"])
+        return {"depth": depth,
+                "row_s_ema": (sum(emas) / len(emas)) if emas else None,
+                "batches": batches, "live": live}
+
+    # -- fleet operations ----------------------------------------------
+
+    def warmup(self, example: Optional[np.ndarray] = None) -> None:
+        if example is not None:
+            self._example = np.asarray(example)
+        for _i, m in self._live_members():
+            m.warmup(self._example)
+
+    def swap(self, source, **kwargs) -> int:
+        """Fan the swap out to every live member (each builds + warms on
+        its own subset before its local flip — a multi-member swap is N
+        independent zero-drop swaps; a remote `source` is fetched once
+        per member, so prefer params/Module sources for big fleets)."""
+        vid = None
+        for _i, m in self._live_members():
+            vid = m.swap(source, **kwargs)
+        if vid is None:
+            raise control.ReplicaLostError(
+                "serve: router swap with no live member")
+        return vid
+
+    def healthy(self) -> bool:
+        """True while ANY member survives — the router's whole point is
+        degrading to the surviving subsets instead of dying with one."""
+        return any(m.healthy() for _i, m in self._live_members())
+
+    @property
+    def version(self):
+        for _i, m in self._live_members():
+            return m.version
+        return None
+
+    @property
+    def max_batch(self) -> int:
+        for _i, m in self._live_members():
+            return m.max_batch
+        return int(config.get_int("SERVE_MAX_BATCH", 8))
+
+    # -- traffic trace capture ------------------------------------------
+
+    def record_trace(self, path: Optional[str] = None, *,
+                     limit: Optional[int] = None):
+        from .tracefile import TraceRecorder
+        if self._recorder is not None and (path is None or
+                                           self._recorder.path == path):
+            return self._recorder
+        self._recorder = TraceRecorder(clock=self.clock, limit=limit,
+                                       path=path)
+        return self._recorder
+
+    def stop_trace(self, path: Optional[str] = None):
+        rec, self._recorder = self._recorder, None
+        if rec is None:
+            return []
+        if path or rec.path:
+            rec.save(path)
+        return rec.events()
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> dict:
+        members = {}
+        agg = {"submitted": 0, "batches": 0, "batch_rows": 0,
+               "shed_overload": 0, "shed_timeout": 0, "shed_priority": 0,
+               "restarts": 0}
+        for i, m in self._live_members():
+            st = m.stats()
+            members[str(i)] = {
+                "devices": [int(d.id) for d in self._subsets[i]],
+                "routed": self._routed[i],
+                "queue_depth": st["queue_depth"],
+                "healthy": st["healthy"],
+                "version": st["version"],
+                "batches": st["batches"],
+                "batch_fill": st["batch_fill"],
+                "restarts": st["restarts"],
+                "shed_overload": st["shed_overload"],
+                "shed_timeout": st["shed_timeout"]}
+            for k in agg:
+                agg[k] += st.get(k, 0)
+        out = dict(agg)
+        out["router"] = {
+            "layout": list(self.layout.sizes),
+            "devices_per_replica": self.layout.size,
+            "replicas": self.replicas,
+            "replicas_planned": len(self._subsets),
+            "routed": list(self._routed),
+            "members": members}
+        out["replicas"] = self.replicas
+        out["replicas_live"] = len(members)
+        out["healthy"] = self.healthy()
+        v = self.version
+        out["version"] = v.id if v is not None else None
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.stats()
+        if self._quotas is not None:
+            out["quota"] = self._quotas.stats()
+        if self._recorder is not None:
+            out["trace_recording"] = self._recorder.stats()
+        from ..utils import aot
+        if aot.enabled():
+            s = aot.stats()
+            out["aot"] = {k: int(s[k]) for k in
+                          ("hits", "misses", "stores", "lowers",
+                           "compiles", "corrupt")}
+        return out

@@ -54,6 +54,15 @@ Knobs (utils/config tier; constructor args override):
 | ``BIGDL_TPU_SERVE_CANARY_ERROR_MARGIN`` | rollback when canary error rate > incumbent + margin | 0.05 |
 | ``BIGDL_TPU_SERVE_TENANT_QPS`` | per-tenant token-bucket refill, req/s (0 = quotas off) | 0 |
 | ``BIGDL_TPU_SERVE_TENANT_BURST`` | per-tenant bucket depth (0 = 2x qps, min 1) | 0 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_MAX`` | pool ceiling; > 0 arms queue-driven autoscaling (serve/autoscale.py) | 0 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_MIN`` | pool floor under autoscaling | replicas |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_TARGET_WAIT_MS`` | est. queue wait that triggers growth | 50 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_IDLE_S`` | sustained-idle seconds before one shrink step | 2.0 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_COOLDOWN_S`` | min seconds between scale actions | 0.5 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_UP_POLLS`` | consecutive over-target polls before growing | 2 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_STEP`` | replicas added per scale-up | 1 |
+| ``BIGDL_TPU_SERVE_AUTOSCALE_POLL_S`` | controller poll cadence | 0.05 |
+| ``BIGDL_TPU_SERVE_TRACE_LIMIT`` | max in-memory trace events while recording | 100000 |
 """
 
 from __future__ import annotations
@@ -78,17 +87,22 @@ __all__ = ["ModelVersion", "InferenceServer"]
 
 class ModelVersion:
     """One servable (module, params, engine) bundle.  Immutable once
-    built; the server flips between versions by replacing one reference."""
+    built; the server flips between versions by replacing one reference.
+
+    ``mesh`` pins the forward engine to a fixed device subset instead of
+    the process-wide ``Engine.mesh()`` — the topology router
+    (serve/router.py) places each replica's versions on its own disjoint
+    subset this way."""
 
     def __init__(self, vid: int, module: Module, label: str,
-                 strategy=None):
+                 strategy=None, mesh=None):
         from ..optim.optimizer import _ShardedForward
         if module.params is None:
             module.build()
         self.id = int(vid)
         self.label = label
         self.module = module
-        self._engine = _ShardedForward(module, strategy)
+        self._engine = _ShardedForward(module, strategy, mesh=mesh)
 
     def predict(self, batch: np.ndarray) -> np.ndarray:
         """Forward one padded fixed-shape batch; returns host rows (the
@@ -144,7 +158,16 @@ class InferenceServer:
                  canary_min_batches: Optional[int] = None,
                  canary_window: Optional[int] = None,
                  canary_latency_ratio: Optional[float] = None,
-                 canary_error_margin: Optional[float] = None):
+                 canary_error_margin: Optional[float] = None,
+                 mesh=None,
+                 autoscale_min: Optional[int] = None,
+                 autoscale_max: Optional[int] = None,
+                 autoscale_target_wait_ms: Optional[float] = None,
+                 autoscale_idle_s: Optional[float] = None,
+                 autoscale_cooldown_s: Optional[float] = None,
+                 autoscale_up_polls: Optional[int] = None,
+                 autoscale_step: Optional[int] = None,
+                 autoscale_poll_s: Optional[float] = None):
         self.max_batch = int(max_batch if max_batch is not None
                              else config.get_int("SERVE_MAX_BATCH", 8))
         wait_ms = (max_wait_ms if max_wait_ms is not None
@@ -157,14 +180,17 @@ class InferenceServer:
             deadline_ms if deadline_ms is not None
             else config.get_float("SERVE_DEADLINE_MS", 0.0))
         self._strategy = strategy
+        self._mesh = mesh                   # pinned device subset (router)
         self.batcher = DynamicBatcher(self.max_batch, wait_ms / 1000.0,
                                       self.queue_limit, buckets=buckets,
                                       clock=clock)
         self._example = None if example is None else np.asarray(example)
-        self._version = ModelVersion(1, model, "initial", strategy)
+        self._version = ModelVersion(1, model, "initial", strategy,
+                                     mesh=mesh)
         self._vid = 1                       # monotonic version ids
         self._lock = threading.Lock()       # stats + version flip (brief)
         self._swap_lock = threading.Lock()  # serialize concurrent swaps
+        self._scale_lock = threading.Lock()  # serialize pool resizes
         self._threads: list = []
         # replica lifecycle state (serve/control.ReplicaMonitor): idx ->
         # [thread, generation, last local heartbeat].  The generation is
@@ -208,6 +234,22 @@ class InferenceServer:
         self._quotas = (control.TenantQuotas(qps, burst=burst,
                                              clock=self.batcher.clock)
                         if qps > 0 else None)
+        # queue-driven autoscaling (serve/autoscale.py): _MAX > 0 arms a
+        # controller that grows/shrinks the worker pool between the
+        # bounds — scale-up reuses this version's already-warm engine
+        # (zero compiles), shrink retires the highest replica slots
+        from . import autoscale as autoscale_mod
+        self._autoscale_cfg = autoscale_mod.autoscale_knobs(
+            self.replicas,
+            {"min_replicas": autoscale_min, "max_replicas": autoscale_max,
+             "target_wait_ms": autoscale_target_wait_ms,
+             "idle_s": autoscale_idle_s, "cooldown_s": autoscale_cooldown_s,
+             "up_polls": autoscale_up_polls, "step": autoscale_step,
+             "poll_s": autoscale_poll_s})
+        self._autoscaler: Optional[autoscale_mod.AutoScaler] = None
+        # offered-traffic trace capture (serve/tracefile.py), armed by
+        # record_trace() / the HTTP X-BigDL-Record-Trace header
+        self._recorder = None
         # supervision: an embedder-owned Supervisor, or our own from the
         # SERVE_STALL_SECONDS knob — each replica heartbeats a channel
         # under phase 'serve' so a wedged one trips a stall+crash report
@@ -238,6 +280,16 @@ class InferenceServer:
             self._monitor = control.ReplicaMonitor(
                 self, self._replica_lost, budget=self._restart_budget,
                 backoff=self._restart_backoff).start()
+        if self._autoscale_cfg["max_replicas"] > 0:
+            from . import autoscale as autoscale_mod
+            cfg = dict(self._autoscale_cfg)
+            cfg["min_replicas"] = min(cfg["min_replicas"], self.replicas)
+            cfg["max_replicas"] = max(cfg["max_replicas"],
+                                      cfg["min_replicas"])
+            poll = cfg.pop("poll_s")
+            self._autoscaler = autoscale_mod.AutoScaler(
+                self, poll_s=poll, clock=self.batcher.clock,
+                **cfg).start()
         logger.info("serve: started %d replica(s), max_batch=%d, "
                     "buckets=%s, queue_limit=%d%s", self.replicas,
                     self.max_batch, self.batcher.buckets, self.queue_limit,
@@ -253,9 +305,17 @@ class InferenceServer:
         pool, a drain the workers never finished — fails with a typed
         ServerClosed instead of leaving callers blocked on ``result()``
         forever."""
+        if self._autoscaler is not None:
+            # the controller must not resize a pool that is shutting down
+            self._autoscaler.stop()
         if self._monitor is not None:
             # the monitor must not respawn replicas into a shutdown
             self._monitor.stop()
+        if self._recorder is not None and self._recorder.path:
+            try:  # flush an armed trace so recordings survive shutdown
+                self._recorder.save()
+            except Exception:  # noqa: BLE001 — recording is best-effort
+                logger.exception("serve: trace flush failed at shutdown")
         # with no LIVE workers there is nobody to drain the queue —
         # draining would strand queued requests' result() forever
         self.batcher.close(
@@ -310,10 +370,15 @@ class InferenceServer:
             raise ServeError(
                 f"serve: sample shape {x.shape} does not match the "
                 f"server's example shape {self._example.shape}")
-        if self._quotas is not None:
-            self._quotas.admit(tenant)
         ms = (deadline_ms if deadline_ms is not None
               else self.default_deadline_ms)
+        if self._recorder is not None:
+            # record OFFERED traffic (shed requests included — they are
+            # real load), after shape validation so the trace replays
+            self._recorder.note(x, tenant=tenant, priority=priority,
+                                deadline_ms=ms if ms and ms > 0 else None)
+        if self._quotas is not None:
+            self._quotas.admit(tenant)
         deadline = (self.batcher.clock() + ms / 1000.0) if ms and ms > 0 \
             else None
         return self.batcher.submit(x, deadline, tenant=tenant,
@@ -357,13 +422,15 @@ class InferenceServer:
         the whole ladder is cache reads (zero fresh lowers), so restart
         is seconds, not a cold compile.  Runs on the monitor thread; the
         old engine keeps answering until the flip."""
-        if self.batcher.closed:
+        if self.batcher.closed or idx >= self.replicas:
+            # retired by a pool shrink: the monitor must not heal a slot
+            # the autoscaler deliberately emptied
             return
         with self._lock:
             old = self._version
         try:
             version = ModelVersion(old.id, old.module, old.label,
-                                   self._strategy)
+                                   self._strategy, mesh=self._mesh)
             if self._example is not None:
                 self._warm_version(version, self._example)
             with self._lock:
@@ -380,6 +447,85 @@ class InferenceServer:
                           replica=idx)
         logger.info("serve: replica %d restarted (bucket ladder "
                     "re-warmed)", idx)
+
+    # -- elastic pool size (serve/autoscale.AutoScaler hooks) -----------
+
+    def scale_to(self, n: int) -> int:
+        """Resize the worker pool to ``n`` replicas (the autoscaler's
+        actuator; also a manual operation).
+
+        Growth spawns worker threads through the same path start() uses
+        — they drain the shared queue through the CURRENT version's
+        already-warm engine, so scale-up performs zero compiles and zero
+        fresh lowers (the ladder was warmed at start/swap; with the AOT
+        cache armed even THAT was cache reads — ``stats()["aot"]``).
+        Shrink condemns the HIGHEST replica slots (generation bump): a
+        condemned worker parked on the empty queue exits at its next
+        wait slice, one holding a collected batch requeues it first —
+        zero accepted-request loss — and the ReplicaMonitor skips
+        retired slots so a scale-down is never "healed" back."""
+        n = max(int(n), 1)
+        with self._scale_lock:
+            if self.batcher.closed:
+                return self.replicas
+            cur = self.replicas
+            if n == cur:
+                return cur
+            if n > cur:
+                for idx in range(cur, n):
+                    self._spawn_replica(idx)
+            else:
+                for idx in range(n, cur):
+                    self._condemn_replica(idx)
+                # wake parked workers so condemned ones notice promptly
+                with self.batcher._cond:
+                    self.batcher._cond.notify_all()
+            self.replicas = n
+        logger.info("serve: pool scaled %d -> %d replica(s)", cur, n)
+        return n
+
+    def autoscale_signals(self) -> dict:
+        """The controller's inputs (serve/autoscale.py): queued rows,
+        EMA service seconds/row, cumulative served batches, and live
+        worker count — all signals the server already maintained."""
+        with self._lock:
+            batches = self._stats["batches"]
+        live = sum(1 for idx, st in self._replica.items()
+                   if idx < self.replicas and st[0] is not None
+                   and st[0].is_alive())
+        return {"depth": self.batcher.depth(),
+                "row_s_ema": self.batcher.service_row_seconds(),
+                "batches": batches, "live": live}
+
+    # -- traffic trace capture (serve/tracefile.py) ---------------------
+
+    def record_trace(self, path: Optional[str] = None, *,
+                     limit: Optional[int] = None):
+        """Arm offered-traffic recording (idempotent for the same path).
+        Every subsequent ``submit()`` — shed or served — is captured as
+        a trace event; ``stop_trace()`` (or server stop, when a path is
+        armed) writes the recordio trace file.  Returns the recorder."""
+        from .tracefile import TraceRecorder
+        if self._recorder is not None and (path is None or
+                                           self._recorder.path == path):
+            return self._recorder
+        self._recorder = TraceRecorder(clock=self.batcher.clock,
+                                       limit=limit, path=path)
+        logger.info("serve: trace recording armed%s",
+                    f" -> {path}" if path else " (in-memory)")
+        return self._recorder
+
+    def stop_trace(self, path: Optional[str] = None):
+        """Disarm recording; write the trace when a path is armed (or
+        given) and return the captured events."""
+        rec, self._recorder = self._recorder, None
+        if rec is None:
+            return []
+        if path or rec.path:
+            n = rec.save(path)
+            logger.info("serve: trace recording stopped — %d event(s) "
+                        "-> %s", n, path or rec.path)
+        return rec.events()
 
     def _mark_unhealthy(self, err: Exception) -> None:
         """The restart budget is exhausted: stop self-healing, surface it.
@@ -427,7 +573,12 @@ class InferenceServer:
                     if st[1] != gen:
                         return  # condemned: a newer incarnation owns idx
                     beat()
-                    reqs = self.batcher.collect(heartbeat=beat)
+                    # stop_when: a pool shrink condemns this slot while
+                    # the worker is parked on an EMPTY queue — it must
+                    # exit at the next wait slice, not linger until the
+                    # next request arrives just to requeue it
+                    reqs = self.batcher.collect(
+                        heartbeat=beat, stop_when=lambda: st[1] != gen)
                     if reqs is None:
                         return
                     if st[1] != gen:
@@ -626,7 +777,8 @@ class InferenceServer:
                 from ..quantize import quantize
                 module = quantize(module)
                 label += "+int8"
-            version = ModelVersion(vid, module, label, self._strategy)
+            version = ModelVersion(vid, module, label, self._strategy,
+                                   mesh=self._mesh)
             if self._example is not None:
                 self._warm_version(version, self._example)
             if canary_fraction is not None:
@@ -700,7 +852,15 @@ class InferenceServer:
         out["batch_fill"] = (round(out["batch_rows"] /
                                    max(out["bucket_rows"], 1), 4))
         out["replicas"] = self.replicas
+        out["replicas_live"] = sum(
+            1 for idx, st in self._replica.items()
+            if idx < self.replicas and st[0] is not None
+            and st[0].is_alive())
         out["healthy"] = self.healthy()
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.stats()
+        if self._recorder is not None:
+            out["trace_recording"] = self._recorder.stats()
         if self._unhealthy is not None:
             out["unhealthy_reason"] = str(self._unhealthy)
             out["unhealthy_type"] = type(self._unhealthy).__name__

@@ -499,8 +499,18 @@ def phase_breakdown(merged: dict) -> dict:
     # anything?" is a first-class report section, not a Perfetto hunt
     aot = {series[len("aot."):]: int(st["last"])
            for series, st in counters.items() if series.startswith("aot.")}
+    # the serving autoscaler's track, promoted the same way: its LAST
+    # replicas sample is the pool's final size and the serve.autoscale
+    # instant count is how many scale decisions fired — "did the pool
+    # actually track the load?" becomes a report line, not a Perfetto
+    # hunt (serve/autoscale.py)
+    autoscale = {series[len("serve.autoscale."):]: st["last"]
+                 for series, st in counters.items()
+                 if series.startswith("serve.autoscale.")}
+    if autoscale:
+        autoscale["decisions"] = instants.get("serve.autoscale", 0)
     return {"phases": phases, "ranks": ranks, "counters": counters,
-            "aot": aot,
+            "aot": aot, "autoscale": autoscale,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -551,6 +561,10 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
     if breakdown.get("aot"):
         lines.append("aot ledger: " + "  ".join(
             f"{k}={v}" for k, v in sorted(breakdown["aot"].items())))
+    if breakdown.get("autoscale"):
+        lines.append("autoscale: " + "  ".join(
+            f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(breakdown["autoscale"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

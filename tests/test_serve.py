@@ -539,8 +539,12 @@ def test_bench_serve_mode_record():
     for k in ("p50_ms", "p95_ms", "p99_ms"):
         assert closed[k] is not None
     assert 0.0 <= open_loop["shed_rate"] <= 1.0
+    # real failures get their OWN bucket (never lumped into shed) and
+    # the four buckets partition the offered load exactly
+    assert open_loop["errors"] == 0
     assert open_loop["served"] + open_loop["shed_overload"] + \
-        open_loop["shed_timeout"] == open_loop["offered"]
+        open_loop["shed_timeout"] + open_loop["errors"] == \
+        open_loop["offered"]
     # traffic storm: bursty load over three priority classes, shed rate
     # reported per class (the priority-aware-admission measurement)
     storm = rec["storm"]
@@ -548,9 +552,10 @@ def test_bench_serve_mode_record():
     assert storm["offered"] == sum(v["offered"] for v in
                                    storm["by_priority"].values())
     assert 0.0 <= storm["shed_rate"] <= 1.0
+    assert storm["errors"] == 0
     for v in storm["by_priority"].values():
         assert v["offered"] == (v["served"] + v["shed_overload"] +
-                                v["shed_timeout"])
+                                v["shed_timeout"] + v["errors"])
         assert 0.0 <= v["shed_rate"] <= 1.0
 
 

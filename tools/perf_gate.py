@@ -46,6 +46,11 @@ utils/hlostats.py):
    ``m*v`` are pinned, and the XLA temp budget of the 1F1B step over the
    GPipe step (batch 256, activations dominating) must stay <= 1 — a
    schedule memory regression fails the gate.
+7. **router dispatch overhead** (ISSUE 14): the serving topology
+   router's per-request (bucket, queue-depth) routing decision
+   (``TopologyRouter._pick``) over a 4-member pool, bounded in host
+   microseconds — the tax scale-out routing adds in front of every
+   request must stay negligible.
 
 ``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
 drift fails), ``max`` (time/ratio metrics — measured must stay <=
@@ -103,6 +108,12 @@ DEFAULT_RATIO_BOUNDS = {
         "note": "XLA temp budget of the compiled 1F1B step / GPipe step "
                 "at batch 256 (activations dominate) — the schedule "
                 "memory claim as a compiled-program invariant"},
+    "router.dispatch_us": {
+        "value": 100.0, "match": "max",
+        "note": "TopologyRouter._pick host microseconds per routing "
+                "decision over a 4-member pool (measured ~2-5us; the "
+                "bound caps the per-request tax topology routing adds "
+                "over the shared queue)"},
 }
 
 
@@ -394,6 +405,33 @@ def measure(batch_size=64):
     ep_card = hlostats.compile_card(compiled, lowered, label="moe.ep")
     measured["moe.all_to_all"] = ep_card.get("ops", {}).get("all-to-all", 0)
     context["expert"]["ep_collectives"] = ep_card.get("collectives")
+
+    # ---- proxy 7: router dispatch overhead (serve/router.py) ---------
+    # the (bucket, depth) routing decision is pure host work in front of
+    # EVERY request — bound its per-call cost over a 4-member pool so a
+    # quadratic-scan or lock-contention regression fails the gate before
+    # a real deployment measures it as tail latency
+    import bigdl_tpu.nn as nn_mod
+    from bigdl_tpu.serve import TopologyRouter
+    rmodel = nn_mod.Sequential().add(
+        nn_mod.Linear(8, 4)).build(jax.random.key(0))
+    n_members = min(4, jax.device_count())
+    router = TopologyRouter(rmodel, replicas=n_members,
+                            example=np.zeros((8,), np.float32))
+    # members constructed (queues + health live), never started: _pick
+    # reads exactly the state it reads under traffic, with no worker
+    # threads adding scheduler noise to the measurement
+    for i in range(n_members):
+        router._members[i] = router._build_member(i)
+    for _ in range(200):
+        router._pick()  # warm (allocator, attribute caches)
+    n_picks = 5000
+    t0_pick = time.perf_counter()
+    for _ in range(n_picks):
+        router._pick()
+    measured["router.dispatch_us"] = round(
+        (time.perf_counter() - t0_pick) / n_picks * 1e6, 3)
+    context["router"] = {"members": n_members, "picks": n_picks}
 
     # ---- proxy 6: 1F1B schedule card + memory ratio (ISSUE 13) -------
     from bigdl_tpu.parallel import build_schedule

@@ -67,7 +67,8 @@ def build_model(name: str):
 def make_handler(server):
     import numpy as np
 
-    from bigdl_tpu.serve import (RequestTimeout, ServeError, ServerClosed,
+    from bigdl_tpu.serve import (ReplicaLostError, RequestTimeout,
+                                 ServeError, ServerClosed,
                                  ServerOverloaded)
 
     class Handler(BaseHTTPRequestHandler):
@@ -86,10 +87,34 @@ def make_handler(server):
             self.end_headers()
             self.wfile.write(body)
 
+        @staticmethod
+        def _retry_after(seconds=None) -> dict:
+            """Retry-After header for every 503/429: the batcher's typed
+            drain estimate unless the error carried its own — the
+            orchestrator-facing backoff hint, not just on the 429 path."""
+            if seconds is None:
+                try:
+                    seconds = server.batcher.retry_after_s()
+                except AttributeError:  # router front end: no one queue
+                    seconds = 1.0
+            return {"Retry-After": str(max(1, int(seconds + 0.999)))}
+
         def _body(self):
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b"{}"
             return json.loads(raw.decode() or "{}")
+
+        def _arm_trace(self):
+            """`X-BigDL-Record-Trace: <path>` arms offered-traffic
+            recording (serve/tracefile.py) on the live server; `off`
+            stops it and writes the armed path."""
+            rt = self.headers.get("X-BigDL-Record-Trace")
+            if not rt:
+                return
+            if rt.strip().lower() in ("off", "stop", "0"):
+                server.stop_trace()
+            else:
+                server.record_trace(rt.strip())
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -99,7 +124,8 @@ def make_handler(server):
                         "ok": False,
                         "reason": st.get("unhealthy_reason"),
                         "type": st.get("unhealthy_type"),
-                        "version": server.version.id})
+                        "version": getattr(server.version, "id", None)},
+                        headers=self._retry_after())
                 self._reply(200, {"ok": True,
                                   "version": server.version.id})
             elif self.path == "/v1/stats":
@@ -119,6 +145,7 @@ def make_handler(server):
             self._reply(404, {"error": f"no route {self.path}"})
 
         def _predict(self, body):
+            self._arm_trace()
             if "inputs" not in body:
                 return self._reply(400, {"error": "missing 'inputs'"})
             x = np.asarray(body["inputs"], np.float32)
@@ -148,9 +175,18 @@ def make_handler(server):
             except RequestTimeout as e:
                 return self._reply(504, {"error": str(e),
                                          "type": "RequestTimeout"})
+            except ReplicaLostError as e:
+                # the unhealthy path (restart budget spent / no live
+                # replica): 503 WITH Retry-After, same as /healthz —
+                # the caller should back off while the orchestrator
+                # replaces the process
+                return self._reply(503, {"error": str(e),
+                                         "type": type(e).__name__},
+                                   headers=self._retry_after())
             except ServerClosed as e:
                 return self._reply(503, {"error": str(e),
-                                         "type": "ServerClosed"})
+                                         "type": "ServerClosed"},
+                                   headers=self._retry_after())
             except ServeError as e:
                 # remaining admission rejections (e.g. sample shape does
                 # not match the served model) are the client's fault
@@ -211,6 +247,21 @@ def main(argv=None):
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--queue-limit", type=int, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--routed", action="store_true",
+                    help="front a TopologyRouter (serve/router.py): "
+                         "replicas become mesh-sharded members on "
+                         "DISJOINT device subsets with per-replica "
+                         "queues and (bucket, depth) routing, instead "
+                         "of worker threads over one shared queue")
+    ap.add_argument("--router-layout", default="1,1,1",
+                    help="with --routed: per-member MeshLayout "
+                         "'data,fsdp,tp' (e.g. '1,1,2' = tp=2 members "
+                         "owning 2 devices each)")
+    ap.add_argument("--autoscale-max", type=int, default=None,
+                    help="pool ceiling; > 0 arms the queue-driven "
+                         "autoscaler (BIGDL_TPU_SERVE_AUTOSCALE_* tunes "
+                         "it) — decisions surface in /v1/stats under "
+                         "'autoscale'")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     args = ap.parse_args(argv)
@@ -222,15 +273,20 @@ def main(argv=None):
         except RuntimeError:
             pass
 
-    from bigdl_tpu.serve import InferenceServer
+    from bigdl_tpu.serve import InferenceServer, TopologyRouter
     from bigdl_tpu.utils.engine import Engine
 
     Engine.init()
     model, sample = build_model(args.model)
-    server = InferenceServer(
-        model, example=sample, replicas=args.replicas,
-        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        queue_limit=args.queue_limit, deadline_ms=args.deadline_ms)
+    kwargs = dict(example=sample, replicas=args.replicas,
+                  max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                  queue_limit=args.queue_limit,
+                  deadline_ms=args.deadline_ms,
+                  autoscale_max=args.autoscale_max)
+    if args.routed:
+        server = TopologyRouter(model, layout=args.router_layout, **kwargs)
+    else:
+        server = InferenceServer(model, **kwargs)
     server.start()
     if args.checkpoint:
         server.swap(args.checkpoint, quantized=args.quantized)

@@ -252,6 +252,23 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] pipeline smoke FAILED rc=$PIPE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # serving scale-out smoke (cpu only): a recorded mini-trace (tenants x
+  # priorities, CRC-framed recordio) replays at 10x open-loop against a
+  # fixed 1-replica pool and an autoscaled topology-routed pool — the
+  # autoscaler must grow then shrink the pool, attainment must be
+  # strictly higher than fixed, scale-up must be pure AOT cache reads
+  # (zero fresh lowers), and routed answers must bit-match bulk
+  # Predictor.predict; one JSON line, exit-coded
+  echo "[runbook] 2n/4 serving scale-out smoke (trace replay + autoscale + router)" >> "$LOG"
+  timeout 300 python tools/scale_smoke.py --platform cpu \
+    > /tmp/scale_smoke.json 2>/tmp/scale_smoke.log
+  SCALE_RC=$?
+  if [ "$SCALE_RC" = 0 ]; then
+    echo "[runbook] scale smoke OK (autoscaled > fixed attainment, zero fresh lowers) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] scale smoke FAILED rc=$SCALE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -280,7 +297,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
