@@ -290,6 +290,12 @@ class Optimizer:
         self.is_overwrite = True
         self.ckpt_keep_last = None
         self.ckpt_keep_every_epochs = None
+        # continuous deployment (serve/continuous.py): when armed, every
+        # publish_every-th checkpoint write also emits a release entry
+        self.publish_dir = None
+        self.publish_every = 1
+        self._publisher = None
+        self._publish_count = 0
         self._ckpt_keepers = set()
         self._kept_epoch_block = 0
         self.train_summary = None
@@ -376,7 +382,9 @@ class Optimizer:
                        is_overwrite: bool = True,
                        async_write: bool = False,
                        keep_last: Optional[int] = None,
-                       keep_every_epochs: Optional[int] = None):
+                       keep_every_epochs: Optional[int] = None,
+                       publish=None,
+                       publish_every: int = 1):
         """async_write=True snapshots to host synchronously but performs
         pickling + filesystem IO on a background thread
         (file_io.save_checkpoint_async) — the train loop does not stall
@@ -389,13 +397,27 @@ class Optimizer:
         the first snapshot of every N-th epoch as a permanent keeper
         (long-horizon rollback points).  None defers to the
         BIGDL_TPU_CKPT_KEEP_LAST / _CKPT_KEEP_EVERY_EPOCHS env knobs;
-        0 disables.  Quarantined ``.corrupt`` files are never pruned."""
+        0 disables.  Quarantined ``.corrupt`` files are never pruned.
+
+        Publication (continuous deployment, serve/continuous.py):
+        `publish=True` emits a CRC-framed *release entry* into the
+        checkpoint dir for every `publish_every`-th checkpoint write (a
+        string publishes into that directory instead) — the model feed a
+        :class:`~bigdl_tpu.serve.continuous.DeployController` on another
+        host watches, canaries, and promotes.  Only the writer rank
+        publishes; async snapshot writes publish from the write future's
+        completion so a release can never point at bytes that are not on
+        storage yet."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.is_overwrite = is_overwrite
         self.checkpoint_async = async_write
         self.ckpt_keep_last = keep_last
         self.ckpt_keep_every_epochs = keep_every_epochs
+        self.publish_dir = (path if publish is True
+                            else (publish or None))
+        self.publish_every = max(int(publish_every), 1)
+        self._publisher = None
         return self
 
     def set_train_summary(self, summary):
@@ -1905,7 +1927,7 @@ class Optimizer:
                 return fut
         else:
             writer = file_io.save_checkpoint
-        writer(
+        write_result = writer(
             self.checkpoint_path, neval,
             {"params": params, "state": net_state},
             {"method": self.optim_method.state_dict(),
@@ -1918,7 +1940,49 @@ class Optimizer:
                     "queued (async)" if is_async else "written",
                     neval, self.checkpoint_path,
                     " (preemption final snapshot)" if preempt else "")
+        if self.publish_dir:
+            self._maybe_publish(neval, state, write_result, is_async)
         self._apply_retention(neval, state)
+
+    def _maybe_publish(self, neval, state, write_result, is_async):
+        """Release-entry publication (serve/continuous.ReleasePublisher):
+        every `publish_every`-th checkpoint write becomes a release the
+        deploy controller can consume.  Callers are already past the
+        writer-rank gate.  Publication failures are logged, never raised
+        — the deploy side simply sees no new release; training goes on."""
+        self._publish_count += 1
+        if (self._publish_count - 1) % self.publish_every:
+            return
+        model_path = file_io._join(
+            file_io._strip_file_scheme(self.checkpoint_path),
+            f"model.{neval}")
+        info = {"neval": int(neval), "epoch": int(state.get("epoch", 0)),
+                "iteration": int(neval),
+                "metrics": {k: float(v) for k, v in state.items()
+                            if isinstance(v, (int, float))
+                            and not k.startswith("_")}}
+
+        def publish(fut=None):
+            if fut is not None and (fut.cancelled()
+                                    or fut.exception() is not None):
+                return  # a failed snapshot write must never be released
+            try:
+                if self._publisher is None:
+                    from ..serve.continuous import ReleasePublisher
+                    self._publisher = ReleasePublisher(self.publish_dir)
+                self._publisher.publish(model_path, **info)
+            except Exception:  # noqa: BLE001 — publication is downstream
+                # of training; its failure must not burn a retry
+                logger.exception("release publish for %s failed "
+                                 "(training continues; the deploy "
+                                 "controller sees no new release)",
+                                 model_path)
+        if is_async:
+            # the snapshot write is still in flight: publish only once
+            # its bytes (incl. the frame the fingerprint reads) are real
+            write_result.add_done_callback(publish)
+        else:
+            publish()
 
     def _apply_retention(self, neval, state):
         """Keep-last-K + keep-every-N-epochs pruning after each write

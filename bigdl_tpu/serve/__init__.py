@@ -19,7 +19,13 @@ pool between bounds with AOT-warm spawn, a `TopologyRouter`
 (serve/router.py) places mesh-sharded replicas on disjoint device
 subsets and routes by (bucket, per-replica queue depth), and recorded
 request traces (serve/tracefile.py) replay at 10-100x in `bench.py
---serve --replay` reporting per-tenant SLO attainment.  See
+--serve --replay` reporting per-tenant SLO attainment.  The continuous
+deployment layer (serve/continuous.py) closes the optimizer->canary
+loop: the trainer's checkpoint path publishes CRC-framed release
+entries and a `DeployController` watches the lineage, verifies each
+entry, canaries it into the live server and promotes or rolls back on
+the control plane's comparator — with a bounded consecutive-rollback
+budget and a full model-version timeline (docs/continuous.md).  See
 docs/serving.md.
 """
 
@@ -27,6 +33,8 @@ from .autoscale import AutoScaler
 from .batcher import (DynamicBatcher, PendingRequest, RequestTimeout,
                       ServeError, ServerClosed, ServerOverloaded,
                       default_buckets, pad_rows, predict_in_fixed_batches)
+from .continuous import (DeployController, ReleasePublisher,
+                         ReleaseRejected, read_release)
 from .control import (CanaryController, CanaryRejected, QuotaExceeded,
                       ReplicaLostError, ReplicaMonitor, TenantQuotas)
 from .router import PlacementError, TopologyRouter, plan_subsets
@@ -44,4 +52,6 @@ __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "AutoScaler", "TopologyRouter", "PlacementError",
            "plan_subsets", "TraceEvent", "TraceFormatError",
            "TraceRecorder", "read_trace", "write_trace", "replay",
-           "resolve_outcomes", "slo_report"]
+           "resolve_outcomes", "slo_report",
+           "DeployController", "ReleasePublisher", "ReleaseRejected",
+           "read_release"]

@@ -250,6 +250,9 @@ class InferenceServer:
         # offered-traffic trace capture (serve/tracefile.py), armed by
         # record_trace() / the HTTP X-BigDL-Record-Trace header
         self._recorder = None
+        # continuous-deployment controller (serve/continuous.py), set by
+        # DeployController.start() so its timeline rides stats()["deploy"]
+        self._deploy = None
         # supervision: an embedder-owned Supervisor, or our own from the
         # SERVE_STALL_SECONDS knob — each replica heartbeats a channel
         # under phase 'serve' so a wedged one trips a stall+crash report
@@ -526,6 +529,13 @@ class InferenceServer:
             logger.info("serve: trace recording stopped — %d event(s) "
                         "-> %s", n, path or rec.path)
         return rec.events()
+
+    def attach_deploy(self, controller) -> None:
+        """Register a continuous-deployment controller
+        (serve/continuous.DeployController) so its state surfaces in
+        ``stats()["deploy"]`` / ``/v1/stats`` and the HTTP front end can
+        serve ``/v1/versions`` from it."""
+        self._deploy = controller
 
     def _mark_unhealthy(self, err: Exception) -> None:
         """The restart budget is exhausted: stop self-healing, surface it.
@@ -859,6 +869,10 @@ class InferenceServer:
         out["healthy"] = self.healthy()
         if self._autoscaler is not None:
             out["autoscale"] = self._autoscaler.stats()
+        if self._deploy is not None:
+            # the deploy controller's healthy/frozen state + version
+            # timeline tail (serve/continuous.py; full list: /v1/versions)
+            out["deploy"] = self._deploy.stats()
         if self._recorder is not None:
             out["trace_recording"] = self._recorder.stats()
         if self._unhealthy is not None:

@@ -26,6 +26,7 @@ Fault points wired into the runtime:
 | ``serve.replica@<idx>`` | once per non-empty batch on replica `<idx>` (serve/server) | wedge/exit (thread-scoped) |
 | ``serve.canary`` | once per canary-routed batch (serve/server)  | fail/stall |
 | ``host.lost@<rank>`` | once per train iteration on rank `<rank>` (driver loop) | exit/wedge |
+| ``deploy.publish`` | once per release-entry write (serve/continuous) | corrupt   |
 
 Schedules (1-based counts):
 

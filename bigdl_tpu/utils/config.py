@@ -68,6 +68,10 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_ELASTIC_PEER_LOST | (net-new: elastic host-loss threshold, seconds of heartbeat-PUBLICATION silence promoting a peer to PeerLostError; parallel/elastic — 0 disarms elasticity) | 0 (off) |
 | BIGDL_TPU_ELASTIC_WORLD / _ELASTIC_RANK | (net-new: simulated-multi-host logical topology for the elastic drill harness; utils/engine.Engine.world/rank) | off |
 | BIGDL_TPU_ELASTIC_NEGOTIATE_TIMEOUT / _ELASTIC_NEGOTIATE_POLL | (net-new: seconds to wait for every survivor's lineage view / poll cadence during elastic negotiation) | 60 / 0.25 |
+| BIGDL_TPU_DEPLOY_CANARY_FRACTION | (net-new: continuous deployment, serve/continuous.py — canary batch fraction the DeployController routes to each new release; 0 = plain full swaps) | 0.25 |
+| BIGDL_TPU_DEPLOY_ROLLBACK_BUDGET | (net-new: consecutive canary rollbacks before the deploy controller freezes unhealthy instead of flapping) | 2 |
+| BIGDL_TPU_DEPLOY_POLL_S | (net-new: release-lineage poll cadence, seconds; the watch itself backs off on the IO knobs when polled without one) | 0.25 |
+| BIGDL_TPU_DEPLOY_DECISION_TIMEOUT | (net-new: seconds to wait a canary verdict out before freezing; 0 = wait forever) | 0 (off) |
 """
 
 from __future__ import annotations

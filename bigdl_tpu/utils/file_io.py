@@ -66,7 +66,8 @@ logger = logging.getLogger("bigdl_tpu")
 __all__ = ["save", "load", "verify", "save_checkpoint", "latest_checkpoint",
            "File", "register_filesystem", "get_filesystem",
            "CorruptCheckpoint", "checkpoint_lineage", "quarantine_checkpoint",
-           "prune_checkpoints", "RetryPolicy", "set_retry_timebase"]
+           "prune_checkpoints", "RetryPolicy", "set_retry_timebase",
+           "watch_lineage", "frame_fingerprint"]
 
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 
@@ -620,6 +621,108 @@ def latest_checkpoint(path: str) -> Optional[Tuple[str, str, int]]:
     (getLatestFile, DistriOptimizer.scala:828-845)."""
     lineage = checkpoint_lineage(path)
     return lineage[0] if lineage else None
+
+
+def frame_fingerprint(path: str) -> Optional[Tuple[int, int]]:
+    """Read one framed blob's ``(payload_length, masked_crc32c)`` from its
+    integrity footer WITHOUT reading (or verifying) the payload; None for
+    legacy unframed files.  The continuous-deployment publisher
+    (serve/continuous.py) records this pair in every release entry and the
+    deploy controller compares it against the snapshot it is about to
+    serve — a snapshot rewritten after publication (elastic recovery
+    re-training over the same nevals) no longer matches and the release is
+    rejected typed instead of served."""
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    if isinstance(fs, LocalFileSystem):
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < _FOOTER_LEN:
+                return None
+            f.seek(size - _FOOTER_LEN)
+            tail = f.read(_FOOTER_LEN)
+    else:
+        data = fs.read_bytes(path)
+        if len(data) < _FOOTER_LEN:
+            return None
+        tail = data[-_FOOTER_LEN:]
+    if tail[-len(_FRAME_MAGIC):] != _FRAME_MAGIC:
+        return None
+    length, crc = _FOOTER.unpack(tail[:_FOOTER.size])
+    return int(length), int(crc)
+
+
+def watch_lineage(path: str, since: int = -1, *,
+                  pattern: str = r"model\.(\d+)",
+                  poll: Optional[float] = None,
+                  clock=None, sleep=None, stop=None,
+                  idle_timeout: Optional[float] = None):
+    """Scheme-agnostic lineage watch: a generator yielding ``(n, path)``
+    for every file under `path` whose NAME fullmatches `pattern` (group 1
+    = the monotonic integer id), in id order, ids > `since` only — the
+    poll loop the deployment controller (serve/continuous.py) runs so it
+    contains zero ad-hoc IO code, usable against any file_io scheme
+    (local, ``memory://``, fsspec remotes; remote listdirs already run
+    under the retry/backoff layer).
+
+    Quarantined (``*.corrupt``) and half-written (``*.tmp``) files never
+    fullmatch the pattern, so the watch can never hand out an entry the
+    writer or a previous consumer has disowned; each id is yielded at
+    most once per generator (a file quarantined AFTER being yielded is
+    simply never seen again).
+
+    Pacing: ``poll`` fixes the idle delay; None backs off exponentially
+    from the ``BIGDL_TPU_IO_BACKOFF_BASE`` knob up to
+    ``_IO_BACKOFF_MAX`` with the RetryPolicy's deterministic jitter,
+    resetting whenever something new appears.  `clock`/`sleep` are
+    injectable (tests run wall-clock-free); `stop` is a callable checked
+    every turn (and between yields) to end the generator; `idle_timeout`
+    ends it after that many seconds without a new entry."""
+    path = _strip_file_scheme(path)
+    matcher = re.compile(pattern)
+    clk = clock or _TIMEBASE["clock"]
+    slp = sleep or _TIMEBASE["sleep"]
+    policy = RetryPolicy(clock=clk, sleep=slp)
+    last = int(since)
+    idle_since = None
+    attempt = 0
+    while True:
+        if stop is not None and stop():
+            return
+        fs = get_filesystem(path)
+        try:
+            names = fs.listdir(path) if fs.isdir(path) else []
+        except Exception as e:  # noqa: BLE001 — a transient listing
+            # failure must not kill the watch (remote ops are already
+            # retried below this; a dir that does not exist YET is the
+            # normal trainer-not-started case)
+            logger.warning("watch_lineage(%s): listing failed (%s: %s); "
+                           "treating as empty this poll", path,
+                           type(e).__name__, e)
+            names = []
+        found = {}
+        for name in names:
+            m = matcher.fullmatch(name)
+            if m:
+                found[int(m.group(1))] = name
+        fresh = sorted(n for n in found if n > last)
+        if fresh:
+            attempt = 0
+            idle_since = None
+            for n in fresh:
+                last = n
+                yield n, _join(path, found[n])
+                if stop is not None and stop():
+                    return
+            continue
+        now = clk()
+        if idle_since is None:
+            idle_since = now
+        if idle_timeout is not None and now - idle_since >= idle_timeout:
+            return
+        attempt = min(attempt + 1, 12)  # cap the exponent, not the wait
+        slp(poll if poll is not None else policy.delay(attempt))
 
 
 def quarantine_checkpoint(model_path: str,

@@ -509,8 +509,19 @@ def phase_breakdown(merged: dict) -> dict:
                  if series.startswith("serve.autoscale.")}
     if autoscale:
         autoscale["decisions"] = instants.get("serve.autoscale", 0)
+    # the continuous-deployment track, promoted the same way: the
+    # trainer's publishes and the controller's deploy/promote/rollback
+    # counts share the one `deploy` track, so a merged trainer+server
+    # trace answers "did every good release reach traffic?" as a report
+    # line (serve/continuous.py) — last values are cumulative totals
+    deploy = {series[len("deploy."):]: st["last"]
+              for series, st in counters.items()
+              if series.startswith("deploy.")}
+    if deploy:
+        deploy["events"] = sum(v for k, v in instants.items()
+                               if k.startswith("deploy."))
     return {"phases": phases, "ranks": ranks, "counters": counters,
-            "aot": aot, "autoscale": autoscale,
+            "aot": aot, "autoscale": autoscale, "deploy": deploy,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -565,6 +576,10 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
         lines.append("autoscale: " + "  ".join(
             f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
             for k, v in sorted(breakdown["autoscale"].items())))
+    if breakdown.get("deploy"):
+        lines.append("deploy: " + "  ".join(
+            f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(breakdown["deploy"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

@@ -12,7 +12,15 @@ pool batches across connections).  Endpoints:
     POST /v1/swap      {"source": "<ckpt dir | snapshot | module file>",
                         "quantized": false, "canary_fraction": 0.1}
                        -> {"version": N}
-    GET  /v1/stats     -> server.stats()
+    GET  /v1/stats     -> server.stats() (with --watch this includes the
+                          deploy controller's healthy/frozen state under
+                          "deploy")
+    GET  /v1/versions  -> the continuous-deployment model-version
+                          timeline (release id, action, timestamp,
+                          canary verdict per entry) + the controller's
+                          healthy/frozen state (serve/continuous.py);
+                          {"deploy": false, ...} when no controller is
+                          attached
     GET  /healthz      -> {"ok": true, "version": N} — or 503
                           {"ok": false, "reason": ...} once the replica
                           restart budget is exhausted (the orchestrator's
@@ -130,6 +138,16 @@ def make_handler(server):
                                   "version": server.version.id})
             elif self.path == "/v1/stats":
                 self._reply(200, server.stats())
+            elif self.path == "/v1/versions":
+                ctl = getattr(server, "_deploy", None)
+                if ctl is None:
+                    return self._reply(200, {
+                        "deploy": False, "timeline": [],
+                        "version": server.version.id})
+                out = ctl.versions()
+                out["deploy"] = True
+                out["version"] = server.version.id
+                self._reply(200, out)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -262,6 +280,19 @@ def main(argv=None):
                          "autoscaler (BIGDL_TPU_SERVE_AUTOSCALE_* tunes "
                          "it) — decisions surface in /v1/stats under "
                          "'autoscale'")
+    ap.add_argument("--watch", default=None, metavar="LINEAGE_DIR",
+                    help="continuous deployment (serve/continuous.py): "
+                         "watch this release lineage dir and canary "
+                         "every verified new release into the live "
+                         "server; timeline on /v1/versions, controller "
+                         "health in /v1/stats under 'deploy'")
+    ap.add_argument("--canary-fraction", type=float, default=None,
+                    help="with --watch: canary batch fraction per "
+                         "release (BIGDL_TPU_DEPLOY_CANARY_FRACTION; "
+                         "0 = plain full swaps)")
+    ap.add_argument("--rollback-budget", type=int, default=None,
+                    help="with --watch: consecutive canary rollbacks "
+                         "before the controller freezes")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     args = ap.parse_args(argv)
@@ -290,10 +321,17 @@ def main(argv=None):
     server.start()
     if args.checkpoint:
         server.swap(args.checkpoint, quantized=args.quantized)
+    controller = None
+    if args.watch:
+        from bigdl_tpu.serve.continuous import DeployController
+        controller = DeployController(
+            server, args.watch, canary_fraction=args.canary_fraction,
+            rollback_budget=args.rollback_budget).start()
     httpd = serve_forever(server, args.host, args.port)
     print(json.dumps({"serving": f"http://{args.host}:{args.port}",
                       "model": args.model,
                       "version": server.version.id,
+                      "watching": args.watch,
                       "stats": "/v1/stats"}), flush=True)
     try:
         threading.Event().wait()
@@ -301,6 +339,8 @@ def main(argv=None):
         pass
     finally:
         httpd.shutdown()
+        if controller is not None:
+            controller.stop()
         server.stop()
     return 0
 

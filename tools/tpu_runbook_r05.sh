@@ -269,6 +269,24 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] scale smoke FAILED rc=$SCALE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # continuous train->serve smoke (cpu only): two elastic trainer ranks
+  # (rank 1 killed mid-train by chaos) publish release entries into a
+  # lineage dir a live server+DeployController in another process
+  # watches — the corrupt mid-publish entry must be quarantined and
+  # skipped typed, the host loss must never interrupt the release feed,
+  # the latency-inflated canary must auto-roll back exactly once, the
+  # LAST release must promote and the served model must bit-match its
+  # snapshot with zero dropped requests; one JSON line, exit-coded
+  echo "[runbook] 2o/4 continuous train->serve smoke (publish -> watch -> canary -> promote)" >> "$LOG"
+  timeout 300 python tools/continuous_smoke.py --platform cpu \
+    > /tmp/continuous_smoke.json 2>/tmp/continuous_smoke.log
+  CONT_RC=$?
+  if [ "$CONT_RC" = 0 ]; then
+    echo "[runbook] continuous smoke OK (corrupt skip + recovery feed + canary rollback + bit-match, zero drops) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] continuous smoke FAILED rc=$CONT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -297,7 +315,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi

@@ -11,7 +11,12 @@ ranks (one slow host's ``step`` spans stand out against the median),
 counter-track series in deterministic (sorted) order — including the
 ``compile`` track compile cards emit (utils/hlostats.py) — and, when the
 ``aot`` track is present, the AOT warm-start ledger
-(hits/misses/stores/lowers/compiles) as its own section.
+(hits/misses/stores/lowers/compiles) as its own section.  The serving
+autoscaler's track and the continuous-deployment ``deploy`` track
+(publishes from the trainer rank, deploy/promote/rollback/reject totals
+from the controller — serve/continuous.py) are promoted to their own
+sections the same way, so a merged trainer+server trace shows training
+steps, publishes, and promotions on one timeline.
 
 Usage::
 
