@@ -115,6 +115,19 @@ class NonFiniteLossError(RuntimeError):
     garbage for the rest of the run."""
 
 
+class _ElasticJoinSignal(Exception):
+    """Internal control flow, never user-facing: a checkpoint boundary
+    observed pending ``elastic/join.<rank>`` intents (returning hosts,
+    parallel/elastic step 4).  Raised out of the train loop so the retry
+    loop can run the grow re-form from its own frame — like
+    PeerLostError, but a PLANNED event: it consumes no retry budget."""
+
+    def __init__(self, joiners):
+        self.joiners = tuple(int(r) for r in joiners)
+        super().__init__(f"returning host(s) {list(self.joiners)} "
+                         "announced at this checkpoint boundary")
+
+
 def _any_deleted(tree) -> bool:
     """True if any jax.Array leaf was donated to a compiled call (deleted)."""
     return any(getattr(leaf, "is_deleted", lambda: False)()
@@ -296,6 +309,10 @@ class Optimizer:
         self.publish_every = 1
         self._publisher = None
         self._publish_count = 0
+        # elastic re-form audit trail: one entry per shrink/grow/join
+        # ({"kind", "neval", "epoch", "world", "batch"}) — the drills'
+        # world/batch-trajectory assertions read this
+        self._elastic_history: List[dict] = []
         self._ckpt_keepers = set()
         self._kept_epoch_block = 0
         self.train_summary = None
@@ -1071,6 +1088,11 @@ class Optimizer:
         # watchdog must stay alive)
         self._sup = self._build_supervisor()
         if self._sup is not None:
+            if elastic_mod.join_armed():
+                # a JOINER stays publication-silent until announce_join
+                # has cleaned its previous life's files and bumped the
+                # heartbeat generation (_elastic_join resumes it)
+                self._sup.suspend_heartbeat()
             self._sup.beat("data")  # arm the timeline before the thread
             self._sup.start()
             supervision.set_active(self._sup)
@@ -1107,6 +1129,11 @@ class Optimizer:
 
     def _optimize_with_retry(self, retries, max_retries, window,
                              last_failure) -> Module:
+        if elastic_mod.join_armed() and self.checkpoint_path is not None:
+            # JOINER path (parallel/elastic step 4): announce, get
+            # admitted, adopt the cluster's agreed snapshot and re-form
+            # into the widened world BEFORE the first training attempt
+            self._elastic_join()
         while True:
             try:
                 try:
@@ -1134,6 +1161,16 @@ class Optimizer:
                     "(retry %d/%d): negotiate restore point, re-form over "
                     "the surviving slice, resume", retries, max_retries)
                 self._elastic_recover(e)
+            except _ElasticJoinSignal as e:
+                # a PLANNED boundary event (returning host admitted), not
+                # a failure: grow consumes no retry budget — the agreed
+                # snapshot is the one this boundary just wrote
+                logger.warning(
+                    "returning host(s) %s announced: elastic grow at this "
+                    "checkpoint boundary (negotiate join snapshot, widen "
+                    "the data axis, rescale the batch back down)",
+                    list(e.joiners))
+                self._elastic_grow(e.joiners)
             except Exception:
                 now = time.monotonic()
                 # reference: the retry counter resets once failures are
@@ -1358,11 +1395,208 @@ class Optimizer:
                                  epoch=plan.epoch, lost=lost)
             telemetry.instant("elastic.resume", cat="elastic",
                               neval=plan.neval, world=len(survivors))
+            telemetry.counter("peers", joined=len(survivors))
             self._elastic_plan = plan  # introspection (tools/tests)
+            self._note_elastic_event("shrink", plan, len(survivors))
             logger.warning(
                 "elastic: recovery round %d complete — resumed from "
                 "snapshot %d on world %d (lost %s)", plan.epoch,
                 plan.neval, len(survivors), lost)
+
+    def _note_elastic_event(self, kind: str, plan, world: int) -> None:
+        """One audit-trail entry per re-form — the drills assert the
+        world/batch trajectory (e.g. 2 -> 1 -> 2, 16 -> 32 -> 16) from
+        this instead of scraping logs."""
+        batchers = self._find_batchers(self.dataset)
+        self._elastic_history.append({
+            "kind": kind, "neval": int(plan.neval),
+            "epoch": int(plan.epoch), "world": int(world),
+            "batch": int(batchers[0].batch_size) if batchers else None})
+
+    def _check_join(self, state) -> None:
+        """Checkpoint-boundary grow gate (parallel/elastic step 4): when
+        a returning rank has published an ``elastic/join.<rank>`` intent,
+        raise the internal join signal so the retry loop runs
+        :meth:`_elastic_grow` from its own frame — anchored at THIS
+        boundary, whose just-written snapshot becomes the joiner's
+        adoption point.  Every survivor evaluates the same checkpoint
+        trigger on the same driver state, so they all reach this gate at
+        the same boundary.  While a SHRINK promotion is still pending the
+        join is DEFERRED (not dropped) to a later boundary: re-forms
+        never interleave."""
+        if not elastic_mod.armed() or self.checkpoint_path is None:
+            return
+        intents = elastic_mod.read_join_intents(self.checkpoint_path,
+                                                exclude_rank=Engine.rank())
+        fresh = sorted(r for r in intents if r not in Engine.survivors())
+        if not fresh:
+            return
+        if self._sup is not None and self._sup.peer_lost_pending():
+            logger.warning(
+                "elastic: join intent from rank(s) %s observed during an "
+                "in-flight shrink round — deferred to the next checkpoint "
+                "boundary (re-forms never interleave)", fresh)
+            return
+        raise _ElasticJoinSignal(fresh)
+
+    def _elastic_grow(self, joiners) -> None:
+        """The survivor side of scale-UP (parallel/elastic step 4),
+        mirroring :meth:`_elastic_recover` with the sign flipped: the
+        writer publishes the admission offer (the widened survivor set +
+        round), every survivor runs the SAME negotiation round the
+        joiner runs, the topology re-forms over the widened set (the
+        data axis grows, ZeRO/FSDP state remaps 1/N -> 1/N'), and the
+        per-host batch rescales back DOWN so the global batch returns to
+        its configured value.  The joiner adopts the agreed snapshot —
+        never the reverse — so every party resumes bit-identically."""
+        old_world = Engine.world()
+        rank = Engine.rank()
+        prev = Engine.survivors()
+        was_writer = Engine.is_writer()
+        joiners = sorted(int(r) for r in joiners if int(r) not in prev)
+        if not joiners:
+            return
+        survivors = sorted(set(prev) | set(joiners))
+        epoch = (self._sup.elastic_epoch + 1
+                 if self._sup is not None else 1)
+        if self._sup is not None:
+            self._sup.beat("checkpoint")
+            # symmetric with the joiner's hold: negotiate/reform can
+            # stall heartbeats long enough to read as a peer loss —
+            # sup.reform() at the end of this round re-arms promotion
+            self._sup.hold_elastic()
+        with telemetry.span("elastic.join", cat="elastic",
+                            joiners=joiners, epoch=epoch):
+            # the boundary snapshot must be durable before anyone
+            # negotiates over it
+            self._drain_ckpt_futures(context="elastic grow")
+            if was_writer:
+                elastic_mod.publish_grow_offer(
+                    self.checkpoint_path, rank, epoch, survivors,
+                    time.time())
+            plan = elastic_mod.negotiate(
+                self.checkpoint_path, rank=rank, survivors=survivors,
+                epoch=epoch, timeout=elastic_mod.join_timeout_seconds())
+            # a joiner that announced but went silent is dropped by the
+            # negotiation timeout: re-form over the responders only
+            new_world = len(plan.survivors)
+            with telemetry.span("elastic.reform", cat="elastic",
+                                old_world=old_world, new_world=new_world):
+                self._load_snapshot(plan.model_path, plan.optim_path)
+                Engine.reform(rank=rank, survivors=plan.survivors)
+                # the compiled step and forward bake the old mesh and
+                # shardings (ZeRO 1/N slices): tear down, rebuild lazily
+                # (an armed AOT cache makes the recompile a cache read)
+                self._compiled = None
+                self._forward_fn = None
+                self._rescale_batches(old_world, new_world)
+            if self._sup is not None:
+                self._sup.reform(rank=rank, world=new_world,
+                                 epoch=plan.epoch,
+                                 returned=[r for r in joiners
+                                           if r in plan.survivors])
+            if was_writer:
+                for r in joiners:
+                    elastic_mod.clear_join_intent(self.checkpoint_path, r)
+            telemetry.instant("elastic.resume", cat="elastic",
+                              neval=plan.neval, world=new_world)
+            telemetry.counter("peers", joined=new_world)
+            self._elastic_plan = plan
+            self._note_elastic_event("grow", plan, new_world)
+            logger.warning(
+                "elastic: grow round %d complete — world %d -> %d at "
+                "snapshot %d (admitted %s)", plan.epoch, old_world,
+                new_world, plan.neval,
+                [r for r in joiners if r in plan.survivors])
+
+    def _elastic_join(self) -> None:
+        """The JOINER side of scale-UP, run BEFORE the first training
+        attempt: gate the announcement (the chaos ``host.return@<rank>``
+        drill point — the loop publishes the CLUSTER position read from
+        the newest snapshot so ``@epoch:iteration`` addresses work, and
+        announces immediately when no gate is armed), clean the previous
+        life's files and bump the heartbeat generation
+        (elastic.announce_join), wait for the survivors' admission
+        offer, run the SAME negotiation round they run, adopt the agreed
+        snapshot, and re-form into the widened world.  Raises the typed
+        ElasticJoinError when no survivor answers."""
+        rank = Engine.rank()
+        ckpt = self.checkpoint_path
+        point = f"host.return@{rank}"
+        poll = elastic_mod.join_poll_seconds()
+        timeout = elastic_mod.join_timeout_seconds()
+        beat = (self._sup.beat if self._sup is not None
+                else (lambda *_a: None))
+        if self._sup is not None:
+            # not a member yet: the joiner must never promote a slow
+            # survivor heartbeat into a shrink of a cluster it is only
+            # observing — sup.reform() below re-arms promotion
+            self._sup.hold_elastic()
+        with telemetry.span("elastic.join", cat="elastic", rank=rank):
+            gate_armed = chaos.armed(point)
+            # a RETURNING rank (previous life's heartbeat on record) must
+            # hold its announcement until a recovery round has declared
+            # it lost — see elastic.death_certificate
+            returning = elastic_mod.previous_generation(ckpt, rank) \
+                is not None
+            floor = elastic_mod.latest_grow_epoch(ckpt)
+            deadline = time.monotonic() + timeout
+            gated = certified = False
+            while True:
+                beat("checkpoint")
+                if gate_armed and not gated:
+                    pos = elastic_mod.cluster_position(ckpt)
+                    if pos is not None:
+                        chaos.at_position(*pos)
+                    gated = chaos.gate(point)
+                if not certified:
+                    certified = (not returning) or \
+                        elastic_mod.death_certificate(
+                            ckpt, rank, floor=floor) > 0
+                if (gated or not gate_armed) and certified:
+                    break
+                if time.monotonic() >= deadline:
+                    logger.warning(
+                        "elastic: join hold (gate fired=%s, death "
+                        "certificate=%s) unresolved within %.0fs — "
+                        "announcing anyway", gated, certified, timeout)
+                    break
+                time.sleep(poll)
+            info = elastic_mod.announce_join(ckpt, rank, time.time())
+            if self._sup is not None:
+                # the announcement wrote the generation-stamped heartbeat;
+                # every publish from here on must carry that generation
+                self._sup.generation = int(info["generation"])
+                self._sup.resume_heartbeat()
+            beat("checkpoint")
+            offer = elastic_mod.wait_for_admission(ckpt, rank,
+                                                   floor=info["floor"])
+            old_world = Engine.world()
+            survivors = [int(r) for r in offer["survivors"]]
+            plan = elastic_mod.negotiate(ckpt, rank=rank,
+                                         survivors=survivors,
+                                         epoch=int(offer["epoch"]),
+                                         timeout=timeout)
+            new_world = len(plan.survivors)
+            with telemetry.span("elastic.reform", cat="elastic",
+                                old_world=old_world, new_world=new_world):
+                self._load_snapshot(plan.model_path, plan.optim_path)
+                Engine.reform(rank=rank, survivors=plan.survivors)
+                self._compiled = None
+                self._forward_fn = None
+                # no batch rescale: the joiner is configured at the
+                # TARGET per-host batch for the widened world already
+            if self._sup is not None:
+                self._sup.reform(rank=rank, world=new_world,
+                                 epoch=plan.epoch, returned=(rank,))
+            telemetry.instant("elastic.resume", cat="elastic",
+                              neval=plan.neval, world=new_world)
+            telemetry.counter("peers", joined=new_world)
+            self._elastic_plan = plan
+            self._note_elastic_event("join", plan, new_world)
+            logger.warning(
+                "elastic: rank %d joined world %d at snapshot %d "
+                "(round %d)", rank, new_world, plan.neval, plan.epoch)
 
     def _check_accum_batching(self):
         """Fail at optimize() start (not mid-epoch on the final partial
@@ -1650,6 +1884,11 @@ class Optimizer:
                         f"{state['neval'] - 1}; resume with "
                         "Optimizer.resume_from or the retry loop of the "
                         "next incarnation")
+                if fire:
+                    # grow gate: returning hosts are admitted ONLY at a
+                    # checkpoint boundary — the snapshot just written is
+                    # the one the joiner adopts (parallel/elastic step 4)
+                    self._check_join(state)
             self._close_data_pipeline()
             if pending_loss is not None:
                 state["loss"] = self._observe_loss(float(pending_loss),
@@ -1691,6 +1930,10 @@ class Optimizer:
                 raise TrainingPreempted(
                     f"SIGTERM: final checkpoint written at epoch "
                     f"{state['epoch'] - 1}")
+            if fire:
+                # grow gate at the epoch boundary too (every_epoch-style
+                # checkpoint triggers)
+                self._check_join(state)
 
         file_io.join_checkpoints(getattr(self, "_ckpt_futures", []))
         self._ckpt_futures = []  # write errors surfaced above

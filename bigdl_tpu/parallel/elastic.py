@@ -39,12 +39,38 @@ lineage, deterministic chaos) into a cluster-wide recovery protocol:
    global batch may grow by up to ``W'-1`` rows, never shrink), and
    resumes from the negotiated entry.  The retry loop treats the whole
    detect->negotiate->re-form sequence as ONE typed attempt.
-4. **drill** — chaos ``host.lost@<rank>`` (utils/chaos: the addressed
+4. **grow** — the SCALE-UP half: a returning (or brand-new) host
+   announces itself with :func:`announce_join` — but a RETURNING rank
+   (one whose previous life left a heartbeat behind) first waits for
+   its :func:`death_certificate`: a recovery round declaring it lost.
+   Announcing earlier would publish a fresh heartbeat while survivors
+   still count the old life as live, resetting the publication silence
+   they detect the loss by — the shrink this grow stacks on would never
+   run.  The announcement itself is heartbeat hygiene
+   first (its stale ``recover.<rank>``/``lineage.<rank>`` files from a
+   previous life are deleted and a fresh GENERATION-stamped heartbeat
+   replaces the frozen one, so survivors can tell "came back" from "old
+   file still lying around"), then an ``elastic/join.<rank>`` intent.
+   Survivors notice the intent at their next CHECKPOINT BOUNDARY (the
+   agreed snapshot is the one just written — the joiner adopts it,
+   never the reverse), the writer publishes an ``elastic/grow.<epoch>``
+   admission offer naming the widened survivor set, and every party —
+   joiner included — runs the SAME :func:`negotiate` round to agree on
+   the restore point.  ``Engine.reform`` widens the ``data`` axis,
+   ZeRO/FSDP state remaps 1/N -> 1/N', and the per-host batch rescales
+   back DOWN so the global batch returns to its configured value.  A
+   join intent that lands while a SHRINK round is still pending is
+   deferred to the next boundary: re-forms never interleave.
+5. **drill** — chaos ``host.lost@<rank>`` (utils/chaos: the addressed
    rank stops publishing and exits or wedges, optionally at an
    ``@epoch:iteration`` address) runs the full cycle deterministically:
    ``tools/elastic_smoke.py`` and ``tests/test_elastic.py`` kill one of
    two subprocess ranks mid-epoch and assert the survivor shrinks,
    rolls back to the negotiated entry, and matches a clean world-1 run.
+   The ``--grow`` drill adds chaos ``host.return@<rank>=@epoch:iteration``
+   (the joiner gates its announcement on the CLUSTER position read from
+   the newest snapshot's driver_state) and asserts world 2 -> 1 -> 2
+   with the per-host batch 16 -> 32 -> 16.
 
 Simulated multi-host: the drill harness runs N single-process jax
 runtimes coordinated ONLY through ``file_io`` (heartbeats, lineage,
@@ -63,6 +89,10 @@ Knobs (utils/config tier):
 | ``BIGDL_TPU_ELASTIC_WORLD`` / ``_ELASTIC_RANK`` | simulated-multi-host logical topology | off |
 | ``BIGDL_TPU_ELASTIC_NEGOTIATE_TIMEOUT`` | seconds to wait for every survivor's lineage view | 60 |
 | ``BIGDL_TPU_ELASTIC_NEGOTIATE_POLL`` | seconds between view polls | 0.25 |
+| ``BIGDL_TPU_ELASTIC_JOIN`` | 1 = this process is a JOINER: announce into the cluster and adopt the agreed snapshot before training | 0 |
+| ``BIGDL_TPU_ELASTIC_JOIN_TIMEOUT`` | seconds the joiner waits for an admission offer (and survivors wait for the joiner's view) | 120 |
+| ``BIGDL_TPU_ELASTIC_JOIN_POLL`` | seconds between the joiner's gate/admission polls | 0.25 |
+| ``BIGDL_TPU_ELASTIC_REFORM_GRACE`` | post-reform seconds during which publication silence is NOT promoted to host loss (every member recompiles its jitted step after a re-form) | 2 |
 """
 
 from __future__ import annotations
@@ -77,11 +107,16 @@ from ..utils import config, file_io, telemetry
 
 logger = logging.getLogger("bigdl_tpu")
 
-__all__ = ["PeerLostError", "ElasticNegotiationError", "ElasticPlan",
-           "armed", "peer_lost_seconds", "elastic_dir", "survey",
-           "publish_intent", "read_intents", "publish_lineage_view",
-           "read_lineage_view", "negotiate", "quarantine_tail",
-           "set_last_peer_lost"]
+__all__ = ["PeerLostError", "ElasticNegotiationError", "ElasticJoinError",
+           "ElasticPlan", "armed", "peer_lost_seconds", "join_armed",
+           "join_timeout_seconds", "join_poll_seconds", "elastic_dir",
+           "survey", "publish_intent", "read_intents",
+           "publish_lineage_view", "read_lineage_view", "negotiate",
+           "quarantine_tail", "set_last_peer_lost", "publish_join_intent",
+           "read_join_intents", "clear_join_intent", "publish_grow_offer",
+           "latest_grow_epoch", "read_grow_offer", "wait_for_admission",
+           "previous_generation", "death_certificate", "announce_join",
+           "cluster_position"]
 
 #: subdirectory of the checkpoint dir holding the recovery protocol files
 ELASTIC_DIRNAME = "elastic"
@@ -122,6 +157,13 @@ class ElasticNegotiationError(RuntimeError):
     the run is unrecoverable in place and the retry loop re-raises."""
 
 
+class ElasticJoinError(RuntimeError):
+    """A joiner could not get admitted: no survivor published an
+    admission offer naming this rank within the join timeout.  Typed so
+    the operator can tell "cluster never answered" from a negotiation
+    failure after admission."""
+
+
 @dataclass
 class ElasticPlan:
     """The negotiated recovery: resume `neval` on `survivors`."""
@@ -141,6 +183,20 @@ def armed() -> bool:
     """True when host-loss promotion is configured (the elasticity master
     switch; 0/unset keeps every path in this module inert)."""
     return peer_lost_seconds() > 0
+
+
+def join_armed() -> bool:
+    """True when THIS process is a joiner: it must announce itself and
+    adopt the cluster's agreed snapshot before training a single step."""
+    return config.get_bool("ELASTIC_JOIN", False)
+
+
+def join_timeout_seconds() -> float:
+    return config.get_float("ELASTIC_JOIN_TIMEOUT", 120.0)
+
+
+def join_poll_seconds() -> float:
+    return config.get_float("ELASTIC_JOIN_POLL", 0.25)
 
 
 def elastic_dir(ckpt_path: str) -> str:
@@ -347,3 +403,224 @@ def negotiate(ckpt_path: str, rank: int, survivors: Sequence[int],
                        "(round %d, survivors %s)", chosen, epoch,
                        list(plan.survivors))
         return plan
+
+
+# ---------------------------------------------------------------------------
+# GROW: join intents, admission offers, announcement hygiene
+# ---------------------------------------------------------------------------
+
+#: subdirectory of the checkpoint dir holding the peer heartbeats (the
+#: supervisor's default; announce_join cleans/restamps files in here)
+HEARTBEAT_DIRNAME = "heartbeats"
+
+
+def publish_join_intent(ckpt_path: str, rank: int, wall_time: float,
+                        generation: int) -> str:
+    """Announce 'rank `rank` (heartbeat generation `generation`) wants
+    back in' — survivors admit it at their next checkpoint boundary."""
+    return _write_json(elastic_dir(ckpt_path), f"join.{int(rank)}",
+                       {"rank": int(rank), "generation": int(generation),
+                        "time": float(wall_time)})
+
+
+def read_join_intents(ckpt_path: str,
+                      exclude_rank: Optional[int] = None) -> Dict[int, dict]:
+    """rank -> intent doc for every pending ``join.<rank>``."""
+    base = elastic_dir(ckpt_path)
+    fs = file_io.get_filesystem(base)
+    try:
+        names = fs.listdir(base)
+    except Exception:  # noqa: BLE001 — dir may not exist yet
+        return {}
+    intents = {}
+    for name in names:
+        head, _, tail = name.rpartition(".")
+        if head != "join" or not tail.isdigit():
+            continue
+        rank = int(tail)
+        if exclude_rank is not None and rank == exclude_rank:
+            continue
+        doc = _read_json(file_io._join(base, name))
+        if doc:
+            intents[rank] = doc
+    return intents
+
+
+def clear_join_intent(ckpt_path: str, rank: int) -> None:
+    """Consume a join intent (admitted or abandoned) so a later boundary
+    does not re-admit a rank that is already in — or long gone."""
+    path = file_io._join(elastic_dir(ckpt_path), f"join.{int(rank)}")
+    try:
+        fs = file_io.get_filesystem(path)
+        if fs.exists(path):
+            fs.remove(path)
+    except Exception as e:  # noqa: BLE001 — best-effort: a leftover
+        # intent is filtered by the survivor-set check at the boundary
+        logger.warning("elastic: could not clear join intent for rank "
+                       "%d: %s", rank, e)
+
+
+def publish_grow_offer(ckpt_path: str, rank: int, epoch: int,
+                       survivors: Sequence[int], wall_time: float) -> str:
+    """The WRITER's admission offer for grow round `epoch`: the widened
+    survivor set every party (joiner included) negotiates over."""
+    return _write_json(elastic_dir(ckpt_path), f"grow.{int(epoch)}",
+                       {"epoch": int(epoch), "rank": int(rank),
+                        "survivors": sorted(int(r) for r in survivors),
+                        "time": float(wall_time)})
+
+
+def latest_grow_epoch(ckpt_path: str) -> int:
+    """Newest grow-offer round on storage (0 when none): the joiner
+    records this BEFORE announcing so stale offers from earlier
+    episodes can never admit it."""
+    base = elastic_dir(ckpt_path)
+    fs = file_io.get_filesystem(base)
+    try:
+        names = fs.listdir(base)
+    except Exception:  # noqa: BLE001 — dir may not exist yet
+        return 0
+    newest = 0
+    for name in names:
+        head, _, tail = name.rpartition(".")
+        if head == "grow" and tail.isdigit():
+            newest = max(newest, int(tail))
+    return newest
+
+
+def read_grow_offer(ckpt_path: str, min_epoch: int,
+                    rank: Optional[int] = None) -> Optional[dict]:
+    """Newest grow offer with round > `min_epoch` (and, when `rank` is
+    given, naming that rank in its survivor set); None when absent."""
+    base = elastic_dir(ckpt_path)
+    best = None
+    for epoch in range(latest_grow_epoch(ckpt_path), min_epoch, -1):
+        doc = _read_json(file_io._join(base, f"grow.{epoch}"))
+        if doc is None:
+            continue
+        if rank is not None and int(rank) not in [
+                int(r) for r in doc.get("survivors", [])]:
+            continue
+        best = doc
+        break
+    return best
+
+
+def wait_for_admission(ckpt_path: str, rank: int, *, floor: int,
+                       timeout: Optional[float] = None,
+                       poll: Optional[float] = None,
+                       clock=None, sleep=None) -> dict:
+    """Joiner side: poll for a grow offer newer than `floor` naming this
+    rank.  Raises the typed :class:`ElasticJoinError` — never hangs —
+    when no survivor answers within the join timeout."""
+    timeout = join_timeout_seconds() if timeout is None else timeout
+    poll = join_poll_seconds() if poll is None else poll
+    clock = clock or time.monotonic
+    sleep = sleep or time.sleep
+    start = clock()
+    while True:
+        offer = read_grow_offer(ckpt_path, min_epoch=floor, rank=rank)
+        if offer is not None:
+            logger.warning("elastic: rank %d admitted by grow round %d "
+                           "(survivors %s)", rank, offer["epoch"],
+                           offer.get("survivors"))
+            return offer
+        if clock() - start >= timeout:
+            raise ElasticJoinError(
+                f"elastic join: rank {rank} announced but no survivor "
+                f"published an admission offer past round {floor} within "
+                f"{timeout:.1f}s — is the cluster checkpointing?")
+        from ..utils import supervisor as _supervision
+        _supervision.notify()
+        sleep(poll)
+
+
+def previous_generation(ckpt_path: str, rank: int,
+                        peer_dir: Optional[str] = None) -> Optional[int]:
+    """Generation of the heartbeat `rank`'s PREVIOUS life left behind,
+    or None when no heartbeat exists (a genuinely new rank)."""
+    base = file_io._strip_file_scheme(str(ckpt_path))
+    peer_dir = peer_dir or file_io._join(base, HEARTBEAT_DIRNAME)
+    old = _read_json(file_io._join(peer_dir, f"heartbeat.{int(rank)}"))
+    if not old:
+        return None
+    return int(old.get("generation", 0))
+
+
+def death_certificate(ckpt_path: str, rank: int, *, floor: int = 0) -> int:
+    """The recovery round (> `floor`, the last grow epoch) in which a
+    survivor declared `rank` lost — 0 when the cluster has not noticed
+    the loss yet.  A RETURNING rank must hold its announcement until
+    this exists: publishing a generation-bumped heartbeat while the
+    survivors still count the old life as live would reset the very
+    publication silence they detect the loss by, and the shrink this
+    grow must stack on would never run."""
+    best = 0
+    for doc in read_intents(ckpt_path, min_epoch=int(floor) + 1).values():
+        if int(rank) in [int(r) for r in doc.get("lost", ())]:
+            best = max(best, int(doc.get("epoch", 0)))
+    return best
+
+
+def announce_join(ckpt_path: str, rank: int, wall_time: float,
+                  peer_dir: Optional[str] = None) -> dict:
+    """Heartbeat hygiene + announcement, in that order.
+
+    The returning rank's previous life left a FROZEN heartbeat and
+    possibly stale ``recover.<rank>``/``lineage.<rank>`` protocol files;
+    survivors must never read those as liveness or as a current view.
+    So: bump the heartbeat GENERATION past the old file's (survivors
+    treat a higher generation from a lost rank as 'returned', not as the
+    old entry aging), delete the stale protocol files, record the grow
+    floor, and only then publish the ``join.<rank>`` intent.  Returns
+    ``{"generation": g, "floor": f}`` for the supervisor restamp and
+    :func:`wait_for_admission`."""
+    base = file_io._strip_file_scheme(str(ckpt_path))
+    peer_dir = peer_dir or file_io._join(base, HEARTBEAT_DIRNAME)
+    hb_path = file_io._join(peer_dir, f"heartbeat.{int(rank)}")
+    old = _read_json(hb_path) or {}
+    generation = int(old.get("generation", 0)) + 1
+    edir = elastic_dir(ckpt_path)
+    fs = file_io.get_filesystem(edir)
+    for stale in (f"recover.{int(rank)}", f"lineage.{int(rank)}"):
+        path = file_io._join(edir, stale)
+        try:
+            if fs.exists(path):
+                fs.remove(path)
+                logger.info("elastic: removed stale %s from rank %d's "
+                            "previous life", stale, rank)
+        except Exception as e:  # noqa: BLE001 — stale views are also
+            # defeated by the epoch stamps; removal is belt-and-braces
+            logger.warning("elastic: could not remove stale %s: %s",
+                           stale, e)
+    _write_json(peer_dir, f"heartbeat.{int(rank)}",
+                {"rank": int(rank), "phase": "join", "count": 0,
+                 "time": float(wall_time), "published": float(wall_time),
+                 "generation": generation})
+    floor = latest_grow_epoch(ckpt_path)
+    publish_join_intent(ckpt_path, rank, wall_time, generation)
+    telemetry.instant("elastic.join_intent", cat="elastic", rank=int(rank),
+                      generation=generation)
+    logger.warning("elastic: rank %d announced join (heartbeat "
+                   "generation %d, grow floor %d)", rank, generation,
+                   floor)
+    return {"generation": generation, "floor": floor}
+
+
+def cluster_position(ckpt_path: str) -> Optional[tuple]:
+    """The cluster's training position ``(epoch, neval)`` as recorded by
+    the newest loadable snapshot's driver_state.  The stored ``neval``
+    is already incremented to the NEXT iteration — exactly the
+    coordinate ``chaos.at_position`` publishes at the top of that
+    iteration — so a joiner polling this can gate a
+    ``host.return@<rank>=@epoch:iteration`` address deterministically.
+    None when no snapshot is loadable yet."""
+    for _mp, op, _n in file_io.checkpoint_lineage(ckpt_path):
+        try:
+            blob = file_io.load(op)
+        except Exception:  # noqa: BLE001 — mid-write entry; try older
+            continue
+        ds = (blob or {}).get("driver_state") or {}
+        if "epoch" in ds and "neval" in ds:
+            return int(ds["epoch"]), int(ds["neval"])
+    return None

@@ -123,10 +123,12 @@ class UnannotatedParameterError(TypeError):
 
 
 class MeshReformError(RuntimeError):
-    """An elastic re-form cannot keep the layout's ``fsdp x tp`` block
-    intact on the surviving device slice (survivor count is not a
-    multiple of fsdp*tp).  Typed so the elastic retry loop can
-    distinguish 'unrecoverable topology' from transient faults."""
+    """An elastic re-form — shrink after a host loss OR grow when a
+    returning host is admitted — cannot keep the layout's ``fsdp x tp``
+    (x pipe x expert) block intact on the new device set (device count
+    is not a multiple of the non-data block).  Typed so the elastic
+    retry loop can distinguish 'unrecoverable topology' from transient
+    faults."""
 
 
 def fsdp_min_size() -> int:

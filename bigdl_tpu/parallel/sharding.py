@@ -74,15 +74,16 @@ class ShardingStrategy:
     def remap(self, mesh: Mesh, params):
         """Re-place a parameter tree under THIS strategy's shardings on a
         (possibly different) mesh — the elastic re-form path
-        (parallel/elastic step 3): after a host loss shrinks the mesh,
-        every leaf is re-derived for the surviving slice, so ZeRO shards
-        go from 1/N to 1/N' and replicated leaves land on the new device
-        set.  Leaves round-trip through host memory (device buffers on a
-        dead mesh cannot be resharded in place); every leaf must be
+        (parallel/elastic steps 3-4): after a host loss shrinks the mesh
+        — or a grow admission widens it — every leaf is re-derived for
+        the new device set, so ZeRO shards go from 1/N to 1/N' (N' < N
+        on shrink, N' > N on grow) and replicated leaves land on the new
+        devices.  Leaves round-trip through host memory (device buffers
+        on a dead mesh cannot be resharded in place); every leaf must be
         addressable from this process — on a real multi-controller pod
         the survivors reload from the negotiated checkpoint instead
-        (Optimizer._elastic_recover), which is this same path with the
-        host copy coming off storage."""
+        (Optimizer._elastic_recover / _elastic_grow), which is this same
+        path with the host copy coming off storage."""
         host = jax.tree.map(
             lambda l: np.asarray(l) if isinstance(l, jax.Array) else l,
             params)

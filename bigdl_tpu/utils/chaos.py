@@ -26,6 +26,7 @@ Fault points wired into the runtime:
 | ``serve.replica@<idx>`` | once per non-empty batch on replica `<idx>` (serve/server) | wedge/exit (thread-scoped) |
 | ``serve.canary`` | once per canary-routed batch (serve/server)  | fail/stall |
 | ``host.lost@<rank>`` | once per train iteration on rank `<rank>` (driver loop) | exit/wedge |
+| ``host.return@<rank>`` | once per announce poll in rank `<rank>`'s joiner loop (parallel/elastic grow) | join (gate) |
 | ``deploy.publish`` | once per release-entry write (serve/continuous) | corrupt   |
 
 Schedules (1-based counts):
@@ -43,6 +44,11 @@ Schedules (1-based counts):
   (``os._exit(117)``) or wedge UNINTERRUPTIBLY (the sliced sleep
   swallows async-raised exceptions — a lost host cannot be recovered by
   a StallError, which is the point)
+- ``ReturnAt(2)`` — the host-RETURN drill (the grow half of
+  parallel/elastic): an OBSERVATION GATE, not a fault.  Checked via
+  :func:`gate` from the joiner's announce loop; when it fires the
+  joiner announces itself and rejoins — nothing raises, blocks, or
+  exits
 
 Env/config spec (``BIGDL_TPU_CHAOS``), `;`-separated points::
 
@@ -63,6 +69,15 @@ Addressing extensions (net-new with the elastic subsystem):
   engages on the addressed one.  Actions: ``exit`` (the process dies
   instantly with code 117) and ``wedge``/``lost`` (stops beating and
   blocks, default 3600s, ``wedge*N`` for N seconds).
+  ``host.return@<rank>`` is the grow counterpart: the JOINER's announce
+  loop polls it via :func:`gate` (actions ``join``/``return``, or the
+  bare ``@epoch:iteration`` shorthand — ``host.return@1=@2:2``).  The
+  joiner publishes the CLUSTER position (read from the newest
+  snapshot's driver_state) via :func:`at_position` before each poll;
+  because a polling observer may never sample the exact coordinate,
+  gate position addresses fire AT-OR-AFTER the addressed ``(epoch,
+  iteration)`` (tuple order) — fault position addresses stay
+  exact-match.
 - **thread-scoped exit/wedge** — a fire site may pass ``thread_exc``
   (serve/server.py's replica loop does, with
   ``serve.replica@<replica idx>`` points): an ``exit`` schedule then
@@ -90,14 +105,14 @@ from typing import Dict, Iterable, List, Optional
 logger = logging.getLogger("bigdl_tpu")
 
 __all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
-           "ExitAt", "WedgeAt", "register", "install", "clear", "reset",
-           "armed", "fire", "transform", "scoped", "counts", "at_position",
-           "FAULT_POINTS"]
+           "ExitAt", "WedgeAt", "ReturnAt", "register", "install", "clear",
+           "reset", "armed", "fire", "gate", "transform", "scoped",
+           "counts", "at_position", "FAULT_POINTS"]
 
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
                 "step.loss_nan", "data.record", "data.stall", "step.stall",
                 "serve.request", "serve.batch", "serve.replica",
-                "serve.canary", "host.lost")
+                "serve.canary", "host.lost", "host.return")
 
 #: the driver loop's current (epoch, neval), published once per iteration
 #: via at_position() — the coordinate ``@epoch:iteration`` addresses match
@@ -321,6 +336,31 @@ class WedgeAt:
         return f"WedgeAt({sorted(self.counts)}, seconds={self.seconds})"
 
 
+class ReturnAt:
+    """Host-return drill (the grow half of parallel/elastic): an
+    observation GATE with fault-schedule addressing but NO fault
+    semantics — :func:`fire`/:func:`transform` ignore it entirely; only
+    :func:`gate` reports it.  The elastic joiner polls its
+    ``host.return@<rank>`` point once per announce loop and announces
+    itself when the gate is reached (by invocation count, or at-or-after
+    an ``@epoch:iteration`` position — see the module docstring)."""
+
+    def __init__(self, *counts: int):
+        self.counts = frozenset(int(c) for c in counts)
+
+    def fires(self, count: int) -> bool:
+        return count in self.counts
+
+    def mutate(self, value):  # gate schedules never mutate
+        raise AssertionError("ReturnAt has no payload mutation")
+
+    is_fail = False
+    is_gate = True
+
+    def __repr__(self):
+        return f"ReturnAt({sorted(self.counts)})"
+
+
 class _Point:
     __slots__ = ("schedules", "count")
 
@@ -436,6 +476,35 @@ def fire(point: str, thread_exc=None) -> None:
                              f"(invocation {count}, {s!r})")
 
 
+def gate(point: str) -> bool:
+    """Count one invocation and report whether an OBSERVATION GATE at
+    `point` is reached — nothing raises, blocks, or exits (the
+    difference from :func:`fire`).  The elastic joiner's announce loop
+    polls its ``host.return@<rank>`` point with this.
+
+    Matching: plain invocation counts are exact (like every schedule);
+    ``@epoch:iteration`` positions fire AT-OR-AFTER the addressed
+    coordinate (tuple order on ``(epoch, neval)``) — the gate's caller
+    POLLS positions sampled from the checkpoint stream and may never
+    observe the exact coordinate, so exact-match would be a silent
+    never-fire."""
+    _load_env()
+    with _LOCK:
+        p = _POINTS.get(point)
+        if p is None or not p.schedules:
+            return False
+        p.count += 1
+        count = p.count
+        at = _POSITION["at"]
+        hits = [s for s in p.schedules
+                if s.fires(count) or
+                (at is not None and
+                 any(at >= pos for pos in getattr(s, "positions", ())))]
+    if hits:
+        _trace_hits(point, count, hits)
+    return bool(hits)
+
+
 def transform(point: str, value):
     """Count one invocation; raise on fail schedules, block on stall
     schedules, else pipe the payload through every matching corrupt
@@ -451,7 +520,7 @@ def transform(point: str, value):
         elif s.is_fail:
             raise ChaosFault(f"chaos[{point}] injected failure "
                              f"(invocation {count}, {s!r})")
-        else:
+        elif not getattr(s, "is_gate", False):
             value = s.mutate(value)
     return value
 
@@ -483,8 +552,9 @@ def _parse_action(action: str):
     ``truncate@2`` / ``nan@7`` / ``stall@5`` / ``stall*30@5`` (for stall,
     ``*N`` is the block duration in SECONDS, not a repeat count) /
     ``exit@4`` / ``wedge*30@4`` / ``lost@4`` (= wedge; the host-loss
-    drill actions).  Counts may be ``epoch:iteration`` pairs
-    (``stall*30@2:5``)."""
+    drill actions) / ``join@2:2`` / ``return@2:2`` or the bare ``@2:2``
+    shorthand (= ReturnAt, the host-return gate).  Counts may be
+    ``epoch:iteration`` pairs (``stall*30@2:5``)."""
     if "@" not in action:
         raise ValueError(f"chaos spec: missing '@counts' in {action!r}")
     kind, _, at = action.partition("@")
@@ -521,6 +591,10 @@ def _parse_action(action: str):
         return place(CorruptAt(*counts_, mode="truncate"))
     if kind == "nan":
         return place(CorruptAt(*counts_))  # float payloads NaN any mode
+    if kind in ("join", "return", ""):
+        # host-return gate: ``host.return@1=join@2:2`` — or the bare
+        # ``host.return@1=@2:2`` the drill specs read most naturally
+        return place(ReturnAt(*counts_))
     raise ValueError(f"chaos spec: unknown action {kind!r} in {action!r}")
 
 

@@ -279,24 +279,33 @@ class Engine:
     def reform(cls, world: Optional[int] = None, rank: Optional[int] = None,
                survivors: Optional[Sequence[int]] = None,
                devices: Optional[Sequence] = None) -> Mesh:
-        """Re-form the topology over the surviving slice after a host
-        loss (parallel/elastic step 3).
+        """Re-form the topology over a new rank set — SHRINK after a host
+        loss (parallel/elastic step 3) or GROW when a returning host is
+        admitted (step 4): the data axis resizes in either direction.
 
         `survivors` are ORIGINAL rank ids (default: the first `world`
-        current survivors); `rank` is this process's original id
-        (default: unchanged).  With `devices` given, the mesh itself is
-        rebuilt over that device subset (the in-process simulated-host
-        path: "losing a host" = losing its devices); only 1-D
-        data-parallel meshes re-form this way — multi-axis layouts need
-        an explicit Engine.init.  Without `devices` the mesh keeps its
-        current (local) devices and only the logical topology shrinks —
-        the simulated-multi-host path, where each rank's devices were
-        local all along.  The caller (Optimizer._elastic_recover) owns
-        tearing down compiled steps and re-placing state."""
+        current survivors — a shrink-only shorthand; growing must name
+        the widened set explicitly since ranks keep their original ids);
+        `rank` is this process's original id (default: unchanged).  With
+        `devices` given, the mesh itself is rebuilt over that device
+        subset (the in-process simulated-host path: "losing a host" =
+        losing its devices, "regaining" = its devices coming back); only
+        1-D data-parallel meshes re-form this way — multi-axis layouts
+        resize their data axis via :meth:`_reform_data_axis`.  Without
+        `devices` the mesh keeps its current (local) devices and only
+        the logical topology changes — the simulated-multi-host path,
+        where each rank's devices were local all along.  The caller
+        (Optimizer._elastic_recover / _elastic_grow) owns tearing down
+        compiled steps and re-placing state."""
         cur = cls.survivors()
         if survivors is None:
             if world is None:
                 raise ValueError("Engine.reform: need world or survivors")
+            if int(world) > len(cur):
+                raise ValueError(
+                    f"Engine.reform: world={world} > current "
+                    f"{len(cur)} — growing needs an explicit survivor "
+                    "set (original rank ids cannot be invented)")
             survivors = cur[:int(world)]
         survivors = tuple(sorted(int(r) for r in survivors))
         if not survivors:
@@ -313,7 +322,7 @@ class Engine:
         if devices is not None:
             devs = list(devices)
             if cls._mesh is not None and len(cls._mesh.axis_names) > 1:
-                cls.set_mesh(cls._shrink_data_axis(cls._mesh, devs))
+                cls.set_mesh(cls._reform_data_axis(cls._mesh, devs))
             else:
                 cls.set_mesh(Mesh(np.array(devs), (cls.DATA_AXIS,)))
         cls._elastic = {"rank": rank, "survivors": survivors}
@@ -322,37 +331,41 @@ class Engine:
         return cls.mesh()
 
     @classmethod
-    def _shrink_data_axis(cls, mesh: Mesh, devs) -> Mesh:
-        """Re-form a MULTI-AXIS mesh over a surviving device slice by
-        shrinking the 'data' axis and keeping every other axis (the
-        fsdp x tp x pipe x expert block of a MeshLayout) intact.  When
-        the survivor count is not a multiple of the non-data block —
-        the shard groups cannot be preserved — this raises the typed
-        MeshReformError instead of silently re-laying-out sharded
-        parameters (parallel/layout; drilled by tests/test_layout.py)."""
+    def _reform_data_axis(cls, mesh: Mesh, devs) -> Mesh:
+        """Re-form a MULTI-AXIS mesh over a new device set by resizing
+        the 'data' axis — in EITHER direction — and keeping every other
+        axis (the fsdp x tp x pipe x expert block of a MeshLayout)
+        intact.  When the device count is not a multiple of the non-data
+        block — the shard groups cannot be preserved — this raises the
+        typed MeshReformError instead of silently re-laying-out sharded
+        parameters (parallel/layout; drilled by tests/test_layout.py and
+        tests/test_elastic.py for the widen direction)."""
         from ..parallel.layout import MeshReformError
         names = tuple(mesh.axis_names)
         if cls.DATA_AXIS not in names:
             raise MeshReformError(
                 f"cannot re-form mesh {dict(mesh.shape)} over "
-                f"{len(devs)} surviving device(s): no '{cls.DATA_AXIS}' "
-                "axis to shrink — rebuild the layout via Engine.init")
+                f"{len(devs)} device(s): no '{cls.DATA_AXIS}' "
+                "axis to resize — rebuild the layout via Engine.init")
         sizes = [int(mesh.shape[a]) for a in names]
         di = names.index(cls.DATA_AXIS)
         block = int(np.prod([s for i, s in enumerate(sizes) if i != di]))
         if len(devs) < block or len(devs) % block:
             raise MeshReformError(
                 f"cannot re-form mesh {dict(mesh.shape)} over "
-                f"{len(devs)} surviving device(s): the non-data block "
+                f"{len(devs)} device(s): the non-data block "
                 f"({ {a: s for i, (a, s) in enumerate(zip(names, sizes)) if i != di} }"
-                f" = {block} devices) must divide the survivor count to "
-                "keep the fsdp/tp/pipe/expert shard groups intact; shrink "
-                f"to a multiple of {block} devices or re-init a smaller "
-                "layout")
+                f" = {block} devices) must divide the device count to "
+                "keep the fsdp/tp/pipe/expert shard groups intact; "
+                f"re-form to a multiple of {block} devices or re-init a "
+                "different layout")
         sizes[di] = len(devs) // block
         logger.warning("Engine.reform: mesh %s -> %s over %d device(s)",
                        dict(mesh.shape), dict(zip(names, sizes)), len(devs))
         return Mesh(np.array(devs).reshape(sizes), names)
+
+    # kept as an alias: external drills/tests referenced the shrink name
+    _shrink_data_axis = _reform_data_axis
 
     # -- topology accessors (BigDL: Engine.nodeNumber / Engine.coreNumber) --
 

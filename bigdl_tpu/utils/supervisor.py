@@ -77,6 +77,7 @@ Knobs (utils/config tier):
 | ``BIGDL_TPU_SUPERVISE_POLICY`` | ``raise`` or ``exit`` | raise |
 | ``BIGDL_TPU_SUPERVISE_PEER_STALE`` | peer heartbeat (beat-age) staleness threshold, seconds | 60 |
 | ``BIGDL_TPU_ELASTIC_PEER_LOST`` | publication-silence seconds promoting a peer to LOST (0 = off) | 0 |
+| ``BIGDL_TPU_ELASTIC_REFORM_GRACE`` | post-reform seconds during which silence is NOT promoted to loss (members recompile their jitted step after every shrink/grow) | 2 |
 """
 
 from __future__ import annotations
@@ -242,6 +243,9 @@ class Supervisor:
                  lineage_dir: Optional[str] = None,
                  on_peer_stale: Optional[Callable[[int, float],
                                                   None]] = None,
+                 on_peer_returned: Optional[Callable[[int, int],
+                                                     None]] = None,
+                 generation: int = 0,
                  name: str = "bigdl-supervisor",
                  timeline_len: int = 64):
         self.deadlines = dict(deadlines or {})
@@ -270,14 +274,34 @@ class Supervisor:
         # episode (programmatic access beside the log line)
         self.peer_lost = (peer_lost if peer_lost is not None
                           else config.get_float("ELASTIC_PEER_LOST", 0.0))
+        # detection grace after every re-form: all members tear down and
+        # recompile their jitted step right after a shrink/grow, and a
+        # compile can starve the monitor thread past a tight peer_lost
+        # threshold — silence inside this window is rebuild, not death
+        self.reform_grace = config.get_float("ELASTIC_REFORM_GRACE", 2.0)
+        self._promotion_grace_until = 0.0
         #: the CHECKPOINT/lineage dir whose `elastic/` subdir carries the
         #: recovery protocol files (parallel/elastic.elastic_dir)
         self.lineage_dir = lineage_dir
         self.on_peer_stale = on_peer_stale
+        # on_peer_returned fires ONCE per returned-peer episode (mirror of
+        # on_peer_stale): a rank recovered away from has published a
+        # heartbeat with a HIGHER generation than the frozen one it left
+        # behind — it wants back in (parallel/elastic grow).  `generation`
+        # is stamped into this rank's own heartbeat blob; a joiner bumps
+        # it past its previous life's so survivors can tell "came back"
+        # from "stale file".
+        self.on_peer_returned = on_peer_returned
+        self.generation = int(generation)
         self.elastic_epoch = 0      # completed elastic recovery rounds
         self.heartbeat_errors = 0   # failed (retried) heartbeat publishes
         self._publish_suspended = False
-        self._lost_peers = set()    # ranks already recovered away from
+        # ranks already recovered away from -> the heartbeat generation
+        # last seen from them (membership test unchanged; the value is
+        # what a RETURN must exceed)
+        self._lost_peers: Dict[int, int] = {}
+        self._returned_peers: Dict[int, int] = {}
+        self._peer_gens: Dict[int, int] = {}
         self._peer_lost_pending = False
         self._lost_candidates: Dict[int, float] = {}
         self.name = name
@@ -626,6 +650,22 @@ class Supervisor:
         peers must see this rank go publication-silent)."""
         self._publish_suspended = True
 
+    def resume_heartbeat(self) -> None:
+        """Re-enable liveness publication — the JOINER path: a returning
+        rank stays publication-silent until its announcement has cleaned
+        the previous life's files and bumped the generation
+        (parallel/elastic.announce_join), then resumes beating."""
+        self._publish_suspended = False
+        self._last_publish = None   # publish on the very next poll
+
+    def hold_elastic(self) -> None:
+        """Disable host-loss promotion until the next :meth:`reform` —
+        the JOINER path: a rank gating on the cluster's checkpoint
+        stream / awaiting admission is not yet a member and must not
+        initiate a shrink of it (a transiently slow survivor heartbeat
+        would otherwise read as a loss)."""
+        self._peer_lost_pending = True
+
     def _publish_heartbeat(self) -> None:
         """Publish this process's last-beat wall time.  Runs on the
         MONITOR thread but stamps the SUPERVISED thread's last beat, so a
@@ -641,9 +681,15 @@ class Supervisor:
         if not self.peer_dir or self.world <= 1 or self._publish_suspended:
             return
         now = self.clock()
-        interval = (self.publish_interval
-                    if self.publish_interval is not None
-                    else max(self.peer_stale / 4.0, 0.5))
+        interval = self.publish_interval
+        if interval is None:
+            interval = max(self.peer_stale / 4.0, 0.5)
+            if self.peer_lost > 0:
+                # elastic-armed: publication age is the host-LOST signal,
+                # so publishes must land well inside that threshold — the
+                # 0.5s floor alone leaves no margin under a sub-second
+                # peer_lost (a scheduling hiccup reads as a dead host)
+                interval = min(interval, self.peer_lost / 4.0)
         if self._last_publish is not None and \
                 now - self._last_publish < interval:
             return
@@ -654,7 +700,8 @@ class Supervisor:
                          else self.wall_clock())
         blob = json.dumps({"rank": self.rank, "phase": phase,
                            "count": count, "time": last_wall,
-                           "published": self.wall_clock()}).encode()
+                           "published": self.wall_clock(),
+                           "generation": self.generation}).encode()
         path = self._heartbeat_path(self.rank)
         try:
             from . import file_io
@@ -690,7 +737,10 @@ class Supervisor:
             return dict(self._lost_candidates)
 
     def _check_peers(self, log: bool) -> Dict[int, float]:
-        if not self.peer_dir or self.world <= 1:
+        # a world shrunk to 1 has no live peers to age-check, but lost
+        # peers' frozen heartbeats must STAY watched: a returning rank
+        # announces its next life there (parallel/elastic grow)
+        if not self.peer_dir or (self.world <= 1 and not self._lost_peers):
             return {}
         from . import file_io
         base = file_io._strip_file_scheme(str(self.peer_dir))
@@ -707,9 +757,15 @@ class Supervisor:
             if head != "heartbeat" or not tail.isdigit():
                 continue
             rank = int(tail)
-            if rank == self.rank or rank in self._lost_peers:
+            if rank == self.rank:
+                continue
+            if rank in self._lost_peers:
                 # peers already recovered away from (elastic reform) keep
-                # their final heartbeat file forever — not news
+                # their final heartbeat file forever — not news, UNLESS a
+                # HIGHER generation shows up: the rank's next life
+                # announcing itself (parallel/elastic grow)
+                if log:
+                    self._check_returned(rank, fs)
                 continue
             try:
                 hb = json.loads(fs.read_bytes(self._heartbeat_path(rank)))
@@ -720,6 +776,10 @@ class Supervisor:
             except Exception:  # noqa: BLE001 — a torn heartbeat write is
                 # transient; the next publish replaces it
                 continue
+            with self._lock:
+                # remember each live peer's generation: on a loss it is
+                # the baseline a RETURN must exceed
+                self._peer_gens[rank] = int(hb.get("generation", 0))
             if self.peer_lost > 0 and pub_age > self.peer_lost:
                 lost[rank] = pub_age
             if age > self.peer_stale:
@@ -746,6 +806,49 @@ class Supervisor:
             self._lost_candidates = lost
         return stale
 
+    def _check_returned(self, rank: int, fs) -> None:
+        """Detect a lost peer's RETURN: its heartbeat generation exceeds
+        the one its previous life left behind.  Observation only (plus
+        the once-per-episode ``on_peer_returned`` callback) — admission
+        happens at the optimizer's next checkpoint boundary, never from
+        the monitor thread."""
+        try:
+            hb = json.loads(fs.read_bytes(self._heartbeat_path(rank)))
+            gen = int(hb.get("generation", 0))
+        except Exception:  # noqa: BLE001 — torn write; next poll retries
+            return
+        with self._lock:
+            if gen <= self._lost_peers.get(rank, 0) or \
+                    rank in self._returned_peers:
+                return
+            self._returned_peers[rank] = gen
+        logger.warning("supervisor: peer host %d RETURNED — heartbeat "
+                       "generation %d supersedes its lost life; it can "
+                       "be admitted at the next checkpoint boundary",
+                       rank, gen)
+        from . import telemetry
+        telemetry.instant("elastic.peer_returned", cat="elastic",
+                          rank=rank, generation=gen)
+        if self.on_peer_returned is not None:
+            try:
+                self.on_peer_returned(rank, gen)
+            except Exception:  # noqa: BLE001 — observer only
+                logger.exception("on_peer_returned callback failed "
+                                 "(non-fatal)")
+
+    def returned_peers(self) -> Dict[int, int]:
+        """Lost peers that have published a NEWER-generation heartbeat
+        (rank -> generation) — returned hosts awaiting admission at the
+        next checkpoint boundary; cleared by :meth:`reform`."""
+        with self._lock:
+            return dict(self._returned_peers)
+
+    def peer_lost_pending(self) -> bool:
+        """True between a host-loss promotion and the reform() that
+        completes it — the window in which a join must be DEFERRED so
+        shrink and grow re-forms never interleave."""
+        return self._peer_lost_pending
+
     # -- elastic host-loss promotion (parallel/elastic) -----------------
 
     def _check_elastic(self, stale: Dict[int, float]) -> None:
@@ -757,6 +860,8 @@ class Supervisor:
         if self.peer_lost <= 0 or self.world <= 1 or not self.peer_dir \
                 or self._peer_lost_pending or not self.lineage_dir:
             return
+        if self.clock() < self._promotion_grace_until:
+            return  # post-reform rebuild window: observe, don't promote
         with self._lock:
             lost = {r: a for r, a in self._lost_candidates.items()
                     if r not in self._lost_peers}
@@ -798,14 +903,20 @@ class Supervisor:
                          "thread %s (already exited?)", tid)
 
     def reform(self, rank: int, world: int, epoch: int,
-               lost=()) -> None:
+               lost=(), returned=()) -> None:
         """Install the post-recovery topology (Optimizer._elastic_recover
-        step 3): the lost peers' frozen heartbeat files stop counting as
-        news, the completed recovery round is recorded, and promotion
-        re-arms for the NEXT loss."""
+        / _elastic_grow): the lost peers' frozen heartbeat files stop
+        counting as news (each recorded with the generation its RETURN
+        must exceed), `returned` ranks are re-admitted to the watch, the
+        completed recovery round is recorded, and promotion re-arms for
+        the NEXT loss."""
         with self._lock:
             self.rank, self.world = int(rank), int(world)
-            self._lost_peers |= {int(r) for r in lost}
+            for r in lost:
+                self._lost_peers[int(r)] = self._peer_gens.get(int(r), 0)
+            for r in returned:
+                self._lost_peers.pop(int(r), None)
+                self._returned_peers.pop(int(r), None)
             self._stale_peers = {r: a for r, a in self._stale_peers.items()
                                  if r not in self._lost_peers}
             self._lost_candidates = {
@@ -813,3 +924,6 @@ class Supervisor:
                 if r not in self._lost_peers}
         self.elastic_epoch = int(epoch)
         self._peer_lost_pending = False
+        # every member recompiles against the new mesh now — hold the
+        # next promotion until the rebuild window has passed
+        self._promotion_grace_until = self.clock() + self.reform_grace

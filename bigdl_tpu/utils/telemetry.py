@@ -416,7 +416,11 @@ def phase_breakdown(merged: dict) -> dict:
     - ``straggler_ranks``: ranks whose mean ``step`` span runs > 1.5x the
       median rank's (the one-slow-host signal);
     - ``instants``: count per instant-event name (chaos injections show up
-      here)."""
+      here);
+    - ``elastic``: the ``elastic.*`` instants keyed by suffix
+      (join/agree/reform/resume/…) plus ``joined`` — the last value of
+      the ``peers`` counter track, i.e. the world size after the most
+      recent shrink/grow (parallel/elastic.py)."""
     spans = [e for e in merged.get("traceEvents", [])
              if e.get("ph") == "X" and "dur" in e]
     by_name: Dict[str, List[float]] = {}
@@ -520,8 +524,19 @@ def phase_breakdown(merged: dict) -> dict:
     if deploy:
         deploy["events"] = sum(v for k, v in instants.items()
                                if k.startswith("deploy."))
+    # the elastic re-form track, promoted the same way: the `peers`
+    # counter's `joined` series carries the joined-rank count after every
+    # re-form (its LAST sample is the final world size) and the
+    # elastic.* instants are the protocol milestones — "did the run
+    # shrink and grow back?" becomes a report line (parallel/elastic)
+    elastic = {k[len("elastic."):]: v for k, v in instants.items()
+               if k.startswith("elastic.")}
+    joined = counters.get("peers.joined")
+    if joined is not None:
+        elastic["joined"] = int(joined["last"])
     return {"phases": phases, "ranks": ranks, "counters": counters,
             "aot": aot, "autoscale": autoscale, "deploy": deploy,
+            "elastic": elastic,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -580,6 +595,10 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
         lines.append("deploy: " + "  ".join(
             f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
             for k, v in sorted(breakdown["deploy"].items())))
+    if breakdown.get("elastic"):
+        lines.append("elastic: " + "  ".join(
+            f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(breakdown["elastic"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

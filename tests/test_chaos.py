@@ -500,6 +500,54 @@ def test_epoch_step_addressed_fault_fires_at_position():
         chaos.fire("data.batch")            # past it: clean again
 
 
+def test_host_return_grammar_roundtrip():
+    """`host.return@<rank>=join@epoch:iter` is the grow drill's gate: a
+    rank-addressed point with fault-schedule addressing but no fault
+    semantics — only gate() reports it, fire()/transform() ignore it."""
+    with chaos.scoped("host.return@1=join@2:2"):
+        assert chaos.armed("host.return@1")
+        assert not chaos.armed("host.return@0")  # unaddressed rank: inert
+        [s] = chaos._POINTS["host.return@1"].schedules
+        assert isinstance(s, chaos.ReturnAt)
+        assert s.positions == frozenset({(2, 2)}) and not s.counts
+    for spec in ("join@2:2", "return@2:2", "@2:2"):  # all spell ReturnAt
+        g = chaos._parse_action(spec)
+        assert isinstance(g, chaos.ReturnAt)
+        assert g.positions == frozenset({(2, 2)})
+    by_count = chaos._parse_action("return@3")       # count addressing too
+    assert by_count.counts == frozenset({3}) and by_count.fires(3)
+    with pytest.raises(ValueError):
+        chaos.install("host.return@1=join")          # no counts stays loud
+
+
+def test_host_return_gate_fires_at_or_after_position():
+    """gate() positions are AT-OR-AFTER (tuple order): the joiner POLLS
+    positions sampled from the checkpoint stream and may never observe
+    the exact coordinate — exact-match would be a silent never-fire."""
+    with chaos.scoped("host.return@1=join@2:2"):
+        assert not chaos.gate("host.return@1")   # no position published
+        chaos.at_position(1, 4)
+        assert not chaos.gate("host.return@1")   # before: held
+        chaos.at_position(2, 2)
+        assert chaos.gate("host.return@1")       # exact: fires
+        chaos.at_position(2, 9)
+        assert chaos.gate("host.return@1")       # after: still fires
+        chaos.at_position(3, 0)
+        assert chaos.gate("host.return@1")       # any later epoch too
+    with chaos.scoped("host.return@1=return@2"):
+        assert not chaos.gate("host.return@1")   # poll 1: not yet
+        assert chaos.gate("host.return@1")       # poll 2: count matches
+        assert not chaos.gate("host.return@1")   # counts stay EXACT
+    assert not chaos.gate("host.return@1")       # nothing installed: False
+
+
+def test_host_return_gate_never_faults_through_fire_or_transform():
+    with chaos.scoped("host.return@1=join@1"):
+        chaos.fire("host.return@1")              # count 1: would match...
+        payload = chaos.transform("host.return@1", b"abc")
+        assert payload == b"abc"                 # ...but gates never mutate
+
+
 def test_exit_at_engages_and_suspends_liveness(monkeypatch, tmp_path):
     """ExitAt must go publication-silent then hard-exit (monkeypatched:
     the test process stays alive) — the survivors' detection signal."""

@@ -147,6 +147,33 @@ def test_negotiate_drops_silent_survivor_after_timeout(tmp_path):
     assert plan.survivors == (0,)
 
 
+def test_joiner_divergent_tail_quarantined_then_adopted(tmp_path):
+    """GROW negotiation from the JOINER's seat: the returning rank's
+    previous life can hold lineage entries the survivor never saw
+    (written in the instants before it died).  The widened set agrees on
+    the newest COMMON entry, the leader (the lowest rank — a survivor)
+    quarantines the divergent tail, and the joiner ADOPTS the agreed
+    snapshot — never the reverse."""
+    _write_lineage(tmp_path, [3, 5, 8])
+    # survivor rank 0's published view: it never saw the joiner's 8
+    elastic.publish_lineage_view(str(tmp_path), 0, 7, [5, 3])
+    plan = elastic.negotiate(str(tmp_path), rank=1, survivors=[0, 1],
+                             epoch=7, my_valid=[8, 5, 3],
+                             timeout=1.0, poll=0.01)
+    assert plan.neval == 5 and plan.survivors == (0, 1)
+    assert plan.model_path.endswith("model.5")
+    # the joiner is NOT the leader: the tail is still intact here...
+    assert (tmp_path / "model.8").exists()
+    # ...until the survivor's own negotiate call (same round) runs
+    plan0 = elastic.negotiate(str(tmp_path), rank=0, survivors=[0, 1],
+                              epoch=7, my_valid=[5, 3],
+                              timeout=1.0, poll=0.01)
+    assert plan0.neval == 5
+    assert (tmp_path / "model.8.corrupt").exists()
+    assert not (tmp_path / "model.8").exists()
+    assert elastic.survey(str(tmp_path))[0] == 5  # every later resume agrees
+
+
 def test_stale_intents_from_previous_rounds_ignored(tmp_path):
     elastic.publish_intent(str(tmp_path), 1, epoch=1, lost=[2],
                            wall_time=0.0)
@@ -352,6 +379,204 @@ def test_suspend_heartbeat_stops_publication(tmp_path):
     assert open(hb).read() == first
 
 
+def test_supervisor_peer_returned_once_per_episode(tmp_path):
+    """A lost peer's RETURN (a generation-bumped heartbeat) is observed
+    exactly once per episode: on_peer_returned fires once, the rank
+    parks in returned_peers() until reform(returned=...) re-admits it to
+    the live watch — admission itself happens at the optimizer's next
+    checkpoint boundary, never from the monitor thread."""
+    ckpt = str(tmp_path)
+    wall = {"now": 1000.0}
+    dead = _lost_supervisor(ckpt, 1, wall)
+    dead.beat("step")
+    dead._publish_heartbeat()                        # generation-0 life
+    seen = []
+    sup = _lost_supervisor(
+        ckpt, 0, wall,
+        on_peer_returned=lambda r, g: seen.append((r, g)))
+    sup._check_peers(log=True)                       # baseline generation
+    sup.reform(rank=0, world=1, epoch=1, lost=[1])   # shrink completed
+    sup._check_peers(log=True)                       # frozen file: not news
+    assert seen == [] and sup.returned_peers() == {}
+    elastic.announce_join(ckpt, 1, wall["now"])      # next life: gen 1
+    sup._check_peers(log=True)
+    sup._check_peers(log=True)                       # same episode: silent
+    assert seen == [(1, 1)]
+    assert sup.returned_peers() == {1: 1}
+    sup.reform(rank=0, world=2, epoch=2, returned=[1])
+    assert sup.returned_peers() == {}
+    # re-admitted to the live watch: staleness applies to it again
+    wall["now"] = 1020.0
+    assert sup._check_peers(log=False)[1] == pytest.approx(20.0)
+
+
+def test_reform_grace_holds_promotion_then_rearms(tmp_path):
+    """Every member recompiles its jitted step right after a re-form; a
+    compile can starve the monitor past a tight peer_lost threshold.
+    reform() therefore arms a detection-grace window: silence inside it
+    is observed, never promoted — and promotion re-arms after it."""
+    ckpt = str(tmp_path)
+    wall = {"now": 1000.0}
+    dead = _lost_supervisor(ckpt, 1, wall)
+    dead.beat("step")
+    dead._publish_heartbeat()
+    mono = {"t": 100.0}
+    sup = _lost_supervisor(ckpt, 0, wall, clock=lambda: mono["t"])
+    sup._thread_id = 1 << 30  # raise delivery is another test's business
+    sup.reform(rank=0, world=2, epoch=0)             # arms the grace
+    assert sup._promotion_grace_until == pytest.approx(
+        100.0 + sup.reform_grace)
+    wall["now"] = 1030.0                             # silent 30s > lost 10s
+    sup._check_elastic(sup._check_peers(log=False))  # inside grace: held
+    assert elastic.read_intents(ckpt, min_epoch=1) == {}
+    assert not sup.peer_lost_pending()
+    mono["t"] += sup.reform_grace + 0.1              # grace expired
+    sup._check_elastic(sup._check_peers(log=False))  # now it promotes
+    assert elastic.read_intents(ckpt, min_epoch=1)[0]["lost"] == [1]
+    assert sup.peer_lost_pending()
+
+
+# ---------------------------------------------------------------------------
+# GROW: join intents, announcement hygiene, admission (pure file_io)
+# ---------------------------------------------------------------------------
+
+def test_join_intent_roundtrip_and_clear(tmp_path):
+    ckpt = str(tmp_path)
+    elastic.publish_join_intent(ckpt, 1, 5.0, generation=3)
+    intents = elastic.read_join_intents(ckpt)
+    assert intents[1]["generation"] == 3 and intents[1]["rank"] == 1
+    # own intent excluded (a joiner never admits itself)
+    assert elastic.read_join_intents(ckpt, exclude_rank=1) == {}
+    elastic.clear_join_intent(ckpt, 1)
+    assert elastic.read_join_intents(ckpt) == {}
+    elastic.clear_join_intent(ckpt, 1)  # consuming twice is harmless
+
+
+def test_announce_join_hygiene_and_generation_bump(tmp_path):
+    """The returning rank's previous life left a frozen heartbeat and
+    stale protocol files; announce_join must bump the heartbeat
+    GENERATION past the old one, delete the stale recover./lineage.
+    views, and record the grow floor BEFORE publishing the intent."""
+    ckpt = str(tmp_path)
+    hb_dir = tmp_path / "heartbeats"
+    hb_dir.mkdir()
+    (hb_dir / "heartbeat.1").write_text(json.dumps(
+        {"rank": 1, "phase": "step", "count": 7, "time": 1.0,
+         "published": 1.0, "generation": 3}))
+    elastic.publish_intent(ckpt, 1, epoch=1, lost=[0], wall_time=1.0)
+    elastic.publish_lineage_view(ckpt, 1, 1, [5, 3])
+    elastic.publish_grow_offer(ckpt, 0, 2, [0, 1], 1.0)  # older episode
+    info = elastic.announce_join(ckpt, 1, 9.0)
+    assert info == {"generation": 4, "floor": 2}
+    edir = tmp_path / "elastic"
+    assert not (edir / "recover.1").exists()   # stale previous-life view
+    assert not (edir / "lineage.1").exists()
+    hb = json.loads((hb_dir / "heartbeat.1").read_text())
+    assert hb["generation"] == 4 and hb["phase"] == "join"
+    assert elastic.read_join_intents(ckpt)[1]["generation"] == 4
+    # a genuinely NEW rank announces at generation 1 with no floor
+    fresh = elastic.announce_join(str(tmp_path / "other"), 0, 9.0)
+    assert fresh == {"generation": 1, "floor": 0}
+
+
+def test_death_certificate_and_previous_generation(tmp_path):
+    """A RETURNING rank (previous_generation is not None) must hold its
+    announcement until a survivor's recovery round declares it lost — a
+    generation-bumped fresh heartbeat would otherwise reset the very
+    publication silence the loss is detected by, and the shrink this
+    grow stacks on would never run."""
+    ckpt = str(tmp_path)
+    assert elastic.previous_generation(ckpt, 1) is None   # a NEW rank
+    assert elastic.death_certificate(ckpt, 1) == 0        # not declared
+    wall = {"now": 10.0}
+    dead = _lost_supervisor(ckpt, 1, wall)
+    dead.beat("step")
+    dead._publish_heartbeat()
+    assert elastic.previous_generation(ckpt, 1) == 0      # a previous life
+    elastic.publish_intent(ckpt, 0, epoch=3, lost=[1], wall_time=10.0)
+    assert elastic.death_certificate(ckpt, 1) == 3
+    assert elastic.death_certificate(ckpt, 0) == 0        # not this rank
+    # rounds at or below the grow floor are a PREVIOUS episode's news
+    assert elastic.death_certificate(ckpt, 1, floor=3) == 0
+    assert elastic.death_certificate(ckpt, 1, floor=2) == 3
+
+
+def test_grow_offer_floor_and_wait_for_admission(tmp_path):
+    ckpt = str(tmp_path)
+    assert elastic.latest_grow_epoch(ckpt) == 0
+    elastic.publish_grow_offer(ckpt, 0, 2, [0, 1], 1.0)
+    elastic.publish_grow_offer(ckpt, 0, 5, [0, 2], 2.0)
+    assert elastic.latest_grow_epoch(ckpt) == 5
+    # newest offer above the floor NAMING the rank, or nothing
+    assert elastic.read_grow_offer(ckpt, min_epoch=0, rank=1)["epoch"] == 2
+    assert elastic.read_grow_offer(ckpt, min_epoch=2, rank=1) is None
+    assert elastic.read_grow_offer(ckpt, min_epoch=0, rank=2)["epoch"] == 5
+    got = elastic.wait_for_admission(ckpt, 2, floor=2, timeout=1.0,
+                                     poll=0.01)
+    assert got["epoch"] == 5 and got["survivors"] == [0, 2]
+    # typed failure — never a hang — when no survivor answers (injected
+    # clock: zero wall-time waiting)
+    fake = {"t": 0.0}
+    with pytest.raises(elastic.ElasticJoinError, match="no survivor"):
+        elastic.wait_for_admission(
+            ckpt, 7, floor=5, timeout=30.0, poll=1.0,
+            clock=lambda: fake["t"],
+            sleep=lambda s: fake.__setitem__("t", fake["t"] + s))
+    assert fake["t"] >= 30.0
+
+
+def test_cluster_position_reads_newest_loadable_driver_state(tmp_path):
+    """cluster_position is the joiner's gate coordinate: the newest
+    loadable snapshot's (epoch, neval) — stored already incremented to
+    the NEXT iteration, the exact coordinate chaos.at_position
+    publishes, so host.return@rank=@epoch:iteration gates line up."""
+    assert elastic.cluster_position(str(tmp_path)) is None
+    file_io.save_checkpoint(str(tmp_path), 4,
+                            {"params": {}, "state": {}},
+                            {"method": {},
+                             "driver_state": {"epoch": 2, "neval": 5}})
+    assert elastic.cluster_position(str(tmp_path)) == (2, 5)
+    # an entry without a position is skipped, the older one still answers
+    file_io.save_checkpoint(str(tmp_path), 9,
+                            {"params": {}, "state": {}},
+                            {"method": {}, "driver_state": {}})
+    assert elastic.cluster_position(str(tmp_path)) == (2, 5)
+
+
+def test_join_deferred_during_inflight_shrink(tmp_path, monkeypatch):
+    """A join intent observed while a SHRINK promotion is pending must
+    be DEFERRED (not dropped): re-forms never interleave.  Once the
+    shrink's reform completes, the same boundary check raises the
+    planned _ElasticJoinSignal — internal control flow that consumes no
+    retry budget."""
+    from bigdl_tpu.optim.optimizer import _ElasticJoinSignal
+    monkeypatch.setenv("BIGDL_TPU_ELASTIC_WORLD", "2")
+    monkeypatch.setenv("BIGDL_TPU_ELASTIC_RANK", "0")
+    monkeypatch.setenv("BIGDL_TPU_ELASTIC_PEER_LOST", "3600")
+    opt = (Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                     nn.CrossEntropyCriterion())
+           .set_checkpoint(str(tmp_path), Trigger.every_epoch()))
+    try:
+        Engine.reform(world=1, rank=0, survivors=[0])  # post-shrink world
+        elastic.publish_join_intent(str(tmp_path), 1, 0.0, generation=1)
+        sup = Supervisor({}, peer_dir=os.path.join(str(tmp_path),
+                                                   "heartbeats"),
+                         rank=0, world=1, publish_interval=0.0)
+        opt._sup = sup
+        sup.hold_elastic()                    # an in-flight shrink round
+        opt._check_join(None)                 # deferred: no signal
+        sup.reform(rank=0, world=1, epoch=1, lost=[1])  # shrink done
+        with pytest.raises(_ElasticJoinSignal) as ei:
+            opt._check_join(None)
+        assert ei.value.joiners == (1,)
+        # an intent from THIS rank is excluded outright
+        elastic.clear_join_intent(str(tmp_path), 1)
+        elastic.publish_join_intent(str(tmp_path), 0, 0.0, generation=1)
+        opt._check_join(None)                 # no signal
+    finally:
+        Engine.reset()
+
+
 # ---------------------------------------------------------------------------
 # re-form: Engine topology + sharding remap + batch rescale
 # ---------------------------------------------------------------------------
@@ -385,6 +610,47 @@ def test_engine_reform_device_subset_rebuilds_mesh():
                          devices=jax.devices()[:4])
     assert mesh.shape["data"] == 4
     assert Engine.mesh() is mesh
+
+
+def test_mesh_reform_error_when_widened_world_breaks_shard_groups():
+    """Widening must keep the non-data shard block intact or fail TYPED
+    (MeshReformError) — never silently re-lay-out sharded parameters."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel.layout import MeshReformError
+    Engine.init()
+    devs = jax.devices()
+    narrow = Mesh(np.array(devs[:4]).reshape(1, 4), ("data", "fsdp"))
+    # the happy widen: data 1 -> 2, the fsdp block of 4 preserved
+    wide = Engine._reform_data_axis(narrow, devs[:8])
+    assert wide.shape["data"] == 2 and wide.shape["fsdp"] == 4
+    # 2x3 -> 8 devices: 8 % 3 != 0, the fsdp groups cannot survive
+    mesh = Mesh(np.array(devs[:6]).reshape(2, 3), ("data", "fsdp"))
+    with pytest.raises(MeshReformError, match="must divide"):
+        Engine._reform_data_axis(mesh, devs[:8])
+    # no data axis at all: nothing to widen
+    flat = Mesh(np.array(devs[:4]), ("fsdp",))
+    with pytest.raises(MeshReformError, match="no 'data' axis"):
+        Engine._reform_data_axis(flat, devs[:8])
+
+
+def test_sharding_remap_widens_zero_params_value_equal():
+    """Grow direction: ZeRO slots sharded 1/1 re-place to 1/2 with
+    identical values — the joiner-admission re-slice of _elastic_grow."""
+    import jax
+    from jax.sharding import Mesh
+
+    strategy = ShardedDataParallel(min_size=1)
+    one = Mesh(np.array(jax.devices()[:1]), ("data",))
+    two = Mesh(np.array(jax.devices()[:2]), ("data",))
+    params = {"w": np.arange(32.0, dtype=np.float32).reshape(4, 8),
+              "b": np.arange(8.0, dtype=np.float32)}
+    placed = strategy.remap(one, params)
+    widened = strategy.remap(two, placed)
+    assert widened["w"].sharding.mesh.shape["data"] == 2
+    np.testing.assert_array_equal(np.asarray(widened["w"]), params["w"])
+    np.testing.assert_array_equal(np.asarray(widened["b"]), params["b"])
 
 
 def test_sharding_remap_reslices_zero_params():
@@ -430,6 +696,22 @@ def test_rescale_batches_ceil_rounding_rule():
     assert b.batch_size == math.ceil(64 / 3) == 22
     opt._rescale_batches(3, 3)           # no-op on equal worlds
     assert b.batch_size == 22
+
+
+def test_rescale_batches_grow_restores_configured_value():
+    """The grow invariant: after a shrink DOUBLES the per-host batch, a
+    grow back to the original world returns it exactly to the configured
+    value (the shrink/grow round-trip is lossless), and ceil rounding
+    applies in the grow direction too."""
+    opt = Optimizer(nn.Sequential().add(nn.Linear(6, 2)), _dataset(),
+                    nn.CrossEntropyCriterion())
+    b = opt._find_batchers(opt.dataset)[0]
+    opt._rescale_batches(2, 1)           # shrink: 16*2=32 over 1
+    assert b.batch_size == 32
+    opt._rescale_batches(1, 2)           # grow back: 32 over 2 -> 16
+    assert b.batch_size == 16
+    opt._rescale_batches(2, 3)           # widen past it: ceil(32/3) = 11
+    assert b.batch_size == math.ceil(32 / 3) == 11
 
 
 # ---------------------------------------------------------------------------
@@ -536,3 +818,41 @@ def test_elastic_drill_two_ranks_end_to_end(tmp_path):
     assert out["neval_resumed"] >= 1
     snaps = glob.glob(os.path.join(str(tmp_path), "ckpt", "model.*"))
     assert snaps, "drill left no lineage behind"
+
+
+def test_elastic_grow_drill_two_ranks_end_to_end(tmp_path):
+    """THE acceptance drill (ISSUE 16): kill-then-return in ONE run.
+    Chaos kills rank 1 mid-epoch (world 2 -> 1, per-host batch doubles);
+    the same rank re-spawns as a joiner, waits for its own death
+    certificate, announces via host.return@1 chaos gating, and is
+    admitted at the next checkpoint boundary (world 1 -> 2, batch back
+    down).  The release feed must stay gap-free across BOTH resizes with
+    promotions after the grow, and both ranks must bit-match a clean
+    world-2 run resumed from the join snapshot.  Driven through
+    tools/elastic_smoke.py --grow — the runbook's cpu-smoke stage 2p."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, "tools",
+                                      "elastic_smoke.py"),
+         "--grow", "--platform", "cpu", "--ckpt-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "PYTHONPATH": _REPO_ROOT})
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON from the drill:\n{proc.stderr[-3000:]}"
+    out = json.loads(lines[-1])
+    assert proc.returncode == 0, out
+    assert out["recovered"] is True and out["joined"] is True
+    assert out["rank1_rc"] == 117            # chaos ExitAt's drill code
+    # the survivor lived through shrink THEN grow, batch 16 -> 32 -> 16
+    assert [h["kind"] for h in out["history_rank0"]] == ["shrink", "grow"]
+    assert [h["world"] for h in out["history_rank0"]] == [1, 2]
+    assert [h["batch"] for h in out["history_rank0"]] == [32, 16]
+    assert [h["kind"] for h in out["history_joiner"]] == ["join"]
+    # both ranks' final params bit-match the clean world-2 resume
+    assert out["loss_match"] is True
+    # the deployment loop never saw a gap or a rejection, and promoted
+    # a release published AFTER the grow
+    assert out["release_gap_free"] is True and out["rejected"] == 0
+    assert out["promoted_after_grow"] >= 1
+    for events in out["elastic_events"].values():
+        assert {"elastic.join", "elastic.agree", "elastic.reform",
+                "elastic.resume"} <= set(events)

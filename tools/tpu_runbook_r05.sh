@@ -287,6 +287,24 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] continuous smoke FAILED rc=$CONT_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # elastic GROW smoke (cpu only): the scale-UP drill — chaos kills
+  # rank 1 mid-epoch (world 2 -> 1, per-host batch doubles), the same
+  # rank returns with BIGDL_TPU_ELASTIC_JOIN=1 and chaos-gated timing,
+  # waits for its own death certificate, announces, and is admitted at
+  # the next checkpoint boundary (world 1 -> 2, batch back down); the
+  # release feed must stay gap-free across BOTH resizes with promotions
+  # after the grow, and both ranks must bit-match a clean world-2 run
+  # resumed from the join snapshot; one JSON line, exit-coded
+  echo "[runbook] 2p/4 elastic grow smoke (kill -> return -> join -> bit-match)" >> "$LOG"
+  timeout 300 python tools/elastic_smoke.py --grow --platform cpu \
+    > /tmp/elastic_grow_smoke.json 2>/tmp/elastic_grow_smoke.log
+  GROW_RC=$?
+  if [ "$GROW_RC" = 0 ]; then
+    echo "[runbook] elastic grow smoke OK (world 2->1->2, gap-free releases, bit-match) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] elastic grow smoke FAILED rc=$GROW_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -315,7 +333,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi

@@ -16,7 +16,11 @@ autoscaler's track and the continuous-deployment ``deploy`` track
 (publishes from the trainer rank, deploy/promote/rollback/reject totals
 from the controller — serve/continuous.py) are promoted to their own
 sections the same way, so a merged trainer+server trace shows training
-steps, publishes, and promotions on one timeline.
+steps, publishes, and promotions on one timeline.  Elastic episodes get
+the same treatment: the ``elastic:`` line counts the ``elastic.*``
+instants (detect/negotiate/agree/join/reform/resume) and reports
+``joined`` — the last value of the ``peers`` counter track, the world
+size after the most recent shrink or grow (parallel/elastic.py).
 
 Usage::
 
