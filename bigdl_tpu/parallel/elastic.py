@@ -460,14 +460,27 @@ def clear_join_intent(ckpt_path: str, rank: int) -> None:
                        "%d: %s", rank, e)
 
 
+def protocol_keep() -> int:
+    """Writer-side retention bound for numbered protocol files
+    (``grow.<epoch>`` here, ``member.<idx>.<generation>`` in
+    serve/fleet): generations kept beyond the current one."""
+    return config.get_int("PROTOCOL_KEEP", 8)
+
+
 def publish_grow_offer(ckpt_path: str, rank: int, epoch: int,
                        survivors: Sequence[int], wall_time: float) -> str:
     """The WRITER's admission offer for grow round `epoch`: the widened
-    survivor set every party (joiner included) negotiates over."""
-    return _write_json(elastic_dir(ckpt_path), f"grow.{int(epoch)}",
+    survivor set every party (joiner included) negotiates over.  The
+    writer also sweeps offers from long-dead rounds (keep the newest
+    ``BIGDL_TPU_PROTOCOL_KEEP``) — without it a long-lived cluster
+    accumulates one ``grow.<epoch>`` per grow episode forever."""
+    base = elastic_dir(ckpt_path)
+    path = _write_json(base, f"grow.{int(epoch)}",
                        {"epoch": int(epoch), "rank": int(rank),
                         "survivors": sorted(int(r) for r in survivors),
                         "time": float(wall_time)})
+    file_io.sweep_numbered(base, r"grow\.(\d+)", keep=protocol_keep())
+    return path
 
 
 def latest_grow_epoch(ckpt_path: str) -> int:

@@ -25,7 +25,16 @@ loop: the trainer's checkpoint path publishes CRC-framed release
 entries and a `DeployController` watches the lineage, verifies each
 entry, canaries it into the live server and promotes or rolls back on
 the control plane's comparator — with a bounded consecutive-rollback
-budget and a full model-version timeline (docs/continuous.md).  See
+budget and a full model-version timeline (docs/continuous.md).  The
+fleet layer (serve/fleet.py + serve/fleetfront.py) lifts the replica
+state machine to OS PROCESSES: worker processes
+(tools/serve_worker.py) register CRC-framed member records + liveness
+heartbeats into a shared fleet dir (the same file_io plumbing elastic
+training trusts), a `FleetSupervisor` condemns silent members by
+generation bump and respawns them warm through the shared AOT cache
+within a restart budget, and a `FleetFront` routes by (bucket, member
+queue depth) over HTTP with bounded retry-on-next-member and rolling
+`swap` fan-out for the DeployController's fleet mode.  See
 docs/serving.md.
 """
 
@@ -37,6 +46,8 @@ from .continuous import (DeployController, ReleasePublisher,
                          ReleaseRejected, read_release)
 from .control import (CanaryController, CanaryRejected, QuotaExceeded,
                       ReplicaLostError, ReplicaMonitor, TenantQuotas)
+from .fleet import FleetSupervisor, MemberLostError
+from .fleetfront import FleetFront
 from .router import PlacementError, TopologyRouter, plan_subsets
 from .server import InferenceServer, ModelVersion
 from .tracefile import (TraceEvent, TraceFormatError, TraceRecorder,
@@ -54,4 +65,5 @@ __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "TraceRecorder", "read_trace", "write_trace", "replay",
            "resolve_outcomes", "slo_report",
            "DeployController", "ReleasePublisher", "ReleaseRejected",
-           "read_release"]
+           "read_release",
+           "FleetSupervisor", "FleetFront", "MemberLostError"]

@@ -56,6 +56,7 @@ Knobs (utils/config tier; constructor args override):
 | ``BIGDL_TPU_DEPLOY_ROLLBACK_BUDGET`` | consecutive canary rollbacks before the controller freezes | 2 |
 | ``BIGDL_TPU_DEPLOY_POLL_S`` | lineage poll cadence, seconds | 0.25 |
 | ``BIGDL_TPU_DEPLOY_DECISION_TIMEOUT`` | seconds to wait a canary verdict out; past it the controller freezes (0 = wait forever) | 0 |
+| ``BIGDL_TPU_DEPLOY_MAX_UNAVAILABLE`` | fleet mode: members concurrently in-swap during the rolling fan-out (serve/fleetfront.py) | 1 |
 
 See docs/continuous.md for the architecture, the release-entry schema
 and the promote/rollback/freeze decision tree.
@@ -215,9 +216,18 @@ class DeployController:
                  rollback_budget: Optional[int] = None,
                  poll_s: Optional[float] = None,
                  decision_timeout: Optional[float] = None,
+                 max_unavailable: Optional[int] = None,
                  since: int = 0, clock=None,
                  timeline_limit: int = 256):
         self.server = server
+        #: fleet mode: a serving target declaring ``fleet = True``
+        #: (serve/fleetfront.FleetFront) gets releases fanned out
+        #: member-by-member — canary on member 0, then rolling swaps
+        #: with at most `max_unavailable` members in-swap at a time
+        self.fleet_mode = bool(getattr(server, "fleet", False))
+        self.max_unavailable = max(1, int(
+            max_unavailable if max_unavailable is not None
+            else config.get_int("DEPLOY_MAX_UNAVAILABLE", 1)))
         self.dir = file_io._strip_file_scheme(str(lineage_dir))
         f = (canary_fraction if canary_fraction is not None
              else config.get_float("DEPLOY_CANARY_FRACTION", 0.25))
@@ -366,10 +376,16 @@ class DeployController:
 
     def _deploy(self, rid: int, entry: dict) -> None:
         fraction = self.canary_fraction
-        vid = self.server.swap(entry["_model_path"],
-                               canary_fraction=fraction)
+        kwargs = {"canary_fraction": fraction}
+        if self.fleet_mode:
+            # FleetFront.swap canaries member 0, waits the member's own
+            # comparator out, then rolls the rest with this bound — the
+            # verdict lands in stats()["canary"] for _await_decision
+            kwargs["max_unavailable"] = self.max_unavailable
+        vid = self.server.swap(entry["_model_path"], **kwargs)
         self._record("deployed", rid, version=vid,
-                     neval=entry.get("neval"))
+                     neval=entry.get("neval"),
+                     **({"fleet": True} if self.fleet_mode else {}))
         if fraction is None:
             # plain full swap: live immediately, nothing to observe
             with self._lock:
@@ -425,11 +441,13 @@ class DeployController:
     # -- timeline / stats -----------------------------------------------
 
     def _record(self, action: str, rid: int, *, version=None, neval=None,
-                reason=None, verdict=None) -> None:
+                reason=None, verdict=None, fleet=None) -> None:
         ev = {"release": int(rid), "action": action,
               "time": round(time.time(), 3)}
         if version is not None:
             ev["version"] = int(version)
+        if fleet:
+            ev["fleet"] = True
         if neval is not None:
             ev["neval"] = int(neval)
         if reason is not None:

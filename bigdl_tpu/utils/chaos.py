@@ -28,6 +28,7 @@ Fault points wired into the runtime:
 | ``host.lost@<rank>`` | once per train iteration on rank `<rank>` (driver loop) | exit/wedge |
 | ``host.return@<rank>`` | once per announce poll in rank `<rank>`'s joiner loop (parallel/elastic grow) | join (gate) |
 | ``deploy.publish`` | once per release-entry write (serve/continuous) | corrupt   |
+| ``fleet.member@<idx>`` | once per heartbeat loop turn in fleet worker `<idx>`'s process (tools/serve_worker) | exit/wedge (process-scoped) |
 
 Schedules (1-based counts):
 
@@ -112,7 +113,8 @@ __all__ = ["ChaosFault", "FailAt", "FailN", "CorruptAt", "StallAt",
 FAULT_POINTS = ("ckpt.write", "ckpt.read", "fs.remote", "data.batch",
                 "step.loss_nan", "data.record", "data.stall", "step.stall",
                 "serve.request", "serve.batch", "serve.replica",
-                "serve.canary", "host.lost", "host.return")
+                "serve.canary", "host.lost", "host.return",
+                "fleet.member")
 
 #: the driver loop's current (epoch, neval), published once per iteration
 #: via at_position() — the coordinate ``@epoch:iteration`` addresses match

@@ -72,6 +72,19 @@ CLIs live in models/run.py and tools/.
 | BIGDL_TPU_DEPLOY_ROLLBACK_BUDGET | (net-new: consecutive canary rollbacks before the deploy controller freezes unhealthy instead of flapping) | 2 |
 | BIGDL_TPU_DEPLOY_POLL_S | (net-new: release-lineage poll cadence, seconds; the watch itself backs off on the IO knobs when polled without one) | 0.25 |
 | BIGDL_TPU_DEPLOY_DECISION_TIMEOUT | (net-new: seconds to wait a canary verdict out before freezing; 0 = wait forever) | 0 (off) |
+| BIGDL_TPU_DEPLOY_MAX_UNAVAILABLE | (net-new: fleet mode — members concurrently in-swap during a rolling release fan-out; serve/fleetfront.py) | 1 |
+| BIGDL_TPU_FLEET_MEMBER_LOST | (net-new: cross-process fleet, serve/fleet.py — seconds of member heartbeat-publication silence before the supervisor condemns + respawns it) | 5.0 |
+| BIGDL_TPU_FLEET_RESTART_BUDGET | (net-new: respawns allowed per fleet member slot before it degrades to the survivors) | 3 |
+| BIGDL_TPU_FLEET_RESTART_BACKOFF | (net-new: first member respawn delay, seconds, doubling per consecutive restart) | 0.5 |
+| BIGDL_TPU_FLEET_POLL | (net-new: fleet supervisor monitor poll cadence, seconds) | 0.5 |
+| BIGDL_TPU_FLEET_SPAWN_GRACE | (net-new: seconds a fresh worker spawn may take to publish its first heartbeat before silence counts) | 30.0 |
+| BIGDL_TPU_FLEET_HEARTBEAT | (net-new: fleet worker beat interval, seconds; tools/serve_worker.py) | 0.5 |
+| BIGDL_TPU_FLEET_KEEP_GENERATIONS | (net-new: member-record generations kept per index by the writer-side retention sweep) | 4 |
+| BIGDL_TPU_FLEET_TIMEOUT_S | (net-new: fleet front tier, serve/fleetfront.py — per-member HTTP request timeout, seconds) | 60 |
+| BIGDL_TPU_FLEET_RETRIES | (net-new: retry-on-next-member attempts after the first, idempotent predicts only) | 2 |
+| BIGDL_TPU_FLEET_REFRESH_S | (net-new: fleet registry cache refresh interval, seconds) | 0.25 |
+| BIGDL_TPU_FLEET_MAX_UNAVAILABLE | (net-new: front-tier default for members concurrently in-swap during a rolling deploy) | 1 |
+| BIGDL_TPU_PROTOCOL_KEEP | (net-new: numbered protocol files — elastic grow offers — kept by the writer-side retention sweep, file_io.sweep_numbered) | 8 |
 """
 
 from __future__ import annotations

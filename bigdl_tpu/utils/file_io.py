@@ -775,6 +775,50 @@ def prune_checkpoints(path: str, keep_last: int, keep=()) -> list:
     return pruned
 
 
+def sweep_numbered(path: str, pattern: str, keep: int) -> list:
+    """Writer-side retention for numbered protocol files: delete every
+    file under `path` whose NAME fullmatches `pattern` (group 1 = the
+    monotonic integer id) beyond the newest `keep` ids.
+
+    The heartbeat/registry protocols (parallel/elastic grow offers,
+    serve/fleet member records) stamp a new id per round/generation and
+    never delete — without a sweep a long-lived dir accumulates one file
+    per restart forever.  The WRITER sweeps right after publishing (it
+    owns the names it stamps); readers only ever want the newest few, so
+    keeping `keep` generations leaves every concurrent reader a
+    consistent window.  Quarantined ``.corrupt`` files never fullmatch
+    and are never touched.  Best-effort: a failed delete is logged, not
+    raised.  Returns the removed names."""
+    if keep <= 0:
+        return []
+    path = _strip_file_scheme(path)
+    fs = get_filesystem(path)
+    matcher = re.compile(pattern)
+    try:
+        names = fs.listdir(path) if fs.isdir(path) else []
+    except Exception:  # noqa: BLE001 — nothing to sweep in an
+        # unreachable/absent dir; the next publish retries
+        return []
+    found = {}
+    for name in names:
+        m = matcher.fullmatch(name)
+        if m:
+            found[int(m.group(1))] = name
+    removed = []
+    for n in sorted(found, reverse=True)[keep:]:
+        target = _join(path, found[n])
+        try:
+            fs.remove(target)
+            removed.append(found[n])
+        except Exception as e:  # noqa: BLE001 — retention is best-effort
+            logger.warning("retention: could not sweep %s: %s", target, e)
+    if removed:
+        logger.info("retention: swept %d stale protocol file(s) from %s "
+                    "(keep=%d): %s", len(removed), path, keep,
+                    sorted(removed))
+    return removed
+
+
 class File:
     """Namespace parity with the reference's `File` object."""
 

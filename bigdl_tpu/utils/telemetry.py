@@ -534,9 +534,23 @@ def phase_breakdown(merged: dict) -> dict:
     joined = counters.get("peers.joined")
     if joined is not None:
         elastic["joined"] = int(joined["last"])
+    # the cross-process fleet track, promoted the same way: the
+    # supervisor's `fleet` counter (live/restarts/degraded, last values
+    # are the final state) plus the fleet.* instants (spawn/lost/
+    # condemn/respawn/deploy milestones across supervisor, front tier,
+    # and every worker process) — "did the fleet lose, replace, and
+    # re-deploy members?" becomes a report line spanning every member's
+    # trace (serve/fleet.py, serve/fleetfront.py)
+    fleet = {series[len("fleet."):]: st["last"]
+             for series, st in counters.items()
+             if series.startswith("fleet.")}
+    fleet_events = sum(v for k, v in instants.items()
+                       if k.startswith("fleet."))
+    if fleet or fleet_events:
+        fleet["events"] = fleet_events
     return {"phases": phases, "ranks": ranks, "counters": counters,
             "aot": aot, "autoscale": autoscale, "deploy": deploy,
-            "elastic": elastic,
+            "elastic": elastic, "fleet": fleet,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -599,6 +613,10 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
         lines.append("elastic: " + "  ".join(
             f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
             for k, v in sorted(breakdown["elastic"].items())))
+    if breakdown.get("fleet"):
+        lines.append("fleet: " + "  ".join(
+            f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(breakdown["fleet"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

@@ -157,6 +157,56 @@ def test_watch_lineage_idle_backoff_and_timeout(tmp_path):
     assert max(delays) <= 2.0             # capped at IO_BACKOFF_MAX
 
 
+def test_watch_lineage_absorbs_transient_remote_faults():
+    """fail*2 inside the listing window: the IO retry layer absorbs the
+    faults BELOW the watch — every release id still comes out, in order,
+    none skipped, and nothing healthy gets quarantined."""
+    d = f"memory://watch_chaos_{os.getpid()}"
+    fs = file_io.get_filesystem(d)
+    fs.makedirs(d)
+    for i in (1, 2, 3):
+        fs.write_bytes(f"{d}/release.{i}", b"r%d" % i)
+    got = []
+    with chaos.scoped("fs.remote=fail*2@2"):
+        for n, _p in file_io.watch_lineage(
+                d, since=0, pattern=RELEASE_PATTERN, poll=0,
+                sleep=lambda s: None, stop=lambda: len(got) >= 4):
+            got.append(n)
+            if n == 3:  # keep publishing THROUGH the chaos window
+                fs.write_bytes(f"{d}/release.4", b"r4")
+    assert got == [1, 2, 3, 4]
+    assert not [n for n in fs.listdir(d) if n.endswith(".corrupt")]
+
+
+def test_watch_lineage_survives_retry_exhaustion():
+    """A fault burst LONGER than the per-op retry budget: the failed
+    listings read as empty polls (warn, not crash), and once the burst
+    drains every id is yielded exactly once — no skips, no false
+    quarantine, no dead watch."""
+    d = f"memory://watch_burst_{os.getpid()}"
+    fs = file_io.get_filesystem(d)
+    fs.makedirs(d)
+    fs.write_bytes(f"{d}/release.1", b"a")
+    fs.write_bytes(f"{d}/release.2", b"b")
+    got, polls = [], [0]
+
+    def stop():
+        polls[0] += 1
+        assert polls[0] < 200, "watch never recovered from the burst"
+        return len(got) >= 2
+
+    # IO_RETRIES=3 -> 4 attempts per op: 8 faults = two full polls where
+    # even the retried listing fails, then storage heals
+    with chaos.scoped("fs.remote=fail*8@1"):
+        for n, _p in file_io.watch_lineage(
+                d, since=0, pattern=RELEASE_PATTERN, poll=0,
+                sleep=lambda s: None, stop=stop):
+            got.append(n)
+    assert got == [1, 2]
+    assert polls[0] > 2  # the burst really cost empty polls first
+    assert not [n for n in fs.listdir(d) if n.endswith(".corrupt")]
+
+
 def test_frame_fingerprint(tmp_path):
     p = tmp_path / "blob"
     file_io.save({"w": np.arange(8.0)}, str(p))

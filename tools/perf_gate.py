@@ -50,7 +50,10 @@ utils/hlostats.py):
    router's per-request (bucket, queue-depth) routing decision
    (``TopologyRouter._pick``) over a 4-member pool, bounded in host
    microseconds — the tax scale-out routing adds in front of every
-   request must stay negligible.
+   request must stay negligible.  The cross-process fleet front
+   (ISSUE 17) pins the same decision computed off the cached member
+   registry (``FleetFront._pick``) — a cache-bypass regression that
+   re-lists the registry per request fails the gate.
 
 ``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
 drift fails), ``max`` (time/ratio metrics — measured must stay <=
@@ -114,6 +117,12 @@ DEFAULT_RATIO_BOUNDS = {
                 "decision over a 4-member pool (measured ~2-5us; the "
                 "bound caps the per-request tax topology routing adds "
                 "over the shared queue)"},
+    "fleet.dispatch_us": {
+        "value": 150.0, "match": "max",
+        "note": "FleetFront._pick host microseconds per routing decision "
+                "over a 4-member registry with a warm cache (measured "
+                "~3-10us; catches a cache-bypass regression that would "
+                "re-list the registry per request)"},
 }
 
 
@@ -432,6 +441,33 @@ def measure(batch_size=64):
     measured["router.dispatch_us"] = round(
         (time.perf_counter() - t0_pick) / n_picks * 1e6, 3)
     context["router"] = {"members": n_members, "picks": n_picks}
+
+    # ---- proxy 7b: fleet front dispatch overhead (serve/fleetfront.py)
+    # the cross-process fleet keeps the router's (bucket, depth) decision
+    # but computes it off the CACHED registry — bound the per-request
+    # host cost so a registry-listing-per-pick regression (cache bypass)
+    # or lock contention fails the gate as a number, not as fleet tail
+    # latency in a real deployment
+    from bigdl_tpu.serve import FleetFront
+    from bigdl_tpu.serve import fleet as fleet_mod
+    fleet_dir = tempfile.mkdtemp(prefix="perf_gate_fleet_")
+    for i in range(4):
+        fleet_mod.publish_member(fleet_dir, index=i, generation=1,
+                                 pid=1000 + i, port=9000 + i, max_batch=8)
+        fleet_mod.beat(fleet_dir, i, 1, 1)
+    # refresh/lost thresholds pinned huge: the warm cache is the hot
+    # path under traffic; the refresh itself is paid once per interval
+    fleet_front = FleetFront(fleet_dir, refresh_s=3600.0,
+                             lost_after_s=3600.0)
+    for _ in range(200):
+        fleet_front._pick()  # warm (registry cache + allocator)
+    t0_pick = time.perf_counter()
+    for _ in range(n_picks):
+        fleet_front._pick()
+    measured["fleet.dispatch_us"] = round(
+        (time.perf_counter() - t0_pick) / n_picks * 1e6, 3)
+    fleet_front.close()
+    context["fleet"] = {"members": 4, "picks": n_picks}
 
     # ---- proxy 6: 1F1B schedule card + memory ratio (ISSUE 13) -------
     from bigdl_tpu.parallel import build_schedule

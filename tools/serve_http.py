@@ -45,6 +45,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -333,15 +334,28 @@ def main(argv=None):
                       "version": server.version.id,
                       "watching": args.watch,
                       "stats": "/v1/stats"}), flush=True)
+    # rolling restarts send SIGTERM: stop accepting, then DRAIN — every
+    # request already admitted is answered before the process exits
+    # (the same zero-drop contract the in-process swap keeps)
+    stop_ev = threading.Event()
+
+    def _graceful(signum, frame):
+        del frame
+        print(json.dumps({"stopping": signal.Signals(signum).name,
+                          "drain": True}), flush=True)
+        stop_ev.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _graceful)
     try:
-        threading.Event().wait()
+        stop_ev.wait()
     except KeyboardInterrupt:
         pass
     finally:
         httpd.shutdown()
         if controller is not None:
             controller.stop()
-        server.stop()
+        server.stop(drain=True)
     return 0
 
 

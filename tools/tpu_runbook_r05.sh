@@ -305,6 +305,25 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] elastic grow smoke FAILED rc=$GROW_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # fleet smoke (cpu only): the cross-process serving drill — 3 worker
+  # processes under FleetSupervisor, kill -9 on member 0 mid-replay, a
+  # chaos-wedged member 1 condemned by heartbeat silence, and a stale
+  # registry entry that must never be routed; the front must serve the
+  # full recorded trace with zero accepted-request loss, respawned
+  # generations must come back warm through the shared AOT cache (zero
+  # fresh lowers), and a rolling deploy (canary on member 0, bounded
+  # max-unavailable) must land the release bit-exact on every member;
+  # one JSON line, exit-coded
+  echo "[runbook] 2q/4 fleet smoke (kill -9 + wedge + stale entry + rolling deploy)" >> "$LOG"
+  timeout 420 python tools/fleet_smoke.py --platform cpu \
+    > /tmp/fleet_smoke.json 2>/tmp/fleet_smoke.log
+  FLEET_RC=$?
+  if [ "$FLEET_RC" = 0 ]; then
+    echo "[runbook] fleet smoke OK (zero loss, warm respawns, bounded deploy, bit-match) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] fleet smoke FAILED rc=$FLEET_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -333,7 +352,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, fleet_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
