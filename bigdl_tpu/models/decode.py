@@ -59,13 +59,35 @@ def _mha_modules(module):
     return _modules_of_type(module, MultiHeadAttention)
 
 
-def init_kv_cache(model, batch: int, max_len: int, dtype=jnp.float32):
-    """One {k, v} buffer of shape [B, H, max_len, D] per attention layer."""
+def init_kv_cache(model, batch: int, max_len: int, dtype=jnp.float32,
+                  mesh=None):
+    """One {k, v} buffer of shape [B, H, max_len, D] per attention layer.
+
+    ``mesh``: optional canonical layout mesh (parallel/layout
+    ``build_mesh``) — cache tensors are then placed through the
+    ``kv_cache`` role (rows over data x fsdp, heads over tp), so a
+    tp-sharded model decodes against caches that already match its
+    column-parallel q/k/v kernels: each device holds exactly the 1/tp
+    of the cache its heads produce, no per-step resharding."""
+    lay = None
+    if mesh is not None:
+        from ..parallel import layout as _layout
+        lay = _layout.MeshLayout.of_mesh(mesh)
+        if lay is None:
+            raise ValueError(
+                "init_kv_cache: mesh lacks the canonical layout axes "
+                "(build it with parallel/layout.MeshLayout.build_mesh)")
     caches = []
     for mha in _mha_modules(model):
         shape = (batch, mha.num_heads, max_len, mha.head_dim)
-        caches.append({"k": jnp.zeros(shape, dtype),
-                       "v": jnp.zeros(shape, dtype)})
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if lay is not None:
+            from jax.sharding import NamedSharding
+            sh = NamedSharding(mesh, lay.spec_for("kv_cache", shape,
+                                                  min_size=0))
+            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        caches.append({"k": k, "v": v})
     return caches
 
 
@@ -259,7 +281,8 @@ def beam_generate(model, prompt, num_tokens: int, max_len: int,
 
 def cached_generate(model, prompt, num_tokens: int, max_len: int,
                     pad_token: int = 0, temperature: float = 0.0,
-                    top_k: int = 0, rng=None, cache_dtype=None):
+                    top_k: int = 0, rng=None, cache_dtype=None,
+                    mesh=None):
     """KV-cache decode: same contract as transformer_lm.greedy_generate
     (greedy when temperature == 0, else temperature/top-k sampling) but
     each generated token runs a [B, 1, E] incremental forward against the
@@ -270,6 +293,12 @@ def cached_generate(model, prompt, num_tokens: int, max_len: int,
     with a large batch an expert can overflow in one mode but not the other
     (both drop per the capacity contract); raise capacity_factor on the
     model if exact MoE parity at scale matters.
+
+    ``mesh``: optional canonical layout mesh — params are placed through
+    the role table (parallel/layout.assign_shardings) and caches through
+    the ``kv_cache`` role, so a tp-sharded model serves decode through
+    the existing mesh machinery unchanged (jit propagates the input
+    shardings; no resharding in the step).
     """
     prompt_arr = np.asarray(prompt, np.int32)
     toks = prompt_arr[None, :] if prompt_arr.ndim == 1 else prompt_arr
@@ -280,12 +309,20 @@ def cached_generate(model, prompt, num_tokens: int, max_len: int,
 
     from ..common import get_policy
     dtype = cache_dtype or get_policy().compute_dtype
+    params, state = model.params, model.state
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel import layout as _layout
+        params = jax.device_put(
+            params, _layout.assign_shardings(model, params, mesh))
+        rep = NamedSharding(mesh, PartitionSpec())
+        state = jax.device_put(state, jax.tree.map(lambda _: rep, state))
     step = _get_step(model, B, max_len, dtype)
-    caches = tuple(init_kv_cache(model, B, max_len, dtype))
+    caches = tuple(init_kv_cache(model, B, max_len, dtype, mesh=mesh))
     buf = np.full((B, max_len), pad_token, np.int32)
     buf[:, :t0] = toks
     for pos in range(t0 + num_tokens - 1):
-        logits, caches = step(model.params, model.state, caches,
+        logits, caches = step(params, state, caches,
                               jnp.asarray(buf[:, pos]), pos)
         if pos + 1 < t0:
             continue  # prompt prefill: only the cache matters

@@ -35,6 +35,9 @@ _GENERATE_FWD_CACHE = weakref.WeakKeyDictionary()
 class PositionalEmbedding(Module):
     """Learned absolute positions added to [B, T, E] token embeddings."""
 
+    # (max_len, emb) table: position rows shard like vocab rows
+    PARAM_ROLES = {"weight": "embedding_row"}
+
     def __init__(self, max_len: int, embed_dim: int):
         super().__init__()
         self.max_len = max_len

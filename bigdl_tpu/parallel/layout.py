@@ -111,6 +111,13 @@ ROLES: Dict[str, Tuple[Optional[int], Optional[int]]] = {
     # way embedding_row shards LookupTable rows, with an fsdp fallback on
     # the remaining axes (parallel/expert.MoEFFN; _spec_for special case)
     "expert_table": (None, None),
+    # decode KV caches [slots, heads, cache_len, head_dim]: slots shard
+    # over data x fsdp like batch rows, heads over tp to match the
+    # column-parallel q/k/v kernels (models/decode.py, serve/decode.py;
+    # _spec_for special case — never min_size-gated: a cache that stops
+    # matching its attention kernels' sharding forces a resharding
+    # collective per decode step)
+    "kv_cache": (None, None),
 }
 
 
@@ -277,6 +284,22 @@ class MeshLayout:
                     if parts[ax] is None and shape[ax] % self.fsdp == 0:
                         parts[ax] = FSDP_AXIS
                         break
+            return P(*parts)
+        if role == "kv_cache" and ndim >= 2:
+            # [slots, heads, cache_len, head_dim]: slots ride the batch
+            # axes (data x fsdp, degrading like embedding_row when the
+            # slot count does not divide the product), heads ride tp so
+            # each device holds exactly the cache rows its column-
+            # parallel attention heads produce.  No min_size gate.
+            if self.data * self.fsdp > 1:
+                if shape[0] % (self.data * self.fsdp) == 0:
+                    parts[0] = (DATA_AXIS, FSDP_AXIS)
+                elif self.data > 1 and shape[0] % self.data == 0:
+                    parts[0] = DATA_AXIS
+                elif self.fsdp > 1 and shape[0] % self.fsdp == 0:
+                    parts[0] = FSDP_AXIS
+            if self.tp > 1 and shape[1] % self.tp == 0:
+                parts[1] = TP_AXIS
             return P(*parts)
         if role == "embedding_row" and ndim >= 1:
             # rows over fsdp x tp together; degrade to fsdp alone, then

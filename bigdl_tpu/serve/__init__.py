@@ -34,14 +34,22 @@ training trusts), a `FleetSupervisor` condemns silent members by
 generation bump and respawns them warm through the shared AOT cache
 within a restart budget, and a `FleetFront` routes by (bucket, member
 queue depth) over HTTP with bounded retry-on-next-member and rolling
-`swap` fan-out for the DeployController's fleet mode.  See
-docs/serving.md.
+`swap` fan-out for the DeployController's fleet mode.  The generative
+layer (serve/decode.py) brings continuous-batching autoregressive
+decode to the same stack: a `DecodeEngine` runs a persistent step loop
+over fixed KV-cache slots (prefill/decode as separate AOT-cached
+executables on a (slots, cache-page) bucket ladder), sequences join
+and leave per step, and admission rides a per-sequence `DecodeQueue`
+(deadline = time-to-last-token, tenant quotas, priority eviction).
+See docs/serving.md.
 """
 
 from .autoscale import AutoScaler
-from .batcher import (DynamicBatcher, PendingRequest, RequestTimeout,
-                      ServeError, ServerClosed, ServerOverloaded,
-                      default_buckets, pad_rows, predict_in_fixed_batches)
+from .batcher import (DecodeQueue, DynamicBatcher, PendingRequest,
+                      RequestTimeout, ServeError, ServerClosed,
+                      ServerOverloaded, default_buckets, pad_rows,
+                      predict_in_fixed_batches)
+from .decode import DecodeEngine, SlotFault, page_ladder
 from .continuous import (DeployController, ReleasePublisher,
                          ReleaseRejected, read_release)
 from .control import (CanaryController, CanaryRejected, QuotaExceeded,
@@ -66,4 +74,5 @@ __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "resolve_outcomes", "slo_report",
            "DeployController", "ReleasePublisher", "ReleaseRejected",
            "read_release",
-           "FleetSupervisor", "FleetFront", "MemberLostError"]
+           "FleetSupervisor", "FleetFront", "MemberLostError",
+           "DecodeEngine", "DecodeQueue", "SlotFault", "page_ladder"]

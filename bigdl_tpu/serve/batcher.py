@@ -54,7 +54,8 @@ from ..utils import chaos, telemetry
 
 __all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
            "RequestTimeout", "PendingRequest", "DynamicBatcher",
-           "default_buckets", "pad_rows", "predict_in_fixed_batches"]
+           "DecodeQueue", "default_buckets", "pad_rows",
+           "predict_in_fixed_batches"]
 
 
 class ServeError(RuntimeError):
@@ -151,14 +152,39 @@ def default_buckets(max_batch: int) -> tuple:
     return tuple(buckets)
 
 
-def pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
+def pad_rows(arr: np.ndarray, n: int,
+             length: Optional[int] = None) -> np.ndarray:
     """Pad the batch dim up to ``n`` rows by repeating the last row — the
     fixed-shape trick that keeps jit from ever seeing a new shape (no
     per-remainder recompiles).  Shared by the online batcher and the
-    offline UDF chunker."""
+    offline UDF chunker.
+
+    ``length``, when given, additionally pads the TRAILING axis up to
+    ``length`` with zeros (the generative token-batch case: ragged
+    prompts ride the same (bucket, page) shape ladder as fixed feature
+    batches).  Rows longer than ``length`` are an error — truncation
+    would silently drop tokens.  Dtype is always preserved, including
+    for zero-row inputs (which still get their trailing axis resized so
+    the compiled shape is honest)."""
+    arr = np.asarray(arr)
+    if length is not None:
+        if arr.ndim < 1:
+            raise ValueError("pad_rows: length= needs at least a 1-D "
+                             f"array, got ndim={arr.ndim}")
+        have = arr.shape[-1]
+        if have > length:
+            raise ValueError(f"pad_rows: trailing axis {have} exceeds "
+                             f"length={length} (refusing to truncate)")
+        if have < length:
+            pad = [(0, 0)] * (arr.ndim - 1) + [(0, length - have)]
+            arr = np.pad(arr, pad, mode="constant", constant_values=0)
     short = n - len(arr)
     if short <= 0:
         return arr
+    if len(arr) == 0 and length is not None:
+        # nothing to repeat: zero rows of the (resized) shape, zeros —
+        # the token-batch contract (pad token 0), dtype preserved
+        return np.zeros((n,) + arr.shape[1:], dtype=arr.dtype)
     return np.concatenate([arr, np.repeat(arr[-1:], short, axis=0)])
 
 
@@ -458,3 +484,87 @@ class DynamicBatcher:
                     "shed_by_priority": {str(k): v for k, v in
                                          sorted(self.shed_by_priority
                                                 .items())}}
+
+
+class DecodeQueue(DynamicBatcher):
+    """Per-SEQUENCE admission queue for the generative decode engine
+    (serve/decode.py).
+
+    Same bounded queue, deadlines, priority eviction and shed policy as
+    :class:`DynamicBatcher` — a queued item is one *sequence* (prompt +
+    generation budget), not one feature row, and the consumer is the
+    engine's persistent step loop rather than a replica pool:
+
+    - :meth:`take` pops up to ``n`` live sequences WITHOUT blocking or
+      coalescing — the step loop admits into whatever slots just freed
+      and must never park while other slots are still decoding.
+    - :meth:`note_service` is fed (tokens, seconds), so the EMA learns
+      seconds/TOKEN; ``retry_after_s`` therefore scales with the queue's
+      total outstanding token budget, not its request count.
+    """
+
+    def __init__(self, queue_limit: int, max_wait_s: float = 0.0,
+                 clock=None):
+        # max_batch/buckets are meaningless per-sequence: slots and the
+        # (slots, cache-page) ladder live in the engine
+        super().__init__(max_batch=1, max_wait_s=max_wait_s,
+                         queue_limit=queue_limit, buckets=(1,),
+                         clock=clock)
+        self._pending_tokens = 0  # queued generation budget (retry-after)
+
+    def submit(self, payload, deadline: Optional[float] = None, *,
+               tenant: Optional[str] = None,
+               priority: int = 0) -> PendingRequest:
+        req = super().submit(payload, deadline, tenant=tenant,
+                             priority=priority)
+        with self._cond:
+            self._pending_tokens += int(payload.get("max_tokens", 1)) \
+                if isinstance(payload, dict) else 1
+        return req
+
+    def retry_after_s(self) -> float:
+        """Back-off estimate for a rejected sequence: EMA seconds/token
+        times the *queued token budget* (a queue of 8 sequences at 256
+        tokens each is 2048 steps of work, not 8)."""
+        per_tok = self._row_s_ema or 0.0
+        return round(max(per_tok * max(self._pending_tokens, 1),
+                         self.max_wait_s, 0.05), 3)
+
+    def take(self, n: int) -> List[PendingRequest]:
+        """Pop up to ``n`` live sequences, non-blocking.  Expired
+        deadlines shed at dequeue exactly like :meth:`collect` (a
+        sequence whose time-to-last-token deadline already passed must
+        never occupy a slot).  Returns [] when the queue is empty."""
+        if n <= 0:
+            return []
+        with self._cond:
+            reqs = [self._q.popleft()
+                    for _ in range(min(len(self._q), n))]
+            for r in reqs:
+                if isinstance(r.payload, dict):
+                    self._pending_tokens = max(
+                        0, self._pending_tokens
+                        - int(r.payload.get("max_tokens", 1)))
+        now = self.clock()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                with self._cond:
+                    self.shed_timeout += 1
+                    self._count_shed(r)
+                r._resolve(error=RequestTimeout(
+                    f"serve: deadline exceeded after "
+                    f"{now - r.enqueued:.3f}s in queue (decode "
+                    "admission)"), now=now)
+            else:
+                live.append(r)
+        return live
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Park the step loop (briefly) until a sequence is queued or the
+        queue closes.  Returns True when there may be work."""
+        with self._cond:
+            if self._q or self._closed:
+                return True
+            self._cond.wait(timeout)
+            return bool(self._q) or self._closed

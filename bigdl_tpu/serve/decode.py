@@ -1,0 +1,725 @@
+"""Continuous-batching generative decode serving.
+
+Everything serve/ shipped before this module is one-shot forward: a
+request is one feature row, a batch is one device call, done.  Real
+serving traffic is dominated by autoregressive DECODE — and the offline
+KV-cache decoder (models/decode.py ``cached_generate``) never met
+``InferenceServer``.  This module closes that gap with the classic
+continuous-batching design (the step BigDL 2.0's Cluster Serving never
+took; PAPERS.md):
+
+- :class:`DecodeEngine` runs a **persistent decode step loop** over a
+  fixed-slot in-flight batch.  Every loop tick decodes ALL active slots
+  in ONE kernel call; a sequence that emits EOS or exhausts its token
+  budget leaves and frees its slot **that same tick** instead of holding
+  the batch hostage (run-to-completion static batching wastes device
+  steps on finished rows — the throughput gap tools/decode_smoke.py
+  gates, not asserts).
+- **Prefill and decode are separate jitted executables** with separate
+  compile cards and AOT cache entries, keyed like the
+  ``_ShardedForward`` buckets (module fingerprint + base fingerprint +
+  shape dims through utils/aot.get_or_compile).  Prefill admits one new
+  sequence into a free KV-cache slot: a ``fori_loop`` over the prompt
+  positions inside ONE executable (traced trip count — one compile per
+  (prompt-bucket, slots, cache-page), not per prompt length), reusing
+  the exact per-position math of models/decode so greedy outputs
+  bit-match the ``cached_generate`` oracle.
+- The bucket ladder extends to **(batch-slots, cache-page)** pages:
+  cache length is allocated in power-of-2 multiples of
+  ``BIGDL_TPU_DECODE_PAGE`` (models/decode.init_kv_cache buffers), so a
+  17-token prompt neither compiles nor pays HBM for ``max_len``.  The
+  cache grows to the next page when a longer sequence is admitted and
+  shrinks back when the engine drains idle.  Under a canonical layout
+  mesh the cache tensors carry the ``kv_cache`` role
+  (parallel/layout.py: slots over data x fsdp, heads over tp), so
+  tp-sharded models serve decode through the existing mesh machinery
+  unchanged.
+- Admission rides :class:`~bigdl_tpu.serve.batcher.DecodeQueue`:
+  bounded queue, per-sequence deadline (= time-to-LAST-token), priority
+  eviction and tenant quotas all apply per-sequence; ``note_service``
+  learns seconds/token so ``retry_after_s`` scales with the queued
+  token budget.
+- Telemetry: the ``serve.decode`` counter track emits tokens/s,
+  active-slot fill, prefill-vs-decode step fractions and cache
+  bytes/slot — promoted to a ``decode:`` trace_report section like
+  ``aot``/``autoscale`` (utils/telemetry.phase_breakdown).
+- Chaos: ``serve.decode@<slot>`` fires once per tick for every slot
+  that participates (prefill or decode).  A faulted slot fails ITS
+  sequence typed (:class:`SlotFault`/ChaosFault), frees the slot, and
+  the other slots keep decoding with zero loss.
+
+Config knobs (utils/config, all overridable per-engine):
+
+=============================  =========  ================================
+env var                        default    meaning
+=============================  =========  ================================
+BIGDL_TPU_DECODE_SLOTS         4          fixed in-flight batch slots
+BIGDL_TPU_DECODE_PAGE          128        cache-page quantum (tokens);
+                                          cache length is page * 2^k
+BIGDL_TPU_DECODE_MAX_LEN       0          cache-length cap; 0 = the
+                                          model's positional max_len
+BIGDL_TPU_DECODE_QUEUE_LIMIT   64         bounded admission queue
+BIGDL_TPU_DECODE_DEADLINE_MS   0          default time-to-last-token
+                                          deadline; 0 = none
+BIGDL_TPU_DECODE_ADMISSION     continuous 'continuous' (join per tick) or
+                                          'batch' (run-to-completion —
+                                          the baseline decode_smoke
+                                          measures against)
+BIGDL_TPU_DECODE_MIN_STEP_MS   0          per-tick pacing floor (bench /
+                                          smoke determinism lever)
+=============================  =========  ================================
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models import decode as kv
+from ..models.transformer_lm import PositionalEmbedding, sample_next
+from ..nn.attention import MultiHeadAttention
+from ..nn.containers import ConcatTable, Sequential
+from ..nn.module import Container
+from ..utils import aot as aot_mod
+from ..utils import chaos, config, hlostats, telemetry
+from .batcher import DecodeQueue, PendingRequest, ServeError
+from .control import TenantQuotas
+
+__all__ = ["DecodeEngine", "SlotFault", "page_ladder"]
+
+_UNSET = object()
+
+
+class SlotFault(ServeError):
+    """A decode slot faulted mid-generation (the ``serve.decode@<slot>``
+    chaos drill, or a per-sequence error): the sequence fails typed, the
+    slot frees the same tick, the other slots keep decoding."""
+
+
+def page_ladder(page: int, max_len: int) -> tuple:
+    """The cache-length ladder: power-of-2 multiples of ``page`` capped
+    at ``max_len`` (``max_len`` itself always included) — the cache-page
+    analogue of batcher.default_buckets."""
+    if page < 1:
+        raise ValueError(f"page must be >= 1, got {page}")
+    sizes = []
+    c = int(page)
+    while c < max_len:
+        sizes.append(c)
+        c *= 2
+    sizes.append(int(max_len))
+    return tuple(sizes)
+
+
+# ---------------------------------------------------------------------------
+# per-slot-position decode step (vmapped cache write, per-slot mask)
+# ---------------------------------------------------------------------------
+# models/decode._cached_attention serves ONE position shared by every
+# row; continuous batching needs every slot at its OWN position.  The
+# math per slot is identical (same projections, same f32 score path,
+# exact-zero masked softmax weights), so greedy tokens bit-match the
+# cached_generate oracle per sequence.
+
+def _slot_attention(mha, params, x, cache, pos):
+    """x: [S, 1, E], pos: [S] int32; returns ([S, 1, E], new_cache)."""
+    if not mha.causal:
+        raise NotImplementedError(
+            "cached decoding requires causal attention "
+            "(MultiHeadAttention(causal=False) found)")
+    S, _, E = x.shape
+    H, D = mha.num_heads, mha.head_dim
+    split = lambda y: y.reshape(S, 1, H, D).transpose(0, 2, 1, 3)
+    q, k, v = (split(mha._proj(params, x, n)) for n in "qkv")
+
+    def upd(c, u, p):  # c: [H, L, D], u: [H, 1, D], p: scalar
+        return jax.lax.dynamic_update_slice(c, u, (0, p, 0))
+
+    ck = jax.vmap(upd)(cache["k"], k.astype(cache["k"].dtype), pos)
+    cv = jax.vmap(upd)(cache["v"], v.astype(cache["v"].dtype), pos)
+    L = ck.shape[2]
+    scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / (D ** 0.5)
+    # per-slot causal horizon; positions past a slot's pos get EXACT
+    # zero softmax weight (exp(-inf)), so stale cache rows from a
+    # previous occupant of the slot contribute exactly nothing
+    mask = jnp.arange(L)[None, None, None, :] <= pos[:, None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhql,bhld->bhqd", w, cv.astype(jnp.float32))
+    o = o.astype(x.dtype).transpose(0, 2, 1, 3).reshape(S, 1, E)
+    return mha._proj(params, o, "o"), {"k": ck, "v": cv}
+
+
+def _slot_step(module, params, state, x, caches, slot, pos):
+    """models/decode._step with a per-slot position vector ``pos``."""
+    if isinstance(module, MultiHeadAttention):
+        y, caches[slot] = _slot_attention(module, params, x, caches[slot],
+                                          pos)
+        return y, slot + 1
+    if isinstance(module, PositionalEmbedding):
+        w = jnp.take(params["weight"], pos, axis=0)  # [S, E]
+        return x + w[:, None].astype(x.dtype), slot
+    if isinstance(module, Sequential):
+        for m, p, s in zip(module.modules, params, state):
+            x, slot = _slot_step(m, p, s, x, caches, slot, pos)
+        return x, slot
+    if isinstance(module, ConcatTable):
+        outs = []
+        for m, p, s in zip(module.modules, params, state):
+            o, slot = _slot_step(m, p, s, x, caches, slot, pos)
+            outs.append(o)
+        return outs, slot
+    if not isinstance(module, Container):
+        y, _ = module.apply(params, state, x, training=False, rng=None)
+        return y, slot
+    raise NotImplementedError(
+        f"cached decoding: unsupported container {type(module).__name__}")
+
+
+def _prompt_bucket(t0: int) -> int:
+    """Power-of-2 prompt padding bucket (floor 8) — one prefill
+    executable per bucket, not per prompt length."""
+    b = 8
+    while b < t0:
+        b *= 2
+    return b
+
+
+class _Seq:
+    """Host-side state of one in-flight sequence (one slot)."""
+
+    __slots__ = ("req", "buf", "t0", "pos", "emitted", "max_tokens",
+                 "eos", "temperature", "top_k", "rng")
+
+    def __init__(self, req: PendingRequest, prompt: np.ndarray,
+                 max_tokens: int, eos, temperature: float, top_k: int,
+                 rng):
+        self.req = req
+        self.t0 = len(prompt)
+        self.buf = np.zeros(self.t0 + max_tokens, np.int32)
+        self.buf[: self.t0] = prompt
+        self.pos = self.t0 - 1   # last position fed to the device
+        self.emitted = 0
+        self.max_tokens = max_tokens
+        self.eos = eos
+        self.temperature = temperature
+        self.top_k = top_k
+        self.rng = rng
+
+
+class DecodeEngine:
+    """Persistent continuous-batching decode loop (module docstring)."""
+
+    def __init__(self, model, *, slots: Optional[int] = None,
+                 page: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 queue_limit: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 admission: Optional[str] = None,
+                 eos_token: Optional[int] = None,
+                 cache_dtype=None, mesh=None,
+                 tenant_qps: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 min_step_s: Optional[float] = None,
+                 clock=None):
+        self.model = model
+        if model.params is None:
+            model.build()
+        self.slots = int(slots if slots is not None
+                         else config.get_int("DECODE_SLOTS", 4))
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        self.page = int(page if page is not None
+                        else config.get_int("DECODE_PAGE", 128))
+        model_cap = min((pe.max_len for pe in kv._modules_of_type(
+            model, PositionalEmbedding)), default=0)
+        cap = int(max_len if max_len is not None
+                  else config.get_int("DECODE_MAX_LEN", 0)) or model_cap
+        if model_cap and cap > model_cap:
+            raise ValueError(f"max_len {cap} > model positional "
+                             f"embedding max_len {model_cap}")
+        if cap < 1:
+            raise ValueError("DecodeEngine needs a positive max_len "
+                             "(model has no PositionalEmbedding cap)")
+        self.max_len = cap
+        self.ladder = page_ladder(self.page, self.max_len)
+        self.admission = str(admission if admission is not None else
+                             config.get_str("DECODE_ADMISSION",
+                                            "continuous"))
+        if self.admission not in ("continuous", "batch"):
+            raise ValueError(f"admission must be 'continuous' or "
+                             f"'batch', got {self.admission!r}")
+        self.default_deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else config.get_float("DECODE_DEADLINE_MS", 0.0))
+        self.min_step_s = float(
+            min_step_s if min_step_s is not None
+            else config.get_float("DECODE_MIN_STEP_MS", 0.0) / 1e3)
+        self.eos_token = eos_token
+        from ..common import get_policy
+        self.cache_dtype = cache_dtype or get_policy().compute_dtype
+        self.clock = clock or time.monotonic
+        self.queue = DecodeQueue(
+            int(queue_limit if queue_limit is not None
+                else config.get_int("DECODE_QUEUE_LIMIT", 64)),
+            clock=self.clock)
+        self.quotas = TenantQuotas(tenant_qps or 0.0, burst=tenant_burst,
+                                   clock=self.clock)
+        self._mesh = mesh
+        self._params, self._state = model.params, model.state
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel import layout as _layout
+            self._params = jax.device_put(
+                self._params,
+                _layout.assign_shardings(model, self._params, mesh))
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._state = jax.device_put(
+                self._state, jax.tree.map(lambda _: rep, self._state))
+        self._module_fp = None       # lazy (fingerprinting traces shapes)
+        self._exe: dict = {}         # (kind, *dims) -> compiled
+        self._slots: List[Optional[_Seq]] = [None] * self.slots
+        self._caches = None
+        self._cache_len = 0
+        self._recorder = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # cumulative counters (stats(); serve.decode telemetry track)
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.tokens_out = 0
+        self.seqs_done = 0
+        self.seqs_failed = 0
+        self.cache_grows = 0
+        self._busy_s = 0.0
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "DecodeEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="bigdl-decode-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Close admissions; ``drain=True`` finishes every queued and
+        in-flight sequence first."""
+        self.queue.close(drain=drain)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=120.0)
+            self._thread = None
+        self.queue.fail_pending()
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- admission ------------------------------------------------------
+
+    def submit(self, prompt, max_tokens: int, *,
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None, priority: int = 0,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token=_UNSET, seed: int = 0) -> PendingRequest:
+        """Enqueue one sequence; returns a PendingRequest whose
+        ``result()`` is the full int32 token row (prompt + generated,
+        the ``cached_generate`` contract, truncated at EOS).  Typed
+        rejections: ServeError (bad request), QuotaExceeded,
+        ServerOverloaded, ServerClosed; RequestTimeout resolves later if
+        the time-to-last-token deadline passes in the queue."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] == 0:
+            raise ServeError("decode: prompt must be a non-empty 1-D "
+                             f"token row, got shape {prompt.shape}")
+        max_tokens = int(max_tokens)
+        if max_tokens < 1:
+            raise ServeError(f"decode: max_tokens must be >= 1, got "
+                             f"{max_tokens}")
+        need = prompt.shape[0] + max_tokens
+        if need > self.max_len:
+            raise ServeError(
+                f"decode: prompt ({prompt.shape[0]}) + max_tokens "
+                f"({max_tokens}) exceeds max_len ({self.max_len})")
+        self.quotas.admit(tenant)
+        eos = self.eos_token if eos_token is _UNSET else eos_token
+        dl_ms = self.default_deadline_ms \
+            if deadline_ms is None else float(deadline_ms)
+        deadline = self.clock() + dl_ms / 1e3 if dl_ms > 0 else None
+        gen = {"max_tokens": max_tokens, "temperature": float(temperature),
+               "top_k": int(top_k), "seed": int(seed)}
+        if eos is not None:
+            gen["eos_token"] = int(eos)
+        if self._recorder is not None:
+            self._recorder.note(prompt, tenant=tenant, priority=priority,
+                                deadline_ms=dl_ms if dl_ms > 0 else None,
+                                gen=gen)
+        payload = dict(gen, prompt=prompt, eos=eos)
+        return self.queue.submit(payload, deadline, tenant=tenant,
+                                 priority=priority)
+
+    def generate(self, prompt, max_tokens: int,
+                 timeout: Optional[float] = 120.0, **kw) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(prompt, max_tokens, **kw).result(timeout)
+
+    # -- trace recording (server.py contract) ---------------------------
+
+    def record_trace(self, path: Optional[str] = None, *, limit=None):
+        from .tracefile import TraceRecorder
+        if self._recorder is not None and (path is None or
+                                           self._recorder.path == path):
+            return self._recorder
+        self._recorder = TraceRecorder(clock=self.clock, limit=limit,
+                                       path=path)
+        return self._recorder
+
+    def stop_trace(self, path: Optional[str] = None):
+        rec, self._recorder = self._recorder, None
+        if rec is not None and (path or rec.path):
+            rec.save(path)
+        return rec
+
+    # -- executables (AOT-keyed like _ShardedForward buckets) -----------
+
+    def _key_fields(self, kind: str, **dims) -> dict:
+        fields = dict(aot_mod.base_fingerprint(self._mesh))
+        if self._module_fp is None:
+            self._module_fp = aot_mod.module_fingerprint(self.model)
+        fields["module"] = self._module_fp
+        fields["params"] = aot_mod.aval_fingerprint(
+            (self._params, self._state))
+        fields["kind"] = kind
+        fields.update(dims)
+        return fields
+
+    def _cache_avals(self, cache_len: int):
+        out = []
+        for mha in kv._mha_modules(self.model):
+            shape = (self.slots, mha.num_heads, cache_len, mha.head_dim)
+            out.append({
+                "k": jax.ShapeDtypeStruct(shape, self.cache_dtype),
+                "v": jax.ShapeDtypeStruct(shape, self.cache_dtype)})
+        return tuple(out)
+
+    def _step_exe(self, cache_len: int):
+        """The decode-step executable for the (slots, cache_len) bucket:
+        ALL slots advance one position in one kernel call."""
+        memo = ("step", self.slots, cache_len)
+        exe = self._exe.get(memo)
+        if exe is not None:
+            return exe
+        model, S = self.model, self.slots
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def fn(params, state, caches, tok, pos):
+            x = tok[:, None]          # [S, 1] token ids
+            caches = list(caches)
+            y, _ = _slot_step(model, params, state, x, caches, 0, pos)
+            return y[:, -1], tuple(caches)
+
+        ivec = jax.ShapeDtypeStruct((S,), jnp.int32)
+        exe = aot_mod.get_or_compile(
+            self._key_fields("decode.step", slots=S, cache_len=cache_len,
+                             dtype=jnp.dtype(self.cache_dtype).name),
+            lambda: fn.lower(self._params, self._state,
+                             self._cache_avals(cache_len), ivec, ivec),
+            label="decode.step",
+            card_extra={"slots": S, "cache_len": cache_len})
+        self._exe[memo] = exe
+        return exe
+
+    def _prefill_exe(self, prompt_bucket: int, cache_len: int):
+        """The prefill executable for the (prompt_bucket, slots,
+        cache_len) bucket: one new sequence enters ONE slot via a traced
+        fori_loop over its prompt positions (trip count t0 is traced, so
+        every prompt length in the bucket shares this compile).  Reuses
+        models/decode._step per position — greedy outputs bit-match the
+        cached_generate oracle by construction."""
+        memo = ("prefill", prompt_bucket, self.slots, cache_len)
+        exe = self._exe.get(memo)
+        if exe is not None:
+            return exe
+        model = self.model
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def fn(params, state, caches, toks, slot, t0):
+            # slice this slot's [1, H, L, D] cache views out, run the
+            # rows=1 incremental step over the prompt, write back — the
+            # other slots' caches pass through untouched
+            sub = tuple(
+                {n: jax.lax.dynamic_slice_in_dim(c[n], slot, 1, axis=0)
+                 for n in c} for c in caches)
+
+            def run_pos(i, sub_t):
+                sub_l = list(sub_t)
+                x = toks[i][None, None]     # [1, 1]
+                y, _ = kv._step(model, params, state, x, sub_l, 0, i)
+                return tuple(sub_l), y[:, -1]
+
+            sub, logits = run_pos(0, sub)
+            sub, logits = jax.lax.fori_loop(
+                1, t0, lambda i, c: run_pos(i, c[0]), (sub, logits))
+            new = tuple(
+                {n: jax.lax.dynamic_update_slice(c[n], s[n],
+                                                 (slot, 0, 0, 0))
+                 for n in c} for c, s in zip(caches, sub))
+            return logits[0], new          # [V] logits of last position
+
+        exe = aot_mod.get_or_compile(
+            self._key_fields("decode.prefill", slots=self.slots,
+                             cache_len=cache_len,
+                             prompt_bucket=prompt_bucket,
+                             dtype=jnp.dtype(self.cache_dtype).name),
+            lambda: fn.lower(
+                self._params, self._state, self._cache_avals(cache_len),
+                jax.ShapeDtypeStruct((prompt_bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32)),
+            label="decode.prefill",
+            card_extra={"slots": self.slots, "cache_len": cache_len,
+                        "prompt_bucket": prompt_bucket})
+        self._exe[memo] = exe
+        return exe
+
+    # -- (slots, cache-page) ladder -------------------------------------
+
+    def _bucket_for(self, need: int) -> int:
+        for c in self.ladder:
+            if c >= need:
+                return c
+        return self.ladder[-1]
+
+    def _fresh_caches(self, cache_len: int):
+        caches = kv.init_kv_cache(self.model, self.slots, cache_len,
+                                  self.cache_dtype, mesh=self._mesh)
+        return tuple(caches)
+
+    def _ensure_cache(self, need: int, idle: bool) -> None:
+        want = self._bucket_for(need)
+        if self._caches is None or (idle and want != self._cache_len):
+            # idle engine: re-page to exactly what the next admission
+            # needs (a 17-token prompt must not pay for max_len)
+            self._caches = self._fresh_caches(want)
+            self._cache_len = want
+            return
+        if want > self._cache_len:
+            # grow to the next page: pad the length axis with zeros —
+            # masked positions carry exact-zero softmax weight, so the
+            # in-flight slots decode on unchanged
+            grown = []
+            for c in self._caches:
+                pad = {}
+                for n, arr in c.items():
+                    z = jnp.zeros(arr.shape[:2]
+                                  + (want - self._cache_len,)
+                                  + arr.shape[3:], arr.dtype)
+                    pad[n] = jnp.concatenate([arr, z], axis=2)
+                grown.append(pad)
+            self._caches = tuple(grown)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding
+                from ..parallel import layout as _layout
+                lay = _layout.MeshLayout.of_mesh(self._mesh)
+                self._caches = tuple(
+                    {n: jax.device_put(arr, NamedSharding(
+                        self._mesh, lay.spec_for("kv_cache", arr.shape,
+                                                 min_size=0)))
+                     for n, arr in c.items()} for c in self._caches)
+            self._cache_len = want
+            self.cache_grows += 1
+
+    def cache_bytes_per_slot(self) -> int:
+        if self._caches is None:
+            return 0
+        total = sum(int(arr.nbytes) for c in self._caches
+                    for arr in c.values())
+        return total // self.slots
+
+    # -- the persistent step loop ---------------------------------------
+
+    def _loop(self) -> None:
+        telemetry.thread_name("decode engine")
+        while True:
+            try:
+                if not self._tick():
+                    return
+            except Exception as e:  # noqa: BLE001 — engine must survive
+                # backstop: a fault not attributable to one slot fails
+                # every in-flight sequence typed rather than wedging the
+                # loop (the queue keeps serving future ticks)
+                now = self.clock()
+                for s in range(self.slots):
+                    seq = self._slots[s]
+                    if seq is not None:
+                        seq.req._resolve(error=e, now=now)
+                        self._slots[s] = None
+                        self.seqs_failed += 1
+
+    def _fail_slot(self, s: int, err: Exception) -> None:
+        seq = self._slots[s]
+        if seq is not None:
+            seq.req._resolve(error=err, now=self.clock())
+            self._slots[s] = None
+            self.seqs_failed += 1
+
+    def _finish_slot(self, s: int) -> None:
+        seq = self._slots[s]
+        out = seq.buf[: seq.t0 + seq.emitted].copy()
+        seq.req._resolve(result=out, version="decode", now=self.clock())
+        self._slots[s] = None
+        self.seqs_done += 1
+
+    def _sample(self, seq: _Seq, logits_row: np.ndarray) -> int:
+        tok, seq.rng = sample_next(logits_row[None], seq.temperature,
+                                   seq.top_k, seq.rng)
+        return int(tok[0])
+
+    def _advance(self, s: int, tok: int) -> None:
+        """Record one emitted token for slot ``s``; finish the sequence
+        the SAME step when it hits EOS or its budget."""
+        seq = self._slots[s]
+        seq.pos += 1
+        seq.buf[seq.pos] = tok
+        seq.emitted += 1
+        self.tokens_out += 1
+        if (seq.eos is not None and tok == seq.eos) or \
+                seq.emitted >= seq.max_tokens:
+            self._finish_slot(s)
+
+    def _admit(self, req: PendingRequest, s: int) -> None:
+        p = req.payload
+        prompt = p["prompt"]
+        t0 = len(prompt)
+        rng = jax.random.PRNGKey(p.get("seed", 0)) \
+            if p.get("temperature", 0.0) > 0 else None
+        seq = _Seq(req, prompt, p["max_tokens"], p.get("eos"),
+                   p.get("temperature", 0.0), p.get("top_k", 0), rng)
+        self._slots[s] = seq
+        try:
+            chaos.fire(f"serve.decode@{s}", thread_exc=SlotFault)
+        except Exception as e:  # noqa: BLE001 — typed per-sequence fail
+            self._fail_slot(s, e)
+            return
+        pb = _prompt_bucket(t0)
+        toks = np.zeros(pb, np.int32)
+        toks[:t0] = prompt
+        exe = self._prefill_exe(pb, self._cache_len)
+        try:
+            logits, self._caches = exe(
+                self._params, self._state, self._caches,
+                jnp.asarray(toks), jnp.int32(s), jnp.int32(t0))
+        except Exception as e:  # noqa: BLE001
+            self._fail_slot(s, SlotFault(f"decode: prefill failed in "
+                                         f"slot {s}: {e!r}"))
+            return
+        self.prefill_steps += 1
+        self._advance(s, self._sample(seq, np.asarray(logits)))
+
+    def _tick(self) -> bool:
+        """One loop iteration: admit into free slots, decode all active
+        slots in one kernel call.  Returns False when closed + drained."""
+        q = self.queue
+        free = [s for s in range(self.slots) if self._slots[s] is None]
+        n_active = self.slots - len(free)
+        incoming: List[PendingRequest] = []
+        if free and (self.admission == "continuous" or n_active == 0):
+            incoming = q.take(len(free))
+        if n_active == 0 and not incoming:
+            if q.closed and q.depth() == 0:
+                return False
+            q.wait_for_work(DecodeQueue._SLICE)
+            return True
+        t_start = self.clock()
+        tokens_before = self.tokens_out
+        if incoming:
+            need = max(len(r.payload["prompt"]) + r.payload["max_tokens"]
+                       for r in incoming)
+            self._ensure_cache(need, idle=(n_active == 0))
+            for r in incoming:
+                self._admit(r, free.pop(0))
+        # decode every still-active slot (including freshly prefilled
+        # ones — their first token is already in the buffer) one
+        # position forward, in ONE kernel call
+        active = [s for s in range(self.slots)
+                  if self._slots[s] is not None]
+        for s in list(active):
+            try:
+                chaos.fire(f"serve.decode@{s}", thread_exc=SlotFault)
+            except Exception as e:  # noqa: BLE001
+                self._fail_slot(s, e)
+                active.remove(s)
+        if active:
+            tok = np.zeros(self.slots, np.int32)
+            pos = np.zeros(self.slots, np.int32)
+            for s in active:
+                seq = self._slots[s]
+                tok[s] = seq.buf[seq.pos]
+                pos[s] = seq.pos
+            exe = self._step_exe(self._cache_len)
+            logits, self._caches = exe(self._params, self._state,
+                                       self._caches, jnp.asarray(tok),
+                                       jnp.asarray(pos))
+            logits = np.asarray(logits)
+            self.decode_steps += 1
+            for s in active:
+                self._advance(s, self._sample(self._slots[s], logits[s]))
+        dt = self.clock() - t_start
+        if self.min_step_s > 0 and dt < self.min_step_s:
+            time.sleep(self.min_step_s - dt)
+            dt = self.min_step_s
+        self._busy_s += dt
+        q.note_service(max(self.tokens_out - tokens_before, 1), dt)
+        n_active = sum(1 for s in self._slots if s is not None)
+        steps = self.prefill_steps + self.decode_steps
+        telemetry.counter(
+            "serve.decode",
+            tokens_per_s=self.tokens_out / max(self._busy_s, 1e-9),
+            fill=n_active / self.slots,
+            prefill_frac=self.prefill_steps / max(steps, 1),
+            decode_frac=self.decode_steps / max(steps, 1),
+            cache_bytes_per_slot=self.cache_bytes_per_slot(),
+            cache_len=self._cache_len)
+        return True
+
+    # -- introspection --------------------------------------------------
+
+    def tokens_per_s(self) -> float:
+        return self.tokens_out / max(self._busy_s, 1e-9)
+
+    def stats(self) -> dict:
+        s = aot_mod.stats()
+        out = {
+            "slots": self.slots,
+            "active": sum(1 for x in self._slots if x is not None),
+            "admission": self.admission,
+            "cache_len": self._cache_len,
+            "cache_bytes_per_slot": self.cache_bytes_per_slot(),
+            "cache_grows": self.cache_grows,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "tokens_out": self.tokens_out,
+            "tokens_per_s": round(self.tokens_per_s(), 3),
+            "seqs_done": self.seqs_done,
+            "seqs_failed": self.seqs_failed,
+            "queue": self.queue.stats(),
+            "quota": self.quotas.stats(),
+            "aot": {k: int(s[k]) for k in ("hits", "misses", "stores",
+                                           "lowers", "compiles",
+                                           "corrupt")},
+        }
+        cards = hlostats.ledger()
+        if cards:
+            out["compile_cards"] = cards
+        if self._recorder is not None:
+            out["trace_recording"] = self._recorder.stats()
+        return out

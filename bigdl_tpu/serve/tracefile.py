@@ -69,35 +69,53 @@ class TraceFormatError(ServeError):
 
 class TraceEvent:
     """One offered request: ``dt`` seconds after the PREVIOUS event (0
-    for the first), the payload row, and its admission metadata."""
+    for the first), the payload row, and its admission metadata.
 
-    __slots__ = ("dt", "payload", "tenant", "priority", "deadline_ms")
+    ``gen``: optional generation metadata for decode traces (serve/
+    decode.py) — a small dict (max_tokens, eos, temperature, ...) the
+    replayer hands to ``DecodeEngine.submit``.  For a generative
+    sequence the payload is the prompt token row and ``deadline_ms`` is
+    the time-to-LAST-token budget (the engine resolves the request at
+    its final token, so recorded latency and SLO attainment are
+    per-sequence by construction).  Absent on classic one-shot traces
+    (``from_record`` defaults it to None — old trace files replay
+    unchanged)."""
+
+    __slots__ = ("dt", "payload", "tenant", "priority", "deadline_ms",
+                 "gen")
 
     def __init__(self, dt: float, payload, tenant: Optional[str] = None,
-                 priority: int = 0, deadline_ms: Optional[float] = None):
+                 priority: int = 0, deadline_ms: Optional[float] = None,
+                 gen: Optional[dict] = None):
         self.dt = max(float(dt), 0.0)
         self.payload = payload
         self.tenant = tenant
         self.priority = int(priority)
         self.deadline_ms = (float(deadline_ms)
                             if deadline_ms is not None else None)
+        self.gen = dict(gen) if gen else None
 
     def to_record(self) -> dict:
-        return {"dt": self.dt, "x": np.asarray(self.payload),
-                "tenant": self.tenant, "priority": self.priority,
-                "deadline_ms": self.deadline_ms}
+        rec = {"dt": self.dt, "x": np.asarray(self.payload),
+               "tenant": self.tenant, "priority": self.priority,
+               "deadline_ms": self.deadline_ms}
+        if self.gen is not None:
+            rec["gen"] = dict(self.gen)
+        return rec
 
     @classmethod
     def from_record(cls, rec: dict) -> "TraceEvent":
         return cls(rec["dt"], rec["x"], tenant=rec.get("tenant"),
                    priority=rec.get("priority", 0),
-                   deadline_ms=rec.get("deadline_ms"))
+                   deadline_ms=rec.get("deadline_ms"),
+                   gen=rec.get("gen"))
 
     def __repr__(self):
         return (f"TraceEvent(dt={self.dt:.4f}, shape="
                 f"{tuple(np.asarray(self.payload).shape)}, "
                 f"tenant={self.tenant!r}, priority={self.priority}, "
-                f"deadline_ms={self.deadline_ms})")
+                f"deadline_ms={self.deadline_ms}"
+                + (f", gen={self.gen}" if self.gen else "") + ")")
 
 
 class TraceRecorder:
@@ -121,7 +139,8 @@ class TraceRecorder:
 
     def note(self, payload, tenant: Optional[str] = None,
              priority: int = 0,
-             deadline_ms: Optional[float] = None) -> None:
+             deadline_ms: Optional[float] = None,
+             gen: Optional[dict] = None) -> None:
         now = self.clock()
         with self._lock:
             if len(self._events) >= self.limit:
@@ -131,7 +150,7 @@ class TraceRecorder:
             self._stamps.append(now)
             self._events.append(TraceEvent(
                 now - prev, np.asarray(payload), tenant=tenant,
-                priority=priority, deadline_ms=deadline_ms))
+                priority=priority, deadline_ms=deadline_ms, gen=gen))
 
     @property
     def count(self) -> int:

@@ -548,9 +548,17 @@ def phase_breakdown(merged: dict) -> dict:
                        if k.startswith("fleet."))
     if fleet or fleet_events:
         fleet["events"] = fleet_events
+    # the continuous-batching decode engine's track, promoted the same
+    # way: tokens/s, active-slot fill, prefill-vs-decode step fractions
+    # and cache bytes/slot (serve/decode.py emits cumulative/derived
+    # values per tick, so LAST is the steady-state answer) — "did the
+    # decode loop stay full and cheap?" becomes a report line
+    decode = {series[len("serve.decode."):]: st["last"]
+              for series, st in counters.items()
+              if series.startswith("serve.decode.")}
     return {"phases": phases, "ranks": ranks, "counters": counters,
             "aot": aot, "autoscale": autoscale, "deploy": deploy,
-            "elastic": elastic, "fleet": fleet,
+            "elastic": elastic, "fleet": fleet, "decode": decode,
             "data_wait_fraction": round(frac, 4),
             "diagnosis": ("input-bound (data_wait_fraction "
                           f"{frac:.2f} > 0.5: the host pipeline gates the "
@@ -617,6 +625,10 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
         lines.append("fleet: " + "  ".join(
             f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
             for k, v in sorted(breakdown["fleet"].items())))
+    if breakdown.get("decode"):
+        lines.append("decode: " + "  ".join(
+            f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(breakdown["decode"].items())))
     if breakdown["instants"]:
         lines.append("instant events: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(breakdown["instants"].items())))

@@ -1,0 +1,391 @@
+"""Continuous-batching decode serving (bigdl_tpu/serve/decode.py — ISSUE 18).
+
+The generative serving contract under test (docs/serving.md "Generative
+decode"):
+  - a persistent step loop over fixed KV-cache slots: sequences join via
+    prefill into a free slot, every tick decodes ALL active slots in one
+    kernel call, and a finished sequence frees its slot the SAME step;
+  - greedy outputs BIT-match the offline ``cached_generate`` oracle per
+    sequence, regardless of what else shares the batch (the per-slot
+    masked attention gives stale cache rows exactly zero weight);
+  - the (batch-slots, cache-page) ladder grows the cache mid-flight and
+    the footprint is exact and observable (``cache_bytes_per_slot``);
+  - prefill and decode are SEPARATE jitted executables with separate
+    compile cards (``decode.prefill`` / ``decode.step``);
+  - admission is a per-sequence ``DecodeQueue``: bounded, deadline =
+    time-to-last-token (shed typed at dequeue), tenant token buckets;
+  - a ``serve.decode@<slot>`` chaos fault fails ONE sequence typed and
+    the other slots keep decoding with zero loss;
+  - under a (1,1,2) tp mesh the per-device KV cache halves and greedy
+    tokens match the single-device run.
+"""
+
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from bigdl_tpu.models.decode import cached_generate, init_kv_cache
+from bigdl_tpu.models.transformer_lm import TransformerLM
+from bigdl_tpu.serve import (DecodeEngine, DecodeQueue, QuotaExceeded,
+                             RequestTimeout, ServeError, SlotFault,
+                             TraceEvent, page_ladder, pad_rows, read_trace,
+                             write_trace)
+from bigdl_tpu.utils import chaos
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(vocab_size=64, max_len=64, d_model=32,
+                         num_heads=2, num_layers=2).build(jax.random.key(0))
+
+
+def _prompts(n, lo=3, hi=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, size=int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _oracle(lm, prompt, max_tokens):
+    return cached_generate(lm, prompt, max_tokens,
+                           max_len=len(prompt) + max_tokens)
+
+
+# ---------------------------------------------------------------------------
+# pad_rows trailing-axis padding (satellite: serve/batcher.py)
+# ---------------------------------------------------------------------------
+
+def test_pad_rows_trailing_axis_pads_with_zeros():
+    arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+    out = pad_rows(arr, 4, length=8)
+    assert out.shape == (4, 8)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out[:2, :3], arr)
+    # rows pad by repeating the last row (the legacy fixed-batch
+    # contract); the NEW trailing axis pads with zeros
+    np.testing.assert_array_equal(out[2:, :3], np.tile(arr[-1], (2, 1)))
+    assert not out[:, 3:].any()
+
+
+def test_pad_rows_length_zero_rows_and_dtype():
+    # zero-row input: row padding alone can't invent the trailing size,
+    # so the length= form must (the legacy no-length call keeps its
+    # empty-array behavior)
+    out = pad_rows(np.zeros((0, 3), np.float16), 2, length=5)
+    assert out.shape == (2, 5) and out.dtype == np.float16
+    assert not out.any()
+
+
+def test_pad_rows_refuses_to_truncate():
+    with pytest.raises(ValueError, match="refusing to truncate"):
+        pad_rows(np.ones((2, 9), np.float32), 2, length=4)
+
+
+# ---------------------------------------------------------------------------
+# DecodeQueue admission (per-sequence queue under the step loop)
+# ---------------------------------------------------------------------------
+
+def test_decode_queue_take_is_nonblocking_and_bounded():
+    q = DecodeQueue(queue_limit=8)
+    reqs = [q.submit({"max_tokens": 4, "i": i}) for i in range(3)]
+    assert q.take(0) == []
+    got = q.take(2)
+    assert [r.payload["i"] for r in got] == [0, 1]
+    assert q.take(5) == [reqs[2]]
+    assert q.take(1) == []  # empty: returns, never parks
+
+
+def test_decode_queue_sheds_expired_deadline_at_dequeue():
+    t = [0.0]
+    q = DecodeQueue(queue_limit=8, clock=lambda: t[0])
+    late = q.submit({"max_tokens": 4}, deadline=1.0)
+    live = q.submit({"max_tokens": 4}, deadline=50.0)
+    t[0] = 2.0
+    got = q.take(2)
+    assert got == [live]
+    with pytest.raises(RequestTimeout):
+        late.result(0.1)
+    assert q.shed_timeout == 1
+
+
+def test_decode_queue_retry_after_scales_with_token_budget():
+    q = DecodeQueue(queue_limit=64)
+    q.note_service(100, 1.0)  # EMA learns 10ms/token
+    q.submit({"max_tokens": 200})
+    q.submit({"max_tokens": 200})
+    # 400 queued tokens at ~10ms/token >> the 0.05s floor
+    assert q.retry_after_s() >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# the engine: page ladder, oracle parity, same-step slot reuse
+# ---------------------------------------------------------------------------
+
+def test_page_ladder_pow2_pages_capped_at_max_len():
+    assert page_ladder(16, 128) == (16, 32, 64, 128)
+    assert page_ladder(16, 100) == (16, 32, 64, 100)
+    assert page_ladder(8, 8) == (8,)
+    with pytest.raises(ValueError):
+        page_ladder(0, 64)
+
+
+def test_continuous_batching_bit_matches_oracle(lm):
+    # 5 mixed-length sequences through 2 slots: forces same-step slot
+    # reuse AND mixed in-flight positions; every output must equal the
+    # offline single-sequence oracle bit for bit
+    prompts = _prompts(5, seed=1)
+    budgets = [4, 7, 3, 6, 5]
+    with DecodeEngine(lm, slots=2, page=8) as eng:
+        handles = [eng.submit(p, mt) for p, mt in zip(prompts, budgets)]
+        outs = [h.result(120.0) for h in handles]
+        st = eng.stats()
+    for p, mt, out in zip(prompts, budgets, outs):
+        np.testing.assert_array_equal(out, _oracle(lm, p, mt))
+    assert st["seqs_done"] == 5 and st["seqs_failed"] == 0
+    assert st["prefill_steps"] == 5  # one prefill per admitted sequence
+    assert st["tokens_out"] == sum(budgets)
+
+
+def test_eos_frees_slot_same_step(lm):
+    prompt = _prompts(1, seed=2)[0]
+    full = _oracle(lm, prompt, 8)
+    eos = int(full[len(prompt) + 2])  # the oracle's 3rd generated token
+    with DecodeEngine(lm, slots=1, page=8) as eng:
+        out = eng.generate(prompt, 8, eos_token=eos)
+        st = eng.stats()
+    # truncated AT the EOS token (inclusive), budget unspent
+    np.testing.assert_array_equal(out, full[: len(prompt) + 3])
+    assert st["tokens_out"] == 3
+
+
+def test_cache_grows_through_the_page_ladder(lm):
+    import time as _time
+    short, long = _prompts(2, lo=4, hi=6, seed=3)
+    with DecodeEngine(lm, slots=2, page=8, min_step_s=0.01) as eng:
+        # sequence A occupies a slot at the 32-page; once it is IN
+        # FLIGHT, B needs the 64 bucket -> a mid-flight concat grow
+        # (idle re-page would be a fresh alloc, cache_grows stays 0)
+        ha = eng.submit(short, 25)
+        deadline = _time.monotonic() + 60.0
+        while eng.stats()["active"] == 0:
+            assert _time.monotonic() < deadline, "A never admitted"
+            _time.sleep(0.002)
+        assert eng.stats()["cache_len"] == 32
+        hb = eng.submit(long, 50)
+        first, out = ha.result(120.0), hb.result(120.0)
+        st = eng.stats()
+    np.testing.assert_array_equal(first, _oracle(lm, short, 25))
+    np.testing.assert_array_equal(out, _oracle(lm, long, 50))
+    assert st["cache_len"] == 64 and st["cache_grows"] >= 1
+    # exact structural footprint: layers x {k,v} x heads x len x head_dim
+    assert st["cache_bytes_per_slot"] == 2 * 2 * 2 * st["cache_len"] * 16 * 4
+
+
+def test_batch_admission_mode_is_run_to_completion(lm):
+    prompts = _prompts(4, seed=4)
+    with DecodeEngine(lm, slots=2, page=8, admission="batch") as eng:
+        handles = [eng.submit(p, 4) for p in prompts]
+        outs = [h.result(120.0) for h in handles]
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _oracle(lm, p, 4))
+    with pytest.raises(ValueError, match="admission"):
+        DecodeEngine(lm, admission="sometimes")
+
+
+def test_prefill_and_decode_emit_separate_compile_cards(lm, monkeypatch):
+    from bigdl_tpu.utils import hlostats
+    monkeypatch.setenv("BIGDL_TPU_COMPILE_CARDS", "1")
+    hlostats.reset()
+    try:
+        with DecodeEngine(lm, slots=2, page=8) as eng:
+            eng.generate(_prompts(1, seed=5)[0], 3)
+        ledger = hlostats.ledger()
+        assert ledger.get("decode.prefill", 0) >= 1
+        assert ledger.get("decode.step", 0) >= 1
+    finally:
+        hlostats.reset()
+
+
+# ---------------------------------------------------------------------------
+# typed rejection, deadlines, quotas, chaos
+# ---------------------------------------------------------------------------
+
+def test_submit_rejects_bad_requests_typed(lm):
+    eng = DecodeEngine(lm, slots=1, page=8)  # never started: pure checks
+    with pytest.raises(ServeError, match="non-empty"):
+        eng.submit(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ServeError, match="max_tokens"):
+        eng.submit(np.ones(3, np.int32), 0)
+    with pytest.raises(ServeError, match="max_len"):
+        eng.submit(np.ones(3, np.int32), 1000)
+    with pytest.raises(ValueError, match="max_len"):
+        DecodeEngine(lm, max_len=4096)  # beyond the PE cap
+
+
+def test_queue_deadline_times_out_typed(lm):
+    # slot pinned busy by a long sequence at a paced step floor; the
+    # queued request's time-to-last-token deadline passes before a slot
+    # frees -> typed RequestTimeout at dequeue, engine keeps serving
+    prompt = _prompts(1, seed=6)[0]
+    with DecodeEngine(lm, slots=1, page=8, min_step_s=0.02) as eng:
+        slow = eng.submit(prompt, 30)
+        late = eng.submit(prompt, 4, deadline_ms=40.0)
+        with pytest.raises(RequestTimeout):
+            late.result(120.0)
+        np.testing.assert_array_equal(slow.result(120.0),
+                                      _oracle(lm, prompt, 30))
+        assert eng.stats()["queue"]["shed_timeout"] == 1
+
+
+def test_tenant_quota_rejects_typed(lm):
+    with DecodeEngine(lm, slots=1, page=8, tenant_qps=0.001,
+                      tenant_burst=1) as eng:
+        prompt = _prompts(1, seed=7)[0]
+        first = eng.submit(prompt, 2, tenant="team-a")
+        with pytest.raises(QuotaExceeded):
+            eng.submit(prompt, 2, tenant="team-a")
+        first.result(120.0)
+
+
+def test_chaos_slot_fault_fails_one_sequence_others_bit_match(lm):
+    # the serve.decode@<slot> drill: slot 1's sequence dies typed, the
+    # slot frees, every OTHER sequence still bit-matches the oracle
+    prompts = _prompts(4, seed=8)
+    with chaos.scoped("serve.decode@1=fail@2"):
+        with DecodeEngine(lm, slots=2, page=8) as eng:
+            handles = [eng.submit(p, 5) for p in prompts]
+            failed, survived = [], []
+            for p, h in zip(prompts, handles):
+                try:
+                    survived.append((p, h.result(120.0)))
+                except chaos.ChaosFault:
+                    failed.append(h)
+            st = eng.stats()
+    assert len(failed) == 1 and st["seqs_failed"] == 1
+    assert len(survived) == 3 and st["seqs_done"] == 3
+    for p, out in survived:
+        np.testing.assert_array_equal(out, _oracle(lm, p, 5))
+
+
+# ---------------------------------------------------------------------------
+# tp-sharded decode (satellite: (1,1,2) mesh parity + halved cache)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 devices")
+def test_tp_sharded_cached_generate_matches_single_device(lm):
+    from bigdl_tpu.parallel import MeshLayout
+    mesh = MeshLayout(1, 1, 2).build_mesh(jax.devices()[:2])
+    prompt = _prompts(1, seed=9)[0]
+    ref = _oracle(lm, prompt, 6)
+    got = cached_generate(lm, prompt, 6, max_len=len(prompt) + 6,
+                          mesh=mesh)
+    # greedy TOKENS match (the tp o-projection all-reduce reorders float
+    # sums, so logits are close-not-equal; argmax is the contract)
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 devices")
+def test_tp_sharded_kv_cache_halves_per_device(lm):
+    from bigdl_tpu.parallel import MeshLayout
+    mesh = MeshLayout(1, 1, 2).build_mesh(jax.devices()[:2])
+    caches = init_kv_cache(lm, batch=2, max_len=32, mesh=mesh)
+    for cache in caches:
+        for arr in (cache["k"], cache["v"]):
+            # head axis (2 heads) split exactly in half over tp
+            assert len(arr.sharding.device_set) == 2
+            shard_bytes = {s.data.nbytes for s in arr.addressable_shards}
+            assert shard_bytes == {arr.nbytes // 2}
+
+
+# ---------------------------------------------------------------------------
+# trace + telemetry integration
+# ---------------------------------------------------------------------------
+
+def test_trace_event_gen_metadata_round_trips(tmp_path):
+    path = str(tmp_path / "gen.trace")
+    ev = TraceEvent(0.5, np.arange(4, dtype=np.int32), tenant="t",
+                    priority=2, deadline_ms=100.0,
+                    gen={"max_tokens": 8, "temperature": 0.0})
+    write_trace(path, [ev, TraceEvent(0.1, np.ones(2, np.float32))])
+    header, events = read_trace(path)
+    assert header["count"] == 2
+    assert events[0].gen == {"max_tokens": 8, "temperature": 0.0}
+    assert events[1].gen is None  # non-generative events unchanged
+    np.testing.assert_array_equal(events[0].payload,
+                                  np.arange(4, dtype=np.int32))
+
+
+def test_engine_records_gen_trace(lm, tmp_path):
+    path = str(tmp_path / "rec.trace")
+    prompt = _prompts(1, seed=10)[0]
+    with DecodeEngine(lm, slots=1, page=8) as eng:
+        eng.record_trace(path)
+        eng.generate(prompt, 3, tenant="team-a")
+        eng.stop_trace()
+    _, events = read_trace(path)
+    assert len(events) == 1 and events[0].tenant == "team-a"
+    assert events[0].gen["max_tokens"] == 3
+    np.testing.assert_array_equal(events[0].payload, prompt)
+
+
+def test_http_generate_route_bit_matches_and_types_errors(lm):
+    import json
+    import sys
+    import urllib.error
+    import urllib.request
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.serve import InferenceServer
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import serve_http
+
+    model = nn.Sequential().add(nn.Linear(4, 3)).build(jax.random.key(0))
+    server = InferenceServer(model, example=np.zeros((4,), np.float32))
+    server.start()
+    engine = DecodeEngine(lm, slots=2, page=8).start()
+    server.decode_engine = engine  # what main() --generate wires up
+    httpd = serve_http.serve_forever(server, "127.0.0.1", 0)
+    try:
+        port = httpd.server_address[1]
+        prompt = [3, 9, 21, 5]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps({"prompt": prompt, "max_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        ref = _oracle(lm, np.asarray(prompt, np.int32), 5)
+        assert resp["tokens"] == ref.tolist() and resp["generated"] == 5
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/stats", timeout=10).read())
+        assert st["decode"]["seqs_done"] == 1
+        # typed rejection surfaces as HTTP 400, not a 500
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"prompt": [],
+                                 "max_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=10)
+        assert exc.value.code == 400
+    finally:
+        httpd.shutdown()
+        engine.stop()
+        server.stop()
+
+
+def test_decode_counter_track_promotes_to_report_section(lm):
+    from bigdl_tpu.utils import telemetry
+    bd = telemetry.phase_breakdown({"traceEvents": [
+        {"ph": "C", "name": "serve.decode", "ts": 1.0,
+         "args": {"tokens_per_s": 350.0, "fill": 0.75,
+                  "cache_bytes_per_slot": 16384}},
+    ]})
+    assert bd["decode"]["tokens_per_s"] == 350.0
+    assert bd["decode"]["fill"] == 0.75
+    assert "decode:" in telemetry.format_report(bd)
+    # and the live engine actually emits the track
+    with DecodeEngine(lm, slots=1, page=8) as eng:
+        eng.generate(_prompts(1, seed=11)[0], 2)
+        st = eng.stats()
+    assert st["tokens_per_s"] > 0 and st["cache_bytes_per_slot"] > 0

@@ -46,7 +46,16 @@ utils/hlostats.py):
    ``m*v`` are pinned, and the XLA temp budget of the 1F1B step over the
    GPipe step (batch 256, activations dominating) must stay <= 1 — a
    schedule memory regression fails the gate.
-7. **router dispatch overhead** (ISSUE 14): the serving topology
+7. **generative decode** (ISSUE 18): (a) the KV-cache O(L) claim as
+   the ``kv_cache``/``full_fwd`` seconds ratio from
+   ``bigdl_tpu/tools/serving_bench.py``, pinned on a CPU-sized LM so
+   every PR gates the decode fast path against the full re-forward;
+   (b) the continuous-batching ``DecodeEngine`` end-to-end tokens/s
+   floor and its per-slot KV-cache footprint
+   (``decode.cache_bytes_per_slot``, exact — a cache-layout or
+   page-ladder regression changes the byte count before it changes a
+   benchmark).
+8. **router dispatch overhead** (ISSUE 14): the serving topology
    router's per-request (bucket, queue-depth) routing decision
    (``TopologyRouter._pick``) over a 4-member pool, bounded in host
    microseconds — the tax scale-out routing adds in front of every
@@ -111,6 +120,18 @@ DEFAULT_RATIO_BOUNDS = {
         "note": "XLA temp budget of the compiled 1F1B step / GPipe step "
                 "at batch 256 (activations dominate) — the schedule "
                 "memory claim as a compiled-program invariant"},
+    "serving.kv_over_full": {
+        "value": 0.5, "match": "max",
+        "note": "cached_generate (KV decode) seconds / greedy_generate "
+                "(full re-forward) seconds at equal generated tokens — "
+                "serving_bench's kv_cache/full_fwd row as a gate "
+                "(measured ~0.06 on CPU; the bound just has to catch "
+                "the fast path degenerating to the O(L^2) one)"},
+    "decode.tokens_per_s": {
+        "value": 50.0, "match": "min",
+        "note": "continuous-batching DecodeEngine end-to-end tokens/s "
+                "on the CPU proxy LM (measured ~1000+; conservative "
+                "floor, catches a pathological per-step stall)"},
     "router.dispatch_us": {
         "value": 100.0, "match": "max",
         "note": "TopologyRouter._pick host microseconds per routing "
@@ -357,8 +378,59 @@ def measure(batch_size=64):
                       "cache_dir": cache_dir}
     _fresh({"BIGDL_TPU_AOT_CACHE": None, "BIGDL_TPU_XLA_CACHE": None})
 
-    # ---- proxies 4+5: pipeline + expert step shapes ------------------
+    # ---- proxy 7: generative decode (serve/decode.py, ISSUE 18) ------
+    # (a) the KV-cache fast-path claim as serving_bench's
+    #     kv_cache/full_fwd seconds ratio on a CPU-sized LM: equal
+    #     generated tokens, 1-token prompt so no prefill skews it
     import jax
+    import numpy as np
+
+    from bigdl_tpu.models import TransformerLM, cached_generate
+    from bigdl_tpu.models.transformer_lm import greedy_generate
+    lm = TransformerLM(vocab_size=256, max_len=128, d_model=64,
+                       num_heads=4, num_layers=2).build(jax.random.key(0))
+    prompt1 = np.ones((4, 1), np.int32)
+
+    def _best(fn, n=3):
+        fn()  # compile + warm
+        times = []
+        for _ in range(n):
+            t1 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t1)
+        return min(times)  # serving_bench convention: best of N
+
+    full_s = _best(lambda: greedy_generate(lm, prompt1, 32, 128))
+    kv_s = _best(lambda: cached_generate(lm, prompt1, 32, max_len=128))
+    measured["serving.kv_over_full"] = round(kv_s / max(full_s, 1e-9), 4)
+
+    # (b) the continuous-batching engine end to end: tokens/s floor +
+    #     the per-slot KV footprint as an exact structural row (slots=4,
+    #     page=16 ladder on the same LM — deterministic byte count)
+    from bigdl_tpu.serve import DecodeEngine
+    drng = np.random.default_rng(3)
+    with DecodeEngine(lm, slots=4, page=16) as eng:
+        # warm-up request pays the prefill+decode compiles; the timed
+        # batch then measures the steady step loop, not the lowering
+        eng.generate(drng.integers(1, 256, size=5).astype(np.int32), 8,
+                     timeout=120)
+        t_dec = time.perf_counter()
+        handles = [eng.submit(drng.integers(1, 256, size=5).astype(np.int32),
+                              8) for _ in range(8)]
+        for h in handles:
+            h.result(120)
+        decode_wall = time.perf_counter() - t_dec
+        dstats = eng.stats()
+    measured["decode.tokens_per_s"] = round(8 * 8 / max(decode_wall, 1e-9),
+                                            1)
+    measured["decode.cache_bytes_per_slot"] = dstats["cache_bytes_per_slot"]
+    context["decode"] = {"full_fwd_s": round(full_s, 4),
+                         "kv_cache_s": round(kv_s, 4),
+                         "tokens_out": dstats["tokens_out"],
+                         "cache_len": dstats["cache_len"],
+                         "decode_steps": dstats["decode_steps"]}
+
+    # ---- proxies 4+5: pipeline + expert step shapes ------------------
     if jax.device_count() < 2:
         context["pipe_expert"] = {
             "skipped": f"need >= 2 devices, have {jax.device_count()} "
@@ -415,7 +487,7 @@ def measure(batch_size=64):
     measured["moe.all_to_all"] = ep_card.get("ops", {}).get("all-to-all", 0)
     context["expert"]["ep_collectives"] = ep_card.get("collectives")
 
-    # ---- proxy 7: router dispatch overhead (serve/router.py) ---------
+    # ---- proxy 8: router dispatch overhead (serve/router.py) ---------
     # the (bucket, depth) routing decision is pure host work in front of
     # EVERY request — bound its per-call cost over a 4-member pool so a
     # quadratic-scan or lock-contention regression fails the gate before
@@ -442,7 +514,7 @@ def measure(batch_size=64):
         (time.perf_counter() - t0_pick) / n_picks * 1e6, 3)
     context["router"] = {"members": n_members, "picks": n_picks}
 
-    # ---- proxy 7b: fleet front dispatch overhead (serve/fleetfront.py)
+    # ---- proxy 8b: fleet front dispatch overhead (serve/fleetfront.py)
     # the cross-process fleet keeps the router's (bucket, depth) decision
     # but computes it off the CACHED registry — bound the per-request
     # host cost so a registry-listing-per-pick regression (cache bypass)

@@ -9,6 +9,14 @@ pool batches across connections).  Endpoints:
     POST /v1/predict   {"inputs": <sample or list of samples>,
                         "tenant": "team-a", "priority": 2}
                        -> {"outputs": ..., "version": N, "latency_ms": x}
+    POST /v1/generate  {"prompt": [1, 5, 9], "max_tokens": 32,
+                        "temperature": 0.0, "eos_token": 2,
+                        "tenant": "team-a", "priority": 2}
+                       -> {"tokens": [...], "generated": N,
+                           "latency_ms": x} — continuous-batching
+                          autoregressive decode (serve/decode.py);
+                          requires --generate (404 otherwise).  The
+                          deadline is time-to-LAST-token.
     POST /v1/swap      {"source": "<ckpt dir | snapshot | module file>",
                         "quantized": false, "canary_fraction": 0.1}
                        -> {"version": N}
@@ -138,7 +146,11 @@ def make_handler(server):
                 self._reply(200, {"ok": True,
                                   "version": server.version.id})
             elif self.path == "/v1/stats":
-                self._reply(200, server.stats())
+                st = server.stats()
+                eng = getattr(server, "decode_engine", None)
+                if eng is not None:
+                    st["decode"] = eng.stats()
+                self._reply(200, st)
             elif self.path == "/v1/versions":
                 ctl = getattr(server, "_deploy", None)
                 if ctl is None:
@@ -159,6 +171,8 @@ def make_handler(server):
                 return self._reply(400, {"error": f"bad JSON: {e}"})
             if self.path == "/v1/predict":
                 return self._predict(body)
+            if self.path == "/v1/generate":
+                return self._generate(body)
             if self.path == "/v1/swap":
                 return self._swap(body)
             self._reply(404, {"error": f"no route {self.path}"})
@@ -220,6 +234,62 @@ def make_handler(server):
                 "outputs": (out if batched else out[0]).tolist(),
                 "version": handles[-1].version,
                 "latency_ms": round(lat * 1e3, 3)})
+
+        def _generate(self, body):
+            eng = getattr(server, "decode_engine", None)
+            if eng is None:
+                return self._reply(404, {
+                    "error": "no decode engine attached (start "
+                             "serve_http with --generate)"})
+            rt = self.headers.get("X-BigDL-Record-Trace")
+            if rt:
+                if rt.strip().lower() in ("off", "stop", "0"):
+                    eng.stop_trace()
+                else:
+                    eng.record_trace(rt.strip())
+            if "prompt" not in body:
+                return self._reply(400, {"error": "missing 'prompt'"})
+            kw = dict(deadline_ms=body.get("deadline_ms"),
+                      tenant=body.get("tenant"),
+                      priority=int(body.get("priority", 0)),
+                      temperature=float(body.get("temperature", 0.0)),
+                      top_k=int(body.get("top_k", 0)),
+                      seed=int(body.get("seed", 0)))
+            if "eos_token" in body:
+                kw["eos_token"] = (int(body["eos_token"])
+                                   if body["eos_token"] is not None
+                                   else None)
+            prompt = np.asarray(body["prompt"], np.int32)
+            try:
+                h = eng.submit(prompt, int(body.get("max_tokens", 16)),
+                               **kw)
+                out = h.result(timeout=body.get("timeout_s", 120))
+            except ServerOverloaded as e:
+                retry = getattr(e, "retry_after_s", None)
+                hdrs = ({"Retry-After": str(max(1, int(retry + 0.999)))}
+                        if retry else None)
+                return self._reply(429, {"error": str(e),
+                                         "type": type(e).__name__,
+                                         "retry_after_s": retry},
+                                   headers=hdrs)
+            except RequestTimeout as e:
+                return self._reply(504, {"error": str(e),
+                                         "type": "RequestTimeout"})
+            except ServerClosed as e:
+                return self._reply(503, {"error": str(e),
+                                         "type": "ServerClosed"},
+                                   headers=self._retry_after())
+            except ServeError as e:
+                return self._reply(400, {"error": str(e),
+                                         "type": type(e).__name__})
+            except Exception as e:  # noqa: BLE001 — typed per-request
+                return self._reply(500, {"error": str(e),
+                                         "type": type(e).__name__})
+            out = np.asarray(out)
+            self._reply(200, {
+                "tokens": out.tolist(),
+                "generated": int(out.shape[0] - prompt.shape[0]),
+                "latency_ms": round((h.latency_s or 0.0) * 1e3, 3)})
 
         def _swap(self, body):
             src = body.get("source") or body.get("checkpoint")
@@ -294,6 +364,14 @@ def main(argv=None):
     ap.add_argument("--rollback-budget", type=int, default=None,
                     help="with --watch: consecutive canary rollbacks "
                          "before the controller freezes")
+    ap.add_argument("--generate", action="store_true",
+                    help="attach a continuous-batching DecodeEngine "
+                         "(serve/decode.py) serving POST /v1/generate; "
+                         "BIGDL_TPU_DECODE_* tunes slots/pages/queue")
+    ap.add_argument("--gen-vocab", type=int, default=256,
+                    help="with --generate: TransformerLM vocab size")
+    ap.add_argument("--gen-max-len", type=int, default=512,
+                    help="with --generate: positional max_len cap")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     args = ap.parse_args(argv)
@@ -322,6 +400,16 @@ def main(argv=None):
     server.start()
     if args.checkpoint:
         server.swap(args.checkpoint, quantized=args.quantized)
+    engine = None
+    if args.generate:
+        from bigdl_tpu.models.transformer_lm import TransformerLM
+        from bigdl_tpu.serve import DecodeEngine
+        lm = TransformerLM(vocab_size=args.gen_vocab,
+                           max_len=args.gen_max_len, d_model=64,
+                           num_heads=4, num_layers=2)
+        lm.build()
+        engine = DecodeEngine(lm).start()
+        server.decode_engine = engine
     controller = None
     if args.watch:
         from bigdl_tpu.serve.continuous import DeployController
@@ -333,6 +421,7 @@ def main(argv=None):
                       "model": args.model,
                       "version": server.version.id,
                       "watching": args.watch,
+                      "generate": bool(engine),
                       "stats": "/v1/stats"}), flush=True)
     # rolling restarts send SIGTERM: stop accepting, then DRAIN — every
     # request already admitted is answered before the process exits
@@ -355,6 +444,8 @@ def main(argv=None):
         httpd.shutdown()
         if controller is not None:
             controller.stop()
+        if engine is not None:
+            engine.stop(drain=True)
         server.stop(drain=True)
     return 0
 

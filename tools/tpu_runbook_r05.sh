@@ -324,6 +324,23 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] fleet smoke FAILED rc=$FLEET_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # 2r. continuous-batching decode smoke (ISSUE 18): a mixed-length
+  # generation trace replayed against the DecodeEngine — greedy outputs
+  # must BIT-match the cached_generate oracle, continuous admission must
+  # beat run-to-completion static batching STRICTLY on tokens/s and SLO
+  # attainment (self-calibrated deadline), prefill/decode emit separate
+  # compile cards, and a second process through the shared AOT cache
+  # must report zero fresh lowers; one JSON line, exit-coded
+  echo "[runbook] 2r/4 decode smoke (continuous batching vs static + oracle bit-match + warm steady state)" >> "$LOG"
+  timeout 420 python tools/decode_smoke.py --platform cpu \
+    > /tmp/decode_smoke.json 2>/tmp/decode_smoke.log
+  DECODE_RC=$?
+  if [ "$DECODE_RC" = 0 ]; then
+    echo "[runbook] decode smoke OK (bit-match, continuous > static, zero warm lowers) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] decode smoke FAILED rc=$DECODE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -352,7 +369,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, fleet_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, fleet_smoke.json, decode_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
