@@ -50,7 +50,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ..utils import chaos, telemetry
+from ..utils import chaos, metrics_export, telemetry
 
 __all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
            "RequestTimeout", "PendingRequest", "DynamicBatcher",
@@ -95,11 +95,13 @@ class PendingRequest:
     ChaosFault / StallError...)."""
 
     __slots__ = ("payload", "enqueued", "deadline", "tenant", "priority",
-                 "version", "latency_s", "_event", "_result", "_error")
+                 "version", "latency_s", "rid", "rid_owner",
+                 "_event", "_result", "_error")
 
     def __init__(self, payload, enqueued: float,
                  deadline: Optional[float] = None,
-                 tenant: Optional[str] = None, priority: int = 0):
+                 tenant: Optional[str] = None, priority: int = 0,
+                 rid: Optional[str] = None, rid_owner: bool = False):
         self.payload = payload
         self.enqueued = enqueued
         self.deadline = deadline
@@ -107,6 +109,8 @@ class PendingRequest:
         self.priority = int(priority)  # higher = shed later
         self.version = None      # model version id that answered
         self.latency_s = None    # enqueue -> resolve
+        self.rid = rid           # request flow id (X-BigDL-Request-Id)
+        self.rid_owner = rid_owner  # this process minted it (it finishes)
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -118,11 +122,26 @@ class PendingRequest:
         self._result = result
         self._error = error
         self.version = version
+        status = type(error).__name__ if error is not None else "ok"
         if now is not None:
             self.latency_s = max(now - self.enqueued, 0.0)
-            telemetry.complete(
-                "serve.request", self.latency_s, cat="serve",
-                status=type(error).__name__ if error is not None else "ok")
+            if self.rid is None:
+                telemetry.complete("serve.request", self.latency_s,
+                                   cat="serve", status=status)
+            else:
+                telemetry.complete("serve.request", self.latency_s,
+                                   cat="serve", status=status, req=self.rid)
+            reg = metrics_export._REGISTRY
+            if reg is not None:
+                reg.observe_request(self.latency_s, status)
+        if self.rid is not None:
+            # the minter closes the flow; a fleet-arrived id gets a step
+            # (the front owns the "f" for the whole cross-process chain)
+            if self.rid_owner:
+                telemetry.flow_finish(self.rid, hop="resolve",
+                                      status=status)
+            else:
+                telemetry.flow_step(self.rid, hop="resolve", status=status)
         self._event.set()
 
     def done(self) -> bool:
@@ -136,6 +155,13 @@ class PendingRequest:
         if self._error is not None:
             raise self._error
         return self._result
+
+
+def _metrics_shed(cause: str) -> None:
+    """Count one shed on the live-metrics plane (no-op when unarmed)."""
+    reg = metrics_export._REGISTRY
+    if reg is not None:
+        reg.shed(cause)
 
 
 def default_buckets(max_batch: int) -> tuple:
@@ -265,6 +291,7 @@ class DynamicBatcher:
                 expired.append(r)
                 self.shed_timeout += 1
                 self._count_shed(r)
+                _metrics_shed("timeout")
             else:
                 live.append(r)
         self._q = live
@@ -293,14 +320,25 @@ class DynamicBatcher:
 
     def submit(self, payload, deadline: Optional[float] = None, *,
                tenant: Optional[str] = None,
-               priority: int = 0) -> PendingRequest:
+               priority: int = 0,
+               request_id: Optional[str] = None) -> PendingRequest:
         """Enqueue one sample; raises :class:`ServerOverloaded` when the
         bounded queue is full, :class:`ServerClosed` after shutdown.
         ``deadline`` is absolute (this batcher's clock).  When the queue
         is full, expired-deadline entries are swept first, then the
         LOWEST-priority queued request is evicted if this arrival
-        strictly outranks it (shed-lowest-first under pressure)."""
+        strictly outranks it (shed-lowest-first under pressure).
+
+        ``request_id`` is the distributed-tracing flow id: pass the one
+        from the ``X-BigDL-Request-Id`` header when the request arrived
+        through the fleet front (its flow already started there); when
+        omitted and tracing is on, one is minted here and this process
+        owns (finishes) the flow."""
         chaos.fire("serve.request")  # admission-path fault point
+        rid, rid_owner = request_id, False
+        if rid is None:
+            rid = telemetry.mint_request_id()  # None when tracing is off
+            rid_owner = rid is not None
         expired: List[PendingRequest] = []
         victim: Optional[PendingRequest] = None
         with self._cond:
@@ -317,10 +355,12 @@ class DynamicBatcher:
                     victim = cand
                     self.shed_priority += 1
                     self._count_shed(cand)
+                    _metrics_shed("priority")
                 else:
                     self.shed_overload += 1
                     self.shed_by_priority[int(priority)] = \
                         self.shed_by_priority.get(int(priority), 0) + 1
+                    _metrics_shed("overloaded")
                     retry = self.retry_after_s()
                     raise ServerOverloaded(
                         f"serve: request queue full ({self.queue_limit} "
@@ -328,11 +368,17 @@ class DynamicBatcher:
                         f"— shedding at admission; retry in {retry}s",
                         retry_after_s=retry)
             req = PendingRequest(payload, self.clock(), deadline,
-                                 tenant=tenant, priority=priority)
+                                 tenant=tenant, priority=priority,
+                                 rid=rid, rid_owner=rid_owner)
             self._q.append(req)
             self.submitted += 1
             depth = len(self._q)
             self._cond.notify_all()
+        if rid is not None:
+            if rid_owner:
+                telemetry.flow_start(rid, hop="queue.enqueue", depth=depth)
+            else:
+                telemetry.flow_step(rid, hop="queue.enqueue", depth=depth)
         now = self.clock()
         for r in expired:
             r._resolve(error=RequestTimeout(
@@ -398,6 +444,7 @@ class DynamicBatcher:
                 with self._cond:
                     self.shed_timeout += 1
                     self._count_shed(r)
+                _metrics_shed("timeout")
                 r._resolve(error=RequestTimeout(
                     f"serve: deadline exceeded after "
                     f"{now - r.enqueued:.3f}s in queue"), now=now)
@@ -514,9 +561,10 @@ class DecodeQueue(DynamicBatcher):
 
     def submit(self, payload, deadline: Optional[float] = None, *,
                tenant: Optional[str] = None,
-               priority: int = 0) -> PendingRequest:
+               priority: int = 0,
+               request_id: Optional[str] = None) -> PendingRequest:
         req = super().submit(payload, deadline, tenant=tenant,
-                             priority=priority)
+                             priority=priority, request_id=request_id)
         with self._cond:
             self._pending_tokens += int(payload.get("max_tokens", 1)) \
                 if isinstance(payload, dict) else 1
@@ -552,6 +600,7 @@ class DecodeQueue(DynamicBatcher):
                 with self._cond:
                     self.shed_timeout += 1
                     self._count_shed(r)
+                _metrics_shed("timeout")
                 r._resolve(error=RequestTimeout(
                     f"serve: deadline exceeded after "
                     f"{now - r.enqueued:.3f}s in queue (decode "

@@ -87,7 +87,7 @@ from ..nn.attention import MultiHeadAttention
 from ..nn.containers import ConcatTable, Sequential
 from ..nn.module import Container
 from ..utils import aot as aot_mod
-from ..utils import chaos, config, hlostats, telemetry
+from ..utils import chaos, config, hlostats, metrics_export, telemetry
 from .batcher import DecodeQueue, PendingRequest, ServeError
 from .control import TenantQuotas
 
@@ -330,13 +330,17 @@ class DecodeEngine:
                deadline_ms: Optional[float] = None,
                tenant: Optional[str] = None, priority: int = 0,
                temperature: float = 0.0, top_k: int = 0,
-               eos_token=_UNSET, seed: int = 0) -> PendingRequest:
+               eos_token=_UNSET, seed: int = 0,
+               request_id: Optional[str] = None) -> PendingRequest:
         """Enqueue one sequence; returns a PendingRequest whose
         ``result()`` is the full int32 token row (prompt + generated,
         the ``cached_generate`` contract, truncated at EOS).  Typed
         rejections: ServeError (bad request), QuotaExceeded,
         ServerOverloaded, ServerClosed; RequestTimeout resolves later if
-        the time-to-last-token deadline passes in the queue."""
+        the time-to-last-token deadline passes in the queue.
+        ``request_id`` is the distributed-tracing flow id from the
+        ``X-BigDL-Request-Id`` header (minted locally when absent and
+        tracing is on)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
             raise ServeError("decode: prompt must be a non-empty 1-D "
@@ -365,7 +369,8 @@ class DecodeEngine:
                                 gen=gen)
         payload = dict(gen, prompt=prompt, eos=eos)
         return self.queue.submit(payload, deadline, tenant=tenant,
-                                 priority=priority)
+                                 priority=priority,
+                                 request_id=request_id)
 
     def generate(self, prompt, max_tokens: int,
                  timeout: Optional[float] = 120.0, **kw) -> np.ndarray:
@@ -568,6 +573,10 @@ class DecodeEngine:
     def _fail_slot(self, s: int, err: Exception) -> None:
         seq = self._slots[s]
         if seq is not None:
+            if seq.req.rid is not None:
+                # the fault lands on the request's flow (failover segment)
+                telemetry.flow_step(seq.req.rid, hop="decode.fault",
+                                    slot=s, error=type(err).__name__)
             seq.req._resolve(error=err, now=self.clock())
             self._slots[s] = None
             self.seqs_failed += 1
@@ -576,6 +585,11 @@ class DecodeEngine:
         seq = self._slots[s]
         out = seq.buf[: seq.t0 + seq.emitted].copy()
         seq.req._resolve(result=out, version="decode", now=self.clock())
+        reg = metrics_export._REGISTRY
+        if reg is not None and seq.req.latency_s is not None:
+            reg.observe("bigdl_decode_ttlt_seconds", seq.req.latency_s,
+                        help="time to last token (submit to full row), "
+                             "seconds")
         self._slots[s] = None
         self.seqs_done += 1
 
@@ -592,6 +606,11 @@ class DecodeEngine:
         seq.buf[seq.pos] = tok
         seq.emitted += 1
         self.tokens_out += 1
+        if seq.req.rid is not None:
+            # one flow step per emitted token: the per-token decode ticks
+            # become arrows on the request's chain in Perfetto
+            telemetry.flow_step(seq.req.rid, hop="decode.tick",
+                                slot=s, n=seq.emitted)
         if (seq.eos is not None and tok == seq.eos) or \
                 seq.emitted >= seq.max_tokens:
             self._finish_slot(s)
@@ -605,6 +624,9 @@ class DecodeEngine:
         seq = _Seq(req, prompt, p["max_tokens"], p.get("eos"),
                    p.get("temperature", 0.0), p.get("top_k", 0), rng)
         self._slots[s] = seq
+        if req.rid is not None:
+            telemetry.flow_step(req.rid, hop="decode.admit", slot=s,
+                                prompt_len=t0)
         try:
             chaos.fire(f"serve.decode@{s}", thread_exc=SlotFault)
         except Exception as e:  # noqa: BLE001 — typed per-sequence fail

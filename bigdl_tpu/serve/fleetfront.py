@@ -57,7 +57,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..utils import config, telemetry
+from ..utils import config, metrics_export, telemetry
 from . import fleet
 from .batcher import (RequestTimeout, ServeError, ServerClosed,
                       ServerOverloaded)
@@ -187,13 +187,19 @@ class FleetFront:
                 f"{record['port']}{route}")
 
     def _post(self, record: dict, route: str, body: dict,
-              timeout: Optional[float] = None):
+              timeout: Optional[float] = None,
+              request_id: Optional[str] = None):
         """POST JSON to one member; returns (status, parsed body).
         Raises URLError/OSError on transport failure (the caller's
-        retry-on-next-member signal)."""
+        retry-on-next-member signal).  ``request_id`` rides the
+        ``X-BigDL-Request-Id`` header so the member joins the request's
+        flow (and echoes the id back)."""
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers[telemetry.REQUEST_ID_HEADER] = request_id
         req = urllib.request.Request(
             self._url(record, route), data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout_s) as resp:
@@ -211,6 +217,32 @@ class FleetFront:
         with urllib.request.urlopen(self._url(record, route),
                                     timeout=timeout or self.timeout_s) as r:
             return json.loads(r.read().decode())
+
+    def _get_text(self, record: dict, route: str,
+                  timeout: Optional[float] = None) -> str:
+        with urllib.request.urlopen(self._url(record, route),
+                                    timeout=timeout or self.timeout_s) as r:
+            return r.read().decode()
+
+    # -- live metrics (GET /metrics on the front) ------------------------
+
+    def metrics_text(self) -> str:
+        """The front's Prometheus exposition: its own registry (request
+        latency/SLO as routed callers saw them, failovers included)
+        followed by the fleet-wide rollup — every live member's
+        ``/metrics`` scraped and re-exported under a ``fleet_`` prefix
+        with ``member`` labels plus per-series fleet sums.  One scrape of
+        the front sees the whole fleet; a member whose scrape fails is
+        skipped (its supervisor owns it), not fatal."""
+        reg = metrics_export.registry()
+        own = reg.render() if reg is not None else ""
+        member_texts: Dict[str, str] = {}
+        for i, record in self._refresh().items():
+            try:
+                member_texts[str(i)] = self._get_text(record, "/metrics")
+            except Exception:  # noqa: BLE001 — scrape best-effort
+                continue
+        return metrics_export.render_rollup(own, member_texts)
 
     @staticmethod
     def _typed(status: int, body: dict):
@@ -232,10 +264,25 @@ class FleetFront:
             "fleet: no live member in the registry — every worker is "
             "lost, condemned, or degraded", retry_after_s=1.0)
 
-    def _dispatch(self, x: np.ndarray, deadline_ms, tenant, priority):
+    def _finish_flow(self, rid, t0, status: str) -> None:
+        """Close the request's flow and feed the front's metrics (the
+        front MINTED the id, so it owns the "f" phase)."""
+        if rid is not None:
+            telemetry.flow_finish(rid, hop="front.done", status=status)
+        dt = self.clock() - t0
+        if rid is not None:
+            telemetry.complete("fleet.request", dt, cat="fleet",
+                               status=status, req=rid)
+        reg = metrics_export._REGISTRY
+        if reg is not None:
+            reg.observe_request(dt, status)
+
+    def _dispatch(self, x: np.ndarray, deadline_ms, tenant, priority,
+                  rid=None):
         """Runs in the pool: route, POST, retry-on-next-member (bounded,
         idempotent predicts only).  Returns (outputs, version,
         latency_s)."""
+        t0 = self.clock()
         body = {"inputs": x.tolist(), "timeout_s": self.timeout_s}
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
@@ -256,8 +303,11 @@ class FleetFront:
             with self._lock:
                 self._inflight[i] = self._inflight.get(i, 0) + 1
                 self._routed[i] = self._routed.get(i, 0) + 1
+            if rid is not None:
+                telemetry.flow_step(rid, hop="front.send", member=i)
             try:
-                status, resp = self._post(record, "/v1/predict", body)
+                status, resp = self._post(record, "/v1/predict", body,
+                                          request_id=rid)
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 # transport failure: the member died under us (kill -9
                 # drill) or never bound — try the next one
@@ -267,16 +317,23 @@ class FleetFront:
                     self._retried += 1
                 telemetry.instant("fleet.retry", cat="fleet", member=i,
                                   error=type(e).__name__)
+                if rid is not None:
+                    # the failover lands on this request's flow: the
+                    # arrow chain shows WHICH member the request lost
+                    telemetry.flow_step(rid, hop="fleet.retry", member=i,
+                                        error=type(e).__name__)
                 continue
             finally:
                 with self._lock:
                     self._inflight[i] = max(self._inflight.get(i, 1) - 1, 0)
             if status == 200:
                 out = np.asarray(resp["outputs"], np.float32)
+                self._finish_flow(rid, t0, "ok")
                 return (out, resp.get("version"),
                         float(resp.get("latency_ms", 0.0)) / 1e3)
             err = self._typed(status, resp)
             if err is not None:
+                self._finish_flow(rid, t0, type(err).__name__)
                 raise err
             # 503 / 5xx: that member is unhealthy or mid-replacement —
             # its supervisor owns it; route around
@@ -287,6 +344,10 @@ class FleetFront:
                 self._retried += 1
             telemetry.instant("fleet.retry", cat="fleet", member=i,
                               status=status)
+            if rid is not None:
+                telemetry.flow_step(rid, hop="fleet.retry", member=i,
+                                    status=status)
+        self._finish_flow(rid, t0, "MemberLostError")
         if last_exc is not None and not self._refresh(force=True):
             raise self._no_member()
         if last_exc is not None:
@@ -297,22 +358,34 @@ class FleetFront:
         raise self._no_member()
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               tenant: Optional[str] = None, priority: int = 0):
+               tenant: Optional[str] = None, priority: int = 0,
+               request_id: Optional[str] = None):
         """Admit one sample: returns a handle whose ``result()`` blocks
         on the HTTP round trip (+ bounded failover).  Raises
         :class:`MemberLostError` at ADMISSION when no member is live —
         the typed 503 the replay accounting records as a shed, never a
-        silently lost accepted request."""
+        silently lost accepted request.  When tracing is on, the front
+        mints the request's flow id here (``request_id`` overrides — a
+        caller propagating an upstream id) and every hop downstream
+        links to it."""
         if self._closed:
             raise ServerClosed("fleet: front tier is closed")
         x = np.asarray(x, np.float32)
         if self._recorder is not None:
             self._recorder.note(x, tenant=tenant, priority=priority,
                                 deadline_ms=deadline_ms)
+        rid = request_id
+        if rid is None:
+            rid = telemetry.mint_request_id()  # None when tracing is off
+        if rid is not None:
+            telemetry.flow_start(rid, hop="front.admit")
         if self._pick() is None:
+            if rid is not None:
+                telemetry.flow_finish(rid, hop="front.done",
+                                      status="MemberLostError")
             raise self._no_member()
         return _FleetHandle(self._pool.submit(
-            self._dispatch, x, deadline_ms, tenant, priority))
+            self._dispatch, x, deadline_ms, tenant, priority, rid))
 
     def predict(self, x, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None):

@@ -308,7 +308,8 @@ class TopologyRouter:
         return best
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               tenant: Optional[str] = None, priority: int = 0):
+               tenant: Optional[str] = None, priority: int = 0,
+               request_id: Optional[str] = None):
         """Route one sample to the chosen member's queue.  Raises the
         member's typed admission errors (ServerOverloaded /
         RequestTimeout downstream), router-level QuotaExceeded, or
@@ -326,7 +327,8 @@ class TopologyRouter:
                 "member is lost or retired")
         self._routed[i] += 1
         return self._members[i].submit(x, deadline_ms=deadline_ms,
-                                       tenant=tenant, priority=priority)
+                                       tenant=tenant, priority=priority,
+                                       request_id=request_id)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None):

@@ -74,7 +74,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..nn.module import Module
-from ..utils import chaos, config, telemetry
+from ..utils import chaos, config, metrics_export, telemetry
 from ..utils.supervisor import StallError, Supervisor
 from . import control
 from .batcher import (DynamicBatcher, PendingRequest, ServeError,
@@ -349,13 +349,17 @@ class InferenceServer:
 
     def submit(self, x, deadline_ms: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority: int = 0) -> PendingRequest:
+               priority: int = 0,
+               request_id: Optional[str] = None) -> PendingRequest:
         """Enqueue one sample (NOT a batch — the batcher owns batching);
         returns a handle whose ``result()`` is the per-sample output row.
         Raises ServerOverloaded / QuotaExceeded / ServerClosed at
         admission.  ``tenant`` tags the request for token-bucket quotas
         (``SERVE_TENANT_QPS``); ``priority`` (higher = more important)
-        decides who is shed first under queue pressure."""
+        decides who is shed first under queue pressure; ``request_id``
+        is the distributed-tracing flow id from the
+        ``X-BigDL-Request-Id`` header (minted locally when absent and
+        tracing is on)."""
         if self._unhealthy is not None and not self._pool_alive():
             # the restart budget is spent and nobody is left to serve:
             # admitting would strand the caller on result() forever
@@ -381,11 +385,18 @@ class InferenceServer:
             self._recorder.note(x, tenant=tenant, priority=priority,
                                 deadline_ms=ms if ms and ms > 0 else None)
         if self._quotas is not None:
-            self._quotas.admit(tenant)
+            try:
+                self._quotas.admit(tenant)
+            except Exception:
+                reg = metrics_export._REGISTRY
+                if reg is not None:
+                    reg.shed("quota")
+                raise
         deadline = (self.batcher.clock() + ms / 1000.0) if ms and ms > 0 \
             else None
         return self.batcher.submit(x, deadline, tenant=tenant,
-                                   priority=priority)
+                                   priority=priority,
+                                   request_id=request_id)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None) -> np.ndarray:
@@ -445,6 +456,10 @@ class InferenceServer:
                              "respawning on the existing engine", idx)
         with self._lock:
             self._stats["restarts"] += 1
+        reg = metrics_export._REGISTRY
+        if reg is not None:
+            reg.counter_inc("bigdl_serve_restarts_total", 1.0,
+                            help="replica respawns by the monitor")
         self._spawn_replica(idx)
         telemetry.instant("serve.replica_restart", cat="serve",
                           replica=idx)
@@ -595,6 +610,11 @@ class InferenceServer:
                         # condemned while collecting (e.g. woke from a
                         # wedge): zero accepted-request loss — hand the
                         # batch back for the replacement to serve
+                        if telemetry.get_active() is not None:
+                            for r in reqs:
+                                telemetry.flow_step(r.rid,
+                                                    hop="replica.lost",
+                                                    replica=idx)
                         self.batcher.requeue(reqs)
                         return
                     if reqs:
@@ -605,6 +625,13 @@ class InferenceServer:
                             chaos.fire(f"serve.replica@{idx}",
                                        thread_exc=control.ReplicaExit)
                         except control.ReplicaExit as e:
+                            # land the chaos kill on every held request's
+                            # flow before the batch goes back to the queue
+                            if telemetry.get_active() is not None:
+                                for r in reqs:
+                                    telemetry.flow_step(
+                                        r.rid, hop="replica.lost",
+                                        replica=idx)
                             self.batcher.requeue(reqs)
                             logger.error(
                                 "serve: replica %d killed by chaos drill "
@@ -650,6 +677,10 @@ class InferenceServer:
         n = len(reqs)
         bucket = self.batcher.bucket_for(n)
         t0 = self.batcher.clock()
+        if telemetry.get_active() is not None:
+            for r in reqs:
+                telemetry.flow_step(r.rid, hop="batch.assemble", size=n,
+                                    bucket=bucket)
         try:
             # batch assembly is inside the guard too: a stray payload that
             # defeats admission-time shape checks (or OOMs the stack) must

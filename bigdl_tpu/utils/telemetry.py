@@ -67,6 +67,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import config
+from . import metrics_export
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -74,12 +75,23 @@ __all__ = ["Tracer", "enabled", "trace_dir", "maybe_start", "set_active",
            "get_active", "span", "complete", "instant", "counter",
            "thread_name", "merge_traces", "phase_breakdown",
            "format_report", "diff_breakdowns", "format_diff",
-           "TRACE_FILE_RE"]
+           "flow_start", "flow_step", "flow_finish", "mint_request_id",
+           "request_breakdown", "format_requests",
+           "REQUEST_ID_HEADER", "TRACE_FILE_RE"]
 
 #: the train loop's phase spans — the names phase_breakdown() ranks first
 PHASE_NAMES = ("data", "step", "checkpoint", "validation")
 
 TRACE_FILE_RE = r"trace\.(\d+)\.json"
+
+#: every flow event of one request shares this name+cat — Chrome links
+#: s/t/f phases into one arrow chain only when (name, cat, id) all match
+FLOW_NAME = "request"
+FLOW_CAT = "req"
+
+#: the HTTP header the fleet front uses to propagate a request id to the
+#: member that serves it (and that members echo back in every response)
+REQUEST_ID_HEADER = "X-BigDL-Request-Id"
 
 
 class _NullSpan:
@@ -150,6 +162,7 @@ class Tracer:
         self._tids: Dict[int, int] = {}
         self.dropped = 0
         self._since_flush = 0
+        self._rid_seq = 0
         self._closed = False
         import socket
         self._host = socket.gethostname()
@@ -238,6 +251,49 @@ class Tracer:
                       "ts": round(self._now_us(), 1), "pid": self.rank,
                       "tid": 0, "args": {k: round(float(v), 6)
                                          for k, v in values.items()}})
+
+    # -- request flows ("s"/"t"/"f" — the cross-process arrow chain) -----
+
+    def mint_request_id(self) -> str:
+        """A process-unique request id (pid-rank-seq hex).  Minted at
+        admission (FleetFront.submit / InferenceServer.submit /
+        DecodeEngine.submit) and carried on the PendingRequest + the
+        ``X-BigDL-Request-Id`` header so every process's flow events for
+        one request share one Chrome flow ``id``."""
+        import os
+        with self._lock:
+            self._rid_seq += 1
+            n = self._rid_seq
+        return f"{os.getpid():x}-{self.rank:x}-{n:x}"
+
+    def _emit_flow(self, ph: str, flow_id: str, args) -> None:
+        ev = {"name": FLOW_NAME, "cat": FLOW_CAT, "ph": ph,
+              "id": str(flow_id), "ts": round(self._now_us(), 1),
+              "pid": self.rank, "tid": self._tid()}
+        if ph == "f":
+            # bind the arrow head to the ENCLOSING slice, not the next one
+            ev["bp"] = "e"
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def flow_start(self, flow_id: str, **args) -> None:
+        """Open a request flow ("s"): the admission point of the process
+        that MINTED the id.  ``args`` should carry ``hop`` — the
+        request_breakdown() segment attribution is keyed on hop names."""
+        self._emit_flow("s", flow_id, args or None)
+
+    def flow_step(self, flow_id: str, **args) -> None:
+        """A "t" flow phase: every later hop the request passes through
+        (front send, member enqueue, batch assembly, decode ticks,
+        retries, failovers) on whichever process observes it."""
+        self._emit_flow("t", flow_id, args or None)
+
+    def flow_finish(self, flow_id: str, **args) -> None:
+        """Close the flow ("f", bp="e"): emitted by the id's minter when
+        the request resolves (the front's dispatch return, or the
+        server's _resolve for locally-minted ids)."""
+        self._emit_flow("f", flow_id, args or None)
 
     # -- inspection / persistence --------------------------------------
 
@@ -348,12 +404,45 @@ def counter(track: str, **values) -> None:
     tr = _ACTIVE
     if tr is not None:
         tr.counter(track, **values)
+    # the live-metrics plane rides the same call sites: every counter
+    # track doubles as a Prometheus gauge when a registry is armed (and
+    # costs one module-attribute load + None check when it is not)
+    reg = metrics_export._REGISTRY
+    if reg is not None:
+        reg.feed_counter(track, values)
 
 
 def thread_name(name: str) -> None:
     tr = _ACTIVE
     if tr is not None:
         tr.thread_name(name)
+
+
+def mint_request_id() -> Optional[str]:
+    """Mint a request id against the active tracer — None when tracing is
+    off, so untraced admission paths carry (and allocate) nothing."""
+    tr = _ACTIVE
+    if tr is None:
+        return None
+    return tr.mint_request_id()
+
+
+def flow_start(flow_id: Optional[str], **args) -> None:
+    tr = _ACTIVE
+    if tr is not None and flow_id:
+        tr.flow_start(flow_id, **args)
+
+
+def flow_step(flow_id: Optional[str], **args) -> None:
+    tr = _ACTIVE
+    if tr is not None and flow_id:
+        tr.flow_step(flow_id, **args)
+
+
+def flow_finish(flow_id: Optional[str], **args) -> None:
+    tr = _ACTIVE
+    if tr is not None and flow_id:
+        tr.flow_finish(flow_id, **args)
 
 
 # ---------------------------------------------------------------------------
@@ -636,6 +725,127 @@ def format_report(breakdown: dict, merged: Optional[dict] = None) -> str:
 
 
 # ---------------------------------------------------------------------------
+# per-request critical paths (trace_report --requests)
+# ---------------------------------------------------------------------------
+
+#: hop name -> which latency segment the time ENTERING that hop belongs
+#: to.  A segment is the gap between consecutive flow events of one
+#: request; it is attributed by where the request ARRIVED (e.g. the gap
+#: ending at ``queue.enqueue`` was spent in transport getting there).
+_SEG_BY_DST = {
+    "front.send": "dispatch",       # front admit -> picked a member
+    "queue.enqueue": "transport",   # front send -> member admission
+    "batch.assemble": "queue",      # enqueue -> pulled into a batch
+    "decode.admit": "queue",        # enqueue -> admitted to a KV slot
+    "decode.tick": "device",        # admit/tick -> next decode step
+    "resolve": "device",            # batch assembly -> result resolved
+    "front.done": "transport",      # member resolve -> front response
+    "fleet.retry": "failover",      # send -> the attempt was abandoned
+    "replica.lost": "failover",     # a replica died holding the request
+    "decode.fault": "failover",     # a KV slot faulted mid-sequence
+}
+_SEGMENTS = ("dispatch", "queue", "device", "transport", "failover")
+
+
+def request_breakdown(merged: dict, slowest: int = 5) -> dict:
+    """Reconstruct per-request critical paths from a merged multi-process
+    trace's flow events.
+
+    Every flow phase ("s"/"t"/"f" with name=:data:`FLOW_NAME`) carries the
+    request id in ``id`` and a ``hop`` arg naming the pipeline station it
+    marks; consecutive hops of one id — across front, worker, and
+    controller pids — partition the request's latency into segments
+    (:data:`_SEGMENTS`).  Returns per-segment p50/p95/p99 over all
+    requests, per-request totals, and the slowest-N hop timelines —
+    "where did the p99 go" as data."""
+    flows: Dict[str, List[dict]] = {}
+    for e in merged.get("traceEvents", []):
+        if e.get("ph") in ("s", "t", "f") and e.get("name") == FLOW_NAME:
+            a = e.get("args") or {}
+            flows.setdefault(str(e.get("id")), []).append(
+                {"ts": float(e.get("ts", 0.0)), "rank": int(e.get("pid", 0)),
+                 "hop": a.get("hop", "?"), "args": a})
+    requests = {}
+    seg_samples: Dict[str, List[float]] = {s: [] for s in _SEGMENTS}
+    for rid, evs in flows.items():
+        evs.sort(key=lambda e: e["ts"])
+        segments = {s: 0.0 for s in _SEGMENTS}
+        for prev, cur in zip(evs, evs[1:]):
+            seg = _SEG_BY_DST.get(cur["hop"], "dispatch")
+            segments[seg] += max(cur["ts"] - prev["ts"], 0.0)
+        total_us = max(evs[-1]["ts"] - evs[0]["ts"], 0.0)
+        members = sorted({e["args"]["member"] for e in evs
+                          if "member" in e["args"]})
+        status = next((e["args"]["status"] for e in reversed(evs)
+                       if "status" in e["args"]), None)
+        requests[rid] = {
+            "total_ms": round(total_us / 1e3, 3),
+            "hops": len(evs),
+            "ranks": sorted({e["rank"] for e in evs}),
+            "members": members,
+            "status": status,
+            "segments": {s: round(v / 1e3, 3)
+                         for s, v in segments.items() if v > 0.0}}
+        for s, v in segments.items():
+            seg_samples[s].append(v / 1e3)
+    seg_stats = {}
+    for s in _SEGMENTS:
+        vals = sorted(v for v in seg_samples[s] if v > 0.0)
+        if not vals:
+            continue
+        seg_stats[s] = {"count": len(vals),
+                        "total_ms": round(sum(vals), 3),
+                        "p50_ms": round(_pct(vals, 0.50), 3),
+                        "p95_ms": round(_pct(vals, 0.95), 3),
+                        "p99_ms": round(_pct(vals, 0.99), 3)}
+    slow = sorted(requests.items(), key=lambda kv: -kv[1]["total_ms"])
+    slowest_list = []
+    for rid, st in slow[:max(int(slowest), 0)]:
+        evs = flows[rid]
+        t0 = evs[0]["ts"]
+        slowest_list.append({
+            "id": rid, "total_ms": st["total_ms"], "status": st["status"],
+            "timeline": [{"t_ms": round((e["ts"] - t0) / 1e3, 3),
+                          "rank": e["rank"], "hop": e["hop"],
+                          **({"member": e["args"]["member"]}
+                             if "member" in e["args"] else {})}
+                         for e in evs]})
+    totals = sorted(st["total_ms"] for st in requests.values())
+    return {"count": len(requests),
+            "total_p50_ms": round(_pct(totals, 0.50), 3),
+            "total_p95_ms": round(_pct(totals, 0.95), 3),
+            "total_p99_ms": round(_pct(totals, 0.99), 3),
+            "segments": seg_stats, "requests": requests,
+            "slowest": slowest_list}
+
+
+def format_requests(rb: dict) -> str:
+    """Human-readable rendering of :func:`request_breakdown`."""
+    if not rb.get("count"):
+        return "requests: none (no flow events in this trace)"
+    lines = [f"requests: {rb['count']}  total p50/p95/p99 ms: "
+             f"{rb['total_p50_ms']}/{rb['total_p95_ms']}/"
+             f"{rb['total_p99_ms']}",
+             f"{'segment':<12}{'count':>8}{'total_ms':>12}{'p50_ms':>10}"
+             f"{'p95_ms':>10}{'p99_ms':>10}"]
+    for seg in _SEGMENTS:
+        st = rb["segments"].get(seg)
+        if st is None:
+            continue
+        lines.append(f"{seg:<12}{st['count']:>8}{st['total_ms']:>12.3f}"
+                     f"{st['p50_ms']:>10.3f}{st['p95_ms']:>10.3f}"
+                     f"{st['p99_ms']:>10.3f}")
+    for s in rb["slowest"]:
+        lines.append(f"slowest {s['id']}: {s['total_ms']}ms"
+                     + (f" status={s['status']}" if s["status"] else ""))
+        for h in s["timeline"]:
+            member = f" member={h['member']}" if "member" in h else ""
+            lines.append(f"  +{h['t_ms']:>10.3f}ms  rank {h['rank']:<3}"
+                         f" {h['hop']}{member}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # run-to-run diff (trace_report --diff A B)
 # ---------------------------------------------------------------------------
 
@@ -643,8 +853,11 @@ def diff_breakdowns(a: dict, b: dict) -> dict:
     """Structured diff of two phase breakdowns (A = baseline, B = new run).
 
     Per phase: count/total_s/p50 in both runs + the B/A total-time ratio;
-    per counter series: last values in both runs + delta.  Phases or
-    series present in only one run are flagged (``only``)."""
+    per counter series: last values in both runs + delta; the promoted
+    ``fleet`` and ``decode`` sections (PRs 17–18) diff key-by-key the
+    same way, so A/B runs compare tokens/s, fill, live members and
+    restarts directly.  Phases or series present in only one run are
+    flagged (``only``)."""
     phases = {}
     for name in sorted(set(a.get("phases", {})) | set(b.get("phases", {}))):
         pa, pb = a.get("phases", {}).get(name), \
@@ -668,7 +881,20 @@ def diff_breakdowns(a: dict, b: dict) -> dict:
             continue
         counters[name] = {"last": [ca["last"], cb["last"]],
                           "delta": round(cb["last"] - ca["last"], 6)}
+    sections = {}
+    for sec in ("fleet", "decode"):
+        sa, sb = a.get(sec) or {}, b.get(sec) or {}
+        rows = {}
+        for name in sorted(set(sa) | set(sb)):
+            va, vb = sa.get(name), sb.get(name)
+            if va is None or vb is None:
+                rows[name] = {"only": "B" if va is None else "A"}
+                continue
+            rows[name] = {"last": [va, vb],
+                          "delta": round(float(vb) - float(va), 6)}
+        sections[sec] = rows
     return {"phases": phases, "counters": counters,
+            "fleet": sections["fleet"], "decode": sections["decode"],
             "data_wait_fraction": [a.get("data_wait_fraction"),
                                    b.get("data_wait_fraction")]}
 
@@ -692,6 +918,17 @@ def format_diff(diff: dict) -> str:
                 lines.append(f"{name:<28}  only in run {d['only']}")
                 continue
             lines.append(f"{name:<28}{d['last'][0]:>14.6g}"
+                         f"{d['last'][1]:>14.6g}{d['delta']:>12.6g}")
+    for sec in ("fleet", "decode"):
+        rows = diff.get(sec) or {}
+        if not rows:
+            continue
+        lines.append(f"{sec + ':':<28}{'A':>14}{'B':>14}{'delta':>12}")
+        for name, d in rows.items():
+            if "only" in d:
+                lines.append(f"  {name:<26}  only in run {d['only']}")
+                continue
+            lines.append(f"  {name:<26}{d['last'][0]:>14.6g}"
                          f"{d['last'][1]:>14.6g}{d['delta']:>12.6g}")
     dw = diff["data_wait_fraction"]
     lines.append(f"data_wait_fraction: {dw[0]} -> {dw[1]}")

@@ -1,0 +1,382 @@
+"""End-to-end request tracing + the live /metrics plane (ISSUE 19).
+
+Covers the acceptance surface: flow events are valid Perfetto-loadable
+Chrome-trace JSON (one ``s``/``t``/``f`` arrow chain per request id);
+multi-process traces merge into per-request critical paths keyed by the
+``X-BigDL-Request-Id`` the fleet front propagates (and the HTTP tier
+echoes); ``GET /metrics`` renders Prometheus text exposition with
+correct counter/gauge/histogram line syntax and a fleet rollup; and with
+tracing off and metrics unarmed the serving path emits no events, holds
+no registry, and spawns no extra thread.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import Engine
+from bigdl_tpu.serve import InferenceServer
+from bigdl_tpu.utils import chaos, file_io, metrics_export, telemetry
+from bigdl_tpu.utils.telemetry import (FLOW_CAT, FLOW_NAME,
+                                       REQUEST_ID_HEADER, Tracer,
+                                       format_requests, merge_traces,
+                                       request_breakdown)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS_DIR = os.path.join(_REPO_ROOT, "tools")
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability(monkeypatch):
+    monkeypatch.delenv("BIGDL_TPU_TRACE", raising=False)
+    monkeypatch.delenv("BIGDL_TPU_METRICS", raising=False)
+    telemetry.set_active(None)
+    metrics_export.disarm()
+    chaos.clear()
+    yield
+    tr = telemetry.get_active()
+    if tr is not None:
+        tr.close()
+    telemetry.set_active(None)
+    metrics_export.disarm()
+    chaos.clear()
+
+
+def _linear_model(seed=0):
+    return nn.Sequential().add(nn.Linear(4, 3)).build(jax.random.key(seed))
+
+
+# ---------------------------------------------------------------------------
+# flow events: Perfetto-shaped JSON
+# ---------------------------------------------------------------------------
+
+def test_flow_events_are_perfetto_shaped(tmp_path):
+    tr = Tracer(str(tmp_path), rank=0)
+    telemetry.set_active(tr)
+    rid = telemetry.mint_request_id()
+    assert rid and isinstance(rid, str)
+    telemetry.flow_start(rid, hop="front.admit")
+    telemetry.flow_step(rid, hop="queue.enqueue", depth=1)
+    telemetry.flow_finish(rid, hop="front.done", status="ok")
+    path = tr.flush()
+    blob = json.loads(file_io.get_filesystem(path).read_bytes(path))
+    evs = [e for e in blob["traceEvents"] if e.get("name") == FLOW_NAME]
+    assert [e["ph"] for e in evs] == ["s", "t", "f"]
+    for e in evs:
+        # the (name, cat, id) triple is what Perfetto uses to link the
+        # arrow chain — every phase must carry the identical triple
+        assert e["cat"] == FLOW_CAT and e["id"] == rid
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert evs[-1].get("bp") == "e"  # arrow binds to the finish slice
+    tr.close()
+
+
+def test_minted_ids_are_unique_and_process_tagged(tmp_path):
+    tr = Tracer(str(tmp_path), rank=3)
+    telemetry.set_active(tr)
+    ids = {telemetry.mint_request_id() for _ in range(100)}
+    assert len(ids) == 100
+    for rid in ids:
+        assert rid.split("-")[0] == f"{os.getpid():x}"
+        assert rid.split("-")[1] == "3"
+    tr.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-process merge: one request id, one critical path
+# ---------------------------------------------------------------------------
+
+def test_cross_process_merge_links_by_request_id(tmp_path):
+    front = Tracer(str(tmp_path), rank=0)
+    worker = Tracer(str(tmp_path), rank=10)
+    rid = "feed-0-1"
+    front.flow_start(rid, hop="front.admit")
+    time.sleep(0.002)
+    front.flow_step(rid, hop="front.send", member=1)
+    time.sleep(0.002)
+    worker.flow_step(rid, hop="queue.enqueue", depth=0)
+    time.sleep(0.002)
+    worker.flow_step(rid, hop="batch.assemble", size=1)
+    time.sleep(0.002)
+    worker.flow_step(rid, hop="resolve", status="ok")
+    time.sleep(0.002)
+    front.flow_finish(rid, hop="front.done", status="ok")
+    front.close()
+    worker.close()
+
+    rb = request_breakdown(merge_traces(str(tmp_path)))
+    assert rb["count"] == 1
+    req = rb["requests"][rid]
+    assert req["ranks"] == [0, 10]          # spans BOTH processes
+    assert req["hops"] == 6
+    assert req["status"] == "ok"
+    assert req["members"] == [1]
+    # the wall-clock gaps were attributed to pipeline segments
+    assert set(req["segments"]) <= {"dispatch", "queue", "device",
+                                    "transport", "failover"}
+    assert req["segments"]["queue"] > 0 and req["segments"]["device"] > 0
+    assert rb["total_p50_ms"] > 0 and rb["segments"]
+    text = format_requests(rb)
+    assert rid in text and "segment" in text
+
+
+def test_failover_flow_carries_both_members(tmp_path):
+    tr = Tracer(str(tmp_path), rank=0)
+    rid = "dead-0-2"
+    tr.flow_start(rid, hop="front.admit")
+    tr.flow_step(rid, hop="front.send", member=0)
+    tr.flow_step(rid, hop="fleet.retry", member=0, error="URLError")
+    tr.flow_step(rid, hop="front.send", member=2)
+    tr.flow_finish(rid, hop="front.done", status="ok")
+    tr.close()
+    rb = request_breakdown(merge_traces(str(tmp_path)))
+    req = rb["requests"][rid]
+    assert req["members"] == [0, 2]         # the two-member failover story
+    assert "failover" in req["segments"]
+
+
+# ---------------------------------------------------------------------------
+# the serving path end to end (in-process server)
+# ---------------------------------------------------------------------------
+
+def test_server_submit_emits_owned_flow(tmp_path):
+    Engine.init()
+    tr = Tracer(str(tmp_path), rank=0)
+    telemetry.set_active(tr)
+    server = InferenceServer(_linear_model(), max_wait_ms=5,
+                             example=np.zeros((4,), np.float32)).start()
+    try:
+        h = server.submit(np.zeros((4,), np.float32))
+        h.result(timeout=30)
+        assert h.rid and h.rid_owner        # minted here -> owns the "f"
+    finally:
+        server.stop()
+        tr.close()
+    merged = merge_traces(str(tmp_path))
+    rb = request_breakdown(merged)
+    assert h.rid in rb["requests"]
+    phases = [e["ph"] for e in merged["traceEvents"]
+              if e.get("name") == FLOW_NAME and str(e.get("id")) == h.rid]
+    assert phases[0] == "s" and phases[-1] == "f"
+    hops = [(e.get("args") or {}).get("hop") for e in merged["traceEvents"]
+            if e.get("name") == FLOW_NAME and str(e.get("id")) == h.rid]
+    assert "queue.enqueue" in hops and "batch.assemble" in hops \
+        and "resolve" in hops
+
+
+def test_disabled_mode_zero_overhead():
+    """BIGDL_TPU_TRACE unset + metrics unarmed: no events, no registry,
+    no extra thread — the PR 4 contract extended to the request plane."""
+    Engine.init()
+    server = InferenceServer(_linear_model(), max_wait_ms=5,
+                             example=np.zeros((4,), np.float32)).start()
+    try:
+        before = threading.active_count()
+        assert telemetry.mint_request_id() is None
+        h = server.submit(np.zeros((4,), np.float32))
+        h.result(timeout=30)
+        assert h.rid is None and not h.rid_owner
+        assert telemetry.get_active() is None
+        assert metrics_export.registry() is None
+        assert not metrics_export.armed()
+        assert threading.active_count() == before
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP tier: request-id echo + GET /metrics
+# ---------------------------------------------------------------------------
+
+def test_http_request_id_echo_and_metrics(tmp_path):
+    import serve_http
+
+    Engine.init()
+    tr = Tracer(str(tmp_path), rank=10)
+    telemetry.set_active(tr)
+    server = InferenceServer(_linear_model(), max_wait_ms=5,
+                             example=np.zeros((4,), np.float32)).start()
+    httpd = serve_http.serve_forever(server, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    rid = "cafe-0-7"
+    try:
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            data=json.dumps({"inputs": [0.0, 0.0, 0.0, 0.0]}).encode(),
+            headers={REQUEST_ID_HEADER: rid}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers.get(REQUEST_ID_HEADER) == rid  # echoed back
+        # serve_forever armed the plane (BIGDL_TPU_METRICS defaults on)
+        assert metrics_export.armed()
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type") == \
+                metrics_export.CONTENT_TYPE
+            text = r.read().decode()
+    finally:
+        httpd.shutdown()
+        server.stop()
+        tr.close()
+    assert "# TYPE bigdl_serve_requests_total counter" in text
+    assert 'bigdl_serve_requests_total{status="ok"} 1' in text
+    assert "# TYPE bigdl_serve_request_latency_seconds histogram" in text
+    assert 'le="+Inf"' in text
+    assert "bigdl_serve_request_latency_seconds_count 1" in text
+    assert "bigdl_serve_slo_attainment" in text
+    # the fleet-arrived id joined THIS process's trace as flow steps
+    # (never "s"/"f" — the minting front owns the chain's endpoints)
+    merged = merge_traces(str(tmp_path))
+    rb = request_breakdown(merged)
+    assert rid in rb["requests"]
+    phases = {e["ph"] for e in merged["traceEvents"]
+              if e.get("name") == FLOW_NAME and str(e.get("id")) == rid}
+    assert phases == {"t"}
+
+
+def test_metrics_disabled_knob_gives_404(monkeypatch):
+    import serve_http
+
+    monkeypatch.setenv("BIGDL_TPU_METRICS", "0")
+    Engine.init()
+    server = InferenceServer(_linear_model(), max_wait_ms=5,
+                             example=np.zeros((4,), np.float32)).start()
+    httpd = serve_http.serve_forever(server, "127.0.0.1", 0)
+    try:
+        assert not metrics_export.armed()   # serve_forever did NOT arm
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}/metrics",
+                timeout=30)
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_retry_after_helper_rounds_up():
+    from serve_http import retry_after_headers
+    assert retry_after_headers(0.2) == {"Retry-After": "1"}
+    assert retry_after_headers(1.0) == {"Retry-After": "1"}
+    assert retry_after_headers(1.01) == {"Retry-After": "2"}
+    assert retry_after_headers(7) == {"Retry-After": "7"}
+
+
+# ---------------------------------------------------------------------------
+# exposition format + fleet rollup (unit level)
+# ---------------------------------------------------------------------------
+
+def test_metrics_exposition_and_fleet_rollup():
+    reg = metrics_export.MetricsRegistry(slo_ms=100.0, window=8)
+    reg.observe_request(0.003, "ok")
+    reg.observe_request(0.250, "RequestTimeout")
+    reg.shed("overloaded")
+    reg.feed_counter("serve", {"depth": 3, "batch_fill": 0.5})
+    text = reg.render()
+    # counter line syntax
+    assert 'bigdl_serve_shed_total{cause="overloaded"} 1' in text
+    assert 'bigdl_serve_requests_total{status="ok"} 1' in text
+    # gauges fed straight from the telemetry.counter track
+    assert "# TYPE bigdl_serve_depth gauge" in text
+    assert "bigdl_serve_depth 3" in text
+    assert "bigdl_serve_batch_fill 0.5" in text
+    # histogram: cumulative le= buckets + _sum/_count
+    assert ('bigdl_serve_request_latency_seconds_bucket{le="0.005"} 1'
+            in text)
+    assert ('bigdl_serve_request_latency_seconds_bucket{le="+Inf"} 2'
+            in text)
+    assert "bigdl_serve_request_latency_seconds_count 2" in text
+    # SLO window: 1 of 2 resolved ok under 100ms
+    assert "bigdl_serve_slo_attainment 0.5" in text
+
+    parsed = metrics_export.parse_exposition(text)
+    assert parsed["bigdl_serve_requests_total"]["type"] == "counter"
+    assert parsed["bigdl_serve_request_latency_seconds"]["type"] == \
+        "histogram"
+    assert parsed["bigdl_serve_depth"]["type"] == "gauge"
+
+    rollup = metrics_export.render_rollup("", {"0": text, "1": text})
+    # fleet-wide sums for counters/histograms, member labels throughout
+    assert "# TYPE fleet_bigdl_serve_requests_total counter" in rollup
+    assert 'fleet_bigdl_serve_requests_total{status="ok"} 2' in rollup
+    assert 'member="0"' in rollup and 'member="1"' in rollup
+    # gauges are per-member only (no meaningless cross-member sum line)
+    assert 'fleet_bigdl_serve_batch_fill{member="0"} 0.5' in rollup
+    assert "fleet_bigdl_serve_batch_fill 1" not in rollup
+
+
+def test_telemetry_counter_feeds_armed_registry(tmp_path):
+    tr = Tracer(str(tmp_path), rank=0)
+    telemetry.set_active(tr)
+    reg = metrics_export.arm()
+    telemetry.counter("serve.decode", tokens_out=128, slots_busy=2)
+    text = reg.render()
+    assert "bigdl_serve_decode_tokens_out 128" in text
+    assert "bigdl_serve_decode_slots_busy 2" in text
+    tr.close()
+
+
+# ---------------------------------------------------------------------------
+# the CLI + diff sections
+# ---------------------------------------------------------------------------
+
+def test_trace_report_requests_cli(tmp_path):
+    tr = Tracer(str(tmp_path), rank=0)
+    rid = "beef-0-1"
+    tr.flow_start(rid, hop="front.admit")
+    time.sleep(0.002)
+    tr.flow_step(rid, hop="queue.enqueue", depth=0)
+    time.sleep(0.002)
+    tr.flow_finish(rid, hop="resolve", status="ok")
+    tr.close()
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS_DIR, "trace_report.py"),
+         str(tmp_path), "--requests", "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": _REPO_ROOT})
+    assert out.returncode == 0, out.stderr
+    rb = json.loads(out.stdout)
+    assert rb["count"] == 1 and rid in rb["requests"]
+    # human table too
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS_DIR, "trace_report.py"),
+         str(tmp_path), "--requests"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": _REPO_ROOT})
+    assert out.returncode == 0, out.stderr
+    assert rid in out.stdout
+
+
+def test_diff_gains_fleet_and_decode_sections(tmp_path):
+    from bigdl_tpu.utils.telemetry import (diff_breakdowns, format_diff,
+                                           phase_breakdown)
+    dirs = {}
+    for name, n in (("a", 2), ("b", 5)):
+        d = tmp_path / name
+        tr = Tracer(str(d), rank=0)
+        with tr.span("step", kind="proxy"):
+            time.sleep(0.001)
+        tr.counter("fleet", live=n)
+        tr.counter("serve.decode", tokens_out=n * 10.0)
+        tr.close()
+        dirs[name] = phase_breakdown(merge_traces(str(d)))
+    diff = diff_breakdowns(dirs["a"], dirs["b"])
+    assert diff["fleet"]["live"] == {"last": [2.0, 5.0], "delta": 3.0}
+    assert diff["decode"]["tokens_out"] == {"last": [20.0, 50.0],
+                                            "delta": 30.0}
+    text = format_diff(diff)
+    assert "fleet:" in text and "decode:" in text

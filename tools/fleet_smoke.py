@@ -403,6 +403,23 @@ def main(argv=None) -> int:
                             f"release: {per_member}")
             return 1
 
+        # -- live metrics plane: every member answers GET /metrics with
+        # Prometheus text exposition, and the front's rollup re-exports
+        # the fleet under fleet_-prefixed, member-labelled series
+        mrec = next(iter(front.members().values()))
+        mreq = urllib.request.Request(
+            f"http://{mrec.get('host', '127.0.0.1')}:{mrec['port']}"
+            "/metrics")
+        with urllib.request.urlopen(mreq, timeout=30) as r:
+            mtext = r.read().decode()
+        rollup = front.metrics_text()
+        out["metrics"] = {
+            "member_ok": "bigdl_serve_requests_total" in mtext,
+            "rollup_ok": "fleet_bigdl_serve_requests_total" in rollup}
+        if not all(out["metrics"].values()):
+            out["error"] = f"metrics plane incomplete: {out['metrics']}"
+            return 1
+
         # degradation never tripped: every loss stayed within budget
         sst = sup.stats()
         out["supervisor"] = {"restarts": sst["restarts"],
@@ -420,14 +437,37 @@ def main(argv=None) -> int:
         tracer.close()
         tracer = None
 
-        breakdown = telemetry.phase_breakdown(
-            telemetry.merge_traces(trace_dir))
+        merged = telemetry.merge_traces(trace_dir)
+        breakdown = telemetry.phase_breakdown(merged)
         out["fleet_report"] = breakdown.get("fleet", {})
         out["deploy_report"] = breakdown.get("deploy", {})
         if not breakdown.get("fleet") or not breakdown.get("deploy"):
             out["error"] = ("merged trace is missing the fleet/deploy "
                             f"tracks: fleet={out['fleet_report']} "
                             f"deploy={out['deploy_report']}")
+            return 1
+
+        # -- request flows: every traced request is one Perfetto arrow
+        # chain across front + worker ranks, and the kill -9 leg left at
+        # least one flow that touched TWO members (the failover story)
+        rb = telemetry.request_breakdown(merged)
+        multi = [rid for rid, r in rb["requests"].items()
+                 if len(r.get("members", [])) >= 2]
+        cross = [rid for rid, r in rb["requests"].items()
+                 if len(r.get("ranks", [])) >= 2]
+        out["request_flows"] = {"count": rb["count"],
+                                "cross_process": len(cross),
+                                "failover_flows": len(multi)}
+        if rb["count"] == 0:
+            out["error"] = "merged trace holds no request flows"
+            return 1
+        if not cross:
+            out["error"] = ("no request flow spans front AND a worker "
+                            f"process: {out['request_flows']}")
+            return 1
+        if not multi:
+            out["error"] = ("kill -9 failover left no two-member "
+                            f"request flow: {out['request_flows']}")
             return 1
         out["ok"] = True
         return 0

@@ -63,6 +63,14 @@ utils/hlostats.py):
    (ISSUE 17) pins the same decision computed off the cached member
    registry (``FleetFront._pick``) — a cache-bypass regression that
    re-lists the registry per request fails the gate.
+9. **observability tax** (ISSUE 19): (a) the fleet dispatch decision
+   re-run with request tracing ARMED — a request id minted plus the
+   admit/send/done flow events every pick — bounded as a ratio over the
+   untraced decision, so the per-request cost of end-to-end flow
+   tracing stays a small multiple of the routing tax it annotates;
+   (b) ``MetricsRegistry.render()`` host microseconds over a
+   representative registry, so a ``GET /metrics`` scrape can never
+   perturb serving.
 
 ``PERF_BASELINE.json`` match kinds: ``exact`` (structural counts — any
 drift fails), ``max`` (time/ratio metrics — measured must stay <=
@@ -144,6 +152,18 @@ DEFAULT_RATIO_BOUNDS = {
                 "over a 4-member registry with a warm cache (measured "
                 "~3-10us; catches a cache-bypass regression that would "
                 "re-list the registry per request)"},
+    "fleet.dispatch_traced_ratio": {
+        "value": 10.0, "match": "max",
+        "note": "the same _pick loop with request tracing ARMED (id "
+                "minted + admit/send/done flow events per pick) over the "
+                "untraced fleet.dispatch_us (measured ~1.5-3x; catches a "
+                "flow path that flushes or allocates per event)"},
+    "metrics.render_us": {
+        "value": 5000.0, "match": "max",
+        "note": "MetricsRegistry.render() host microseconds over a "
+                "representative registry (request histograms + sheds + "
+                "fed counter tracks) — one GET /metrics scrape must "
+                "stay far too cheap to perturb serving"},
 }
 
 
@@ -538,8 +558,57 @@ def measure(batch_size=64):
         fleet_front._pick()
     measured["fleet.dispatch_us"] = round(
         (time.perf_counter() - t0_pick) / n_picks * 1e6, 3)
-    fleet_front.close()
     context["fleet"] = {"members": 4, "picks": n_picks}
+
+    # ---- proxy 9: observability tax (ISSUE 19) -----------------------
+    # (a) the SAME warm dispatch loop with request tracing armed: every
+    # pick mints an id and emits the admit/send/done flow chain — the
+    # whole per-request bookkeeping the serving tiers add when
+    # BIGDL_TPU_TRACE is set.  Bounded as a ratio over the untraced
+    # pick so it tracks machine speed, not absolute microseconds.
+    from bigdl_tpu.utils import metrics_export, telemetry
+    trace_tmp = tempfile.mkdtemp(prefix="perf_gate_trace_")
+    tracer = telemetry.Tracer(trace_tmp, rank=0, flush_every=1 << 30)
+    telemetry.set_active(tracer)
+    try:
+        for _ in range(200):
+            fleet_front._pick()  # re-warm under the armed tracer
+        t0_pick = time.perf_counter()
+        for _ in range(n_picks):
+            rid = telemetry.mint_request_id()
+            telemetry.flow_start(rid, hop="front.admit")
+            fleet_front._pick()
+            telemetry.flow_step(rid, hop="front.send", member=0)
+            telemetry.flow_finish(rid, hop="front.done", status="ok")
+        traced_us = (time.perf_counter() - t0_pick) / n_picks * 1e6
+    finally:
+        telemetry.set_active(None)
+    fleet_front.close()
+    measured["fleet.dispatch_traced_ratio"] = round(
+        traced_us / max(measured["fleet.dispatch_us"], 1e-9), 4)
+    context["fleet"]["traced_us"] = round(traced_us, 3)
+
+    # (b) one GET /metrics render over a representative registry:
+    # request-latency histograms, shed causes, and fed counter tracks
+    reg = metrics_export.MetricsRegistry()
+    for i in range(64):
+        reg.observe_request(0.003 + 0.001 * (i % 7),
+                            "ok" if i % 9 else "RequestTimeout")
+    for cause in ("timeout", "overloaded", "priority", "quota"):
+        reg.shed(cause)
+    reg.feed_counter("serve", {"depth": 3, "batch_fill": 0.8,
+                               "inflight": 2})
+    reg.feed_counter("fleet", {"live": 3, "retried": 1, "lost": 1})
+    reg.feed_counter("serve.decode", {"slots_busy": 4, "tokens_out": 512})
+    reg.render()  # warm
+    n_render = 200
+    t0_r = time.perf_counter()
+    for _ in range(n_render):
+        text = reg.render()
+    measured["metrics.render_us"] = round(
+        (time.perf_counter() - t0_r) / n_render * 1e6, 3)
+    context["metrics"] = {"renders": n_render,
+                          "exposition_lines": text.count("\n")}
 
     # ---- proxy 6: 1F1B schedule card + memory ratio (ISSUE 13) -------
     from bigdl_tpu.parallel import build_schedule

@@ -64,6 +64,14 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
+def retry_after_headers(seconds) -> dict:
+    """The Retry-After header for a typed backoff estimate: whole
+    seconds, rounded UP, never below 1 (a 0s hint would tell the client
+    to hammer).  One rounding rule shared by every 429/503 site on the
+    worker and the fleet front."""
+    return {"Retry-After": str(max(1, int(float(seconds) + 0.999)))}
+
+
 def build_model(name: str):
     """Built (randomly initialized) architecture + example sample shape;
     real weights come from --checkpoint / POST /v1/swap."""
@@ -87,6 +95,7 @@ def make_handler(server):
     from bigdl_tpu.serve import (ReplicaLostError, RequestTimeout,
                                  ServeError, ServerClosed,
                                  ServerOverloaded)
+    from bigdl_tpu.utils import metrics_export, telemetry
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -99,8 +108,21 @@ def make_handler(server):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            # echo the caller's request id so a trace of the client side
+            # can be joined to ours even when the request is shed early
+            rid = self.headers.get(telemetry.REQUEST_ID_HEADER)
+            if rid:
+                self.send_header(telemetry.REQUEST_ID_HEADER, rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_text(self, code: int, text: str, ctype: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
@@ -114,7 +136,7 @@ def make_handler(server):
                     seconds = server.batcher.retry_after_s()
                 except AttributeError:  # router front end: no one queue
                     seconds = 1.0
-            return {"Retry-After": str(max(1, int(seconds + 0.999)))}
+            return retry_after_headers(seconds)
 
         def _body(self):
             length = int(self.headers.get("Content-Length") or 0)
@@ -151,6 +173,26 @@ def make_handler(server):
                 if eng is not None:
                     st["decode"] = eng.stats()
                 self._reply(200, st)
+            elif self.path == "/metrics":
+                # Prometheus text exposition.  A fleet front (anything
+                # exposing metrics_text()) answers with its own metrics
+                # PLUS a fleet_-prefixed rollup scraped from members; a
+                # plain worker renders its process-wide registry.
+                if not metrics_export.enabled():
+                    return self._reply(404, {
+                        "error": "metrics plane disabled "
+                                 "(BIGDL_TPU_METRICS=0)"})
+                try:
+                    fn = getattr(server, "metrics_text", None)
+                    if fn is not None:
+                        text = fn()
+                    else:
+                        reg = metrics_export.registry()
+                        text = reg.render() if reg is not None else ""
+                except Exception as e:  # noqa: BLE001 — surface it
+                    return self._reply(500, {"error": str(e),
+                                             "type": type(e).__name__})
+                self._reply_text(200, text, metrics_export.CONTENT_TYPE)
             elif self.path == "/v1/versions":
                 ctl = getattr(server, "_deploy", None)
                 if ctl is None:
@@ -187,11 +229,15 @@ def make_handler(server):
             deadline = body.get("deadline_ms")
             tenant = body.get("tenant")
             priority = int(body.get("priority", 0))
+            # a request id minted upstream (the fleet front) rides in on
+            # the header so this process's spans join the caller's flow
+            rid = self.headers.get(telemetry.REQUEST_ID_HEADER)
             try:
                 # submit every row FIRST (they coalesce into one bucket),
                 # then wait — a row-at-a-time predict() would serialize
                 handles = [server.submit(r, deadline_ms=deadline,
-                                         tenant=tenant, priority=priority)
+                                         tenant=tenant, priority=priority,
+                                         request_id=rid)
                            for r in rows]
                 outs = [h.result(timeout=body.get("timeout_s", 120))
                         for h in handles]
@@ -199,8 +245,7 @@ def make_handler(server):
                 # covers QuotaExceeded too (a subclass): typed 429 with
                 # the server's retry estimate in the standard header
                 retry = getattr(e, "retry_after_s", None)
-                hdrs = ({"Retry-After": str(max(1, int(retry + 0.999)))}
-                        if retry else None)
+                hdrs = retry_after_headers(retry) if retry else None
                 return self._reply(429, {"error": str(e),
                                          "type": type(e).__name__,
                                          "retry_after_s": retry},
@@ -259,6 +304,8 @@ def make_handler(server):
                 kw["eos_token"] = (int(body["eos_token"])
                                    if body["eos_token"] is not None
                                    else None)
+            kw["request_id"] = self.headers.get(
+                telemetry.REQUEST_ID_HEADER)
             prompt = np.asarray(body["prompt"], np.int32)
             try:
                 h = eng.submit(prompt, int(body.get("max_tokens", 16)),
@@ -266,8 +313,7 @@ def make_handler(server):
                 out = h.result(timeout=body.get("timeout_s", 120))
             except ServerOverloaded as e:
                 retry = getattr(e, "retry_after_s", None)
-                hdrs = ({"Retry-After": str(max(1, int(retry + 0.999)))}
-                        if retry else None)
+                hdrs = retry_after_headers(retry) if retry else None
                 return self._reply(429, {"error": str(e),
                                          "type": type(e).__name__,
                                          "retry_after_s": retry},
@@ -314,6 +360,9 @@ def serve_forever(server, host: str, port: int):
     # the sample rank lets /v1/predict tell one sample from a batch
     server.sample_ndim = server._example.ndim if server._example is not None \
         else 1
+    from bigdl_tpu.utils import metrics_export
+    if metrics_export.enabled():
+        metrics_export.arm()  # idempotent; feeds GET /metrics
     httpd = ThreadingHTTPServer((host, port), make_handler(server))
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="bigdl-serve-http")
@@ -384,9 +433,13 @@ def main(argv=None):
             pass
 
     from bigdl_tpu.serve import InferenceServer, TopologyRouter
+    from bigdl_tpu.utils import telemetry
     from bigdl_tpu.utils.engine import Engine
 
     Engine.init()
+    # arm the span tracer per BIGDL_TPU_TRACE so the standalone server
+    # traces like a fleet worker (serve_worker.py arms its own rank)
+    tracer = telemetry.maybe_start()
     model, sample = build_model(args.model)
     kwargs = dict(example=sample, replicas=args.replicas,
                   max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -447,6 +500,8 @@ def main(argv=None):
         if engine is not None:
             engine.stop(drain=True)
         server.stop(drain=True)
+        if tracer is not None:
+            tracer.close()
     return 0
 
 

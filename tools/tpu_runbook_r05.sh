@@ -313,9 +313,14 @@ if [ "$SMOKE" = 1 ]; then
   # full recorded trace with zero accepted-request loss, respawned
   # generations must come back warm through the shared AOT cache (zero
   # fresh lowers), and a rolling deploy (canary on member 0, bounded
-  # max-unavailable) must land the release bit-exact on every member;
-  # one JSON line, exit-coded
-  echo "[runbook] 2q/4 fleet smoke (kill -9 + wedge + stale entry + rolling deploy)" >> "$LOG"
+  # max-unavailable) must land the release bit-exact on every member.
+  # The run arms BIGDL_TPU_TRACE (ISSUE 19): the merged trace's request
+  # flows must be non-empty, at least one flow must span the front AND
+  # a worker process end-to-end, and the kill -9 failover must show up
+  # as a two-member flow for at least one request; every member must
+  # answer GET /metrics with Prometheus text and the front's rollup
+  # must re-export the fleet; one JSON line, exit-coded
+  echo "[runbook] 2q/4 fleet smoke (kill -9 + wedge + stale entry + rolling deploy + request flows + /metrics)" >> "$LOG"
   timeout 420 python tools/fleet_smoke.py --platform cpu \
     > /tmp/fleet_smoke.json 2>/tmp/fleet_smoke.log
   FLEET_RC=$?

@@ -25,7 +25,15 @@ size after the most recent shrink or grow (parallel/elastic.py).
 Usage::
 
     python tools/trace_report.py <trace-dir> [--out merged.json] [--json]
+    python tools/trace_report.py <trace-dir> --requests [--slowest N]
     python tools/trace_report.py --diff <trace-dir-A> <trace-dir-B> [--json]
+
+``--requests`` reconstructs per-request critical paths from the flow
+events (``ph:"s"/"t"/"f"``, one chain per ``X-BigDL-Request-Id``) the
+serving tiers emit when traced: latency attributed by segment (queue
+vs device vs transport vs failover) at p50/p95/p99, plus the slowest-N
+requests' hop-by-hop timelines across front, worker, and controller
+ranks.
 
 ``--out`` writes the merged timeline (loadable in Perfetto as one file);
 ``--json`` prints the breakdown (or diff) as machine-readable JSON
@@ -80,6 +88,13 @@ def main(argv=None) -> int:
                     help="also write the merged single-timeline trace here")
     ap.add_argument("--json", action="store_true",
                     help="print the breakdown as JSON instead of the table")
+    ap.add_argument("--requests", action="store_true",
+                    help="per-request critical paths from the flow events: "
+                         "segment attribution (queue/device/transport/"
+                         "failover) p50/p95/p99 + slowest-N hop timelines")
+    ap.add_argument("--slowest", type=int, default=5,
+                    help="with --requests: how many slowest requests get "
+                         "a full hop timeline (default 5)")
     args = ap.parse_args(argv)
 
     from bigdl_tpu.utils import telemetry
@@ -87,6 +102,23 @@ def main(argv=None) -> int:
     breakdown, merged = _load_breakdown(telemetry, args.trace_dir)
     if breakdown is None:
         return 2
+
+    if args.requests:
+        rb = telemetry.request_breakdown(merged, slowest=args.slowest)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            print(f"merged trace -> {args.out}", file=sys.stderr)
+        if args.json:
+            print(json.dumps(rb))
+        else:
+            print(telemetry.format_requests(rb))
+        if not rb["requests"]:
+            print(f"trace_report: {args.trace_dir}: trace holds no "
+                  "request flows (run the serving tier with "
+                  "BIGDL_TPU_TRACE armed)", file=sys.stderr)
+            return 3
+        return 0
 
     if args.diff:
         breakdown_b, _ = _load_breakdown(telemetry, args.diff)
