@@ -569,6 +569,12 @@ def _bench_config(name, build, peak_flops):
             memory["layout"] = layout_env
         # per-stage param bytes for pipelined configs (GPipeSequential):
         # the pipe axis's 1/n-per-device claim, visible in the record
+        # per-table bytes for embedding-role params (LookupTable):
+        # recommender memory is table-dominated, and `device_fraction`
+        # shows the fsdp×tp 1/N row-sharding working per config
+        tables = memstats.embedding_table_bytes(model, box["params"])
+        if tables:
+            memory["embedding_tables"] = tables
         stages = memstats.pipeline_stage_bytes(model, box["params"])
         if stages:
             memory["pipeline_stages"] = stages
@@ -899,6 +905,40 @@ def _cfg_textcnn():
             jnp.ones((b,), jnp.int32), 0.05)
 
 
+def _cfg_widedeep():
+    """Wide-and-deep recommender over the recsys feature layout
+    (ISSUE 20): embedding-table-dominated memory, 1/N per device under a
+    BIGDL_TPU_BENCH_LAYOUT fsdp×tp layout (the `embedding_tables` block
+    in the memory record)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu.dataset import FeatureSpec, synthetic_criteo_records
+    from bigdl_tpu.models import WideDeep
+    from bigdl_tpu.nn import ClassNLLCriterion
+    b = 512
+    spec = FeatureSpec()
+    recs = list(synthetic_criteo_records(b, seed=1, spec=spec))
+    inp = jnp.asarray(np.stack([spec.featurize(r).feature for r in recs]))
+    tgt = jnp.asarray(np.array([r["label"] for r in recs], np.int32))
+    return (WideDeep.from_spec(spec, embed_dim=64, hidden=(256, 128)),
+            ClassNLLCriterion(), inp, tgt, 0.05)
+
+
+def _cfg_textclassifier():
+    """Token-id text classification end-to-end (ISSUE 20): a trained
+    LookupTable front (embedding_row, 1/N-sharded) feeding the textcnn
+    conv stack — ids in, classes out, the serving-side bucket ladder's
+    training counterpart."""
+    import jax.numpy as jnp
+    from bigdl_tpu.models.textclassifier import TextClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion
+    b, t, v = 128, 192, 40000
+    return (TextClassifier(20, embed_dim=128, seq_len=t, vocab_size=v),
+            ClassNLLCriterion(),
+            jnp.zeros((b, t), jnp.int32),
+            jnp.ones((b,), jnp.int32), 0.05)
+
+
 def _cfg_transformer_lm():
     """Net-new long-context workload (SURVEY.md §7): decoder-only LM in
     bf16 — flash-attention + matmul path on the MXU."""
@@ -978,6 +1018,8 @@ def _cfg_lstm():
 CONFIGS = {"resnet50_bf16": _cfg_resnet50_bf16, "resnet50": _cfg_resnet50,
            "inception_v1": _cfg_inception_v1,
            "textcnn": _cfg_textcnn, "lstm": _cfg_lstm,
+           "widedeep": _cfg_widedeep,
+           "textclassifier": _cfg_textclassifier,
            "transformer_lm": _cfg_transformer_lm,
            "transformer_lm_pipe": _cfg_transformer_lm_pipe,
            "transformer_moe": _cfg_transformer_moe,

@@ -33,6 +33,8 @@ from .prefetch import PrefetchIterator, ThreadedShardReader
 from .text import (SentenceSplitter, SentenceTokenizer, SentenceBiPadding,
                    Dictionary, LabeledSentence, TextToLabeledSentence,
                    LabeledSentenceToSample)
+from .recsys import (FeatureSpec, TabularToSample, hash_bucket, cross_bucket,
+                     synthetic_criteo_records, write_criteo_shards)
 
 __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "TransformedDataSet", "DataSet", "Sample", "MiniBatch",
@@ -41,7 +43,9 @@ __all__ = ["AbstractDataSet", "LocalArrayDataSet", "DistributedDataSet",
            "SentenceTokenizer", "SentenceBiPadding", "Dictionary",
            "LabeledSentence", "TextToLabeledSentence",
            "LabeledSentenceToSample", "StreamingRecordDataSet",
-           "PrefetchIterator", "ThreadedShardReader"]
+           "PrefetchIterator", "ThreadedShardReader", "FeatureSpec",
+           "TabularToSample", "hash_bucket", "cross_bucket",
+           "synthetic_criteo_records", "write_criteo_shards"]
 
 
 class AbstractDataSet:

@@ -90,6 +90,15 @@ class Dictionary:
     def vocab_size(self) -> int:
         return len(self._index2word)
 
+    def unk_index(self) -> int:
+        """The out-of-vocabulary index — PINNED contract: the UNK token is
+        always the LAST index (``vocab_size() - 1``), on construction and
+        across save/load round-trips.  Models size their LookupTable as
+        ``Dictionary.vocab_size()`` and training/serving both map unseen
+        words here, so this index moving would silently scramble
+        embeddings between a trained checkpoint and its server."""
+        return self._word2index.get(self.UNK, 0)
+
     def get_index(self, word: str) -> int:
         return self._word2index.get(word,
                                     self._word2index.get(self.UNK, 0))
@@ -107,18 +116,42 @@ class Dictionary:
         return np.array([self.get_index(t) for t in tokens], dtype=np.int32)
 
     # -- persistence (Dictionary.scala save: dictionary.txt + discard.txt) --
+    # JSON through utils/file_io (atomic local writes, fsspec/gcs remotes,
+    # retried remote IO) rather than bare open(): the vocabulary ships to
+    # every serving host alongside the checkpoint, over the same
+    # filesystems.
 
     def save(self, path: str) -> None:
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "dictionary.json"), "w") as f:
-            json.dump(self._index2word, f)
+        from ..utils import file_io
+        fs = file_io.get_filesystem(path)
+        fs.makedirs(path)
+        payload = {"format": "bigdl_tpu-dictionary-v1",
+                   "index2word": list(self._index2word)}
+        fs.write_bytes(os.path.join(path, "dictionary.json"),
+                       json.dumps(payload).encode("utf-8"))
 
     @classmethod
     def load(cls, path: str) -> "Dictionary":
+        from ..utils import file_io
+        fs = file_io.get_filesystem(path)
+        raw = json.loads(fs.read_bytes(
+            os.path.join(path, "dictionary.json")).decode("utf-8"))
+        if isinstance(raw, dict):
+            if raw.get("format") != "bigdl_tpu-dictionary-v1":
+                raise ValueError(
+                    f"{path!r}: unrecognized dictionary format "
+                    f"{raw.get('format')!r}")
+            words = raw["index2word"]
+        else:  # legacy pre-v1 files: a bare JSON list
+            words = raw
         d = cls()
-        with open(os.path.join(path, "dictionary.json")) as f:
-            d._index2word = json.load(f)
+        d._index2word = list(words)
         d._word2index = {w: i for i, w in enumerate(d._index2word)}
+        if d._index2word and d._index2word[-1] != cls.UNK:
+            raise ValueError(
+                f"{path!r}: dictionary breaks the pinned UNK contract "
+                f"(last index must be {cls.UNK!r}, got "
+                f"{d._index2word[-1]!r})")
         return d
 
 

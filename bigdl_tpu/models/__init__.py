@@ -15,6 +15,7 @@ from .transformer_lm import (PositionalEmbedding, TransformerBlock,
 from .treelstm_sentiment import TreeLSTMSentiment, encode_tree
 from .vgg import Vgg_16, Vgg_19, VggForCifar10
 from .vit import ViT
+from .widedeep import WideDeep
 
 __all__ = [
     "AlexNet", "Autoencoder", "Inception_Layer_v1", "Inception_Layer_v2",
@@ -24,5 +25,5 @@ __all__ = [
     "TextClassifier", "TransformerBlock", "TransformerLM",
     "TreeLSTMSentiment", "beam_generate", "cached_generate",
     "encode_tree", "init_kv_cache",
-    "Vgg_16", "Vgg_19", "VggForCifar10", "ViT",
+    "Vgg_16", "Vgg_19", "VggForCifar10", "ViT", "WideDeep",
 ]

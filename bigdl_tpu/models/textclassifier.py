@@ -16,8 +16,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..nn import (Linear, LogSoftMax, Max, ReLU, Reshape, Sequential,
-                  TemporalConvolution)
+from ..nn import (Linear, LogSoftMax, LookupTable, Max, ReLU, Reshape,
+                  Sequential, TemporalConvolution)
 from ..nn.module import Module
 
 __all__ = ["TextClassifier", "TemporalMaxPooling"]
@@ -44,8 +44,19 @@ class TemporalMaxPooling(Module):
 
 
 def TextClassifier(class_num: int, embed_dim: int = 200,
-                   seq_len: int = 500):
+                   seq_len: int = 500, vocab_size: int = None):
+    """`vocab_size=None` (default) keeps the reference pipeline: input is
+    (batch, seq_len, embed_dim) pre-embedded GloVe vectors.  With
+    `vocab_size` set, a trained `LookupTable` front is prepended and the
+    input becomes (batch, seq_len) token ids straight from the
+    dataset/text.py Dictionary chain — the embedding trains with the model
+    and, carrying the ``embedding_row`` role, shards 1/N over fsdp×tp like
+    every other table.  seq_len is advisory (the conv/pool stack needs
+    seq >= 149); serving pads each request onto a (batch, seq) bucket
+    ladder, see serve/server.py `seq_buckets`."""
     model = Sequential()
+    if vocab_size is not None:
+        model.add(LookupTable(vocab_size, embed_dim))
     model.add(TemporalConvolution(embed_dim, 128, 5))
     model.add(ReLU())
     model.add(TemporalMaxPooling(5, 5))

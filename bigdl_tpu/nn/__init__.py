@@ -29,7 +29,8 @@ from .normalization import (BatchNormalization, SpatialBatchNormalization,
                             SpatialSubtractiveNormalization,
                             SpatialDivisiveNormalization,
                             SpatialContrastiveNormalization)
-from .dropout import Dropout, LookupTable, GradientReversal
+from .dropout import Dropout, GradientReversal
+from .embedding import LookupTable
 from .shape import (Reshape, InferReshape, View, Transpose, Replicate, Squeeze,
                     Unsqueeze, Select, Narrow, Index, MaskedSelect, Reverse,
                     Padding, SpatialZeroPadding, Contiguous)

@@ -1,12 +1,14 @@
-"""Dropout and embedding layers.
+"""Dropout and gradient-trick layers.
 
 Reference: BigDL `nn/Dropout.scala` (inverted-scaling dropout over a bernoulli
-mask), `nn/LookupTable.scala` (embedding with optional max-norm renorm),
-`nn/GradientReversal.scala`.
+mask), `nn/GradientReversal.scala`.
 
 TPU-native notes: the bernoulli mask comes from the explicit PRNG key threaded
 through `apply` — deterministic under jit and independent of device count.
-LookupTable is a gather (one-hot matmul is left to XLA's discretion).
+
+`LookupTable` moved to nn/embedding.py (PR 20); the re-export below keeps
+`bigdl_tpu.nn.dropout.LookupTable` imports and bigdl-format save/load (keyed
+by class name) working unchanged.
 """
 
 from __future__ import annotations
@@ -14,8 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..common import get_policy
 from .module import Module
+from .embedding import LookupTable  # noqa: F401  (re-export, see docstring)
 
 __all__ = ["Dropout", "LookupTable", "GradientReversal"]
 
@@ -45,46 +47,6 @@ class Dropout(Module):
         if self.scale:
             y = y / keep
         return y.astype(x.dtype), state
-
-
-class LookupTable(Module):
-    """Embedding lookup (nn/LookupTable.scala): indices -> rows of a
-    (n_index, n_output) weight.  Indices are 0-based (reference is 1-based Torch;
-    pass `one_based=True` for parity with reference data)."""
-
-    #: rows shard over fsdp x tp (the wide-embedding role, SNIPPETS.md [2])
-    PARAM_ROLES = {"weight": "embedding_row"}
-
-    def __init__(self, n_index: int, n_output: int, padding_value: float = None,
-                 max_norm: float = None, norm_type: float = 2.0,
-                 should_scale_grad_by_freq: bool = False, one_based: bool = False,
-                 w_regularizer=None):
-        super().__init__()
-        self.n_index, self.n_output = n_index, n_output
-        self.padding_value = padding_value
-        self.max_norm = max_norm
-        self.norm_type = norm_type
-        self.one_based = one_based
-        self.w_regularizer = w_regularizer
-
-    def _init(self, rng):
-        w = jax.random.normal(rng, (self.n_index, self.n_output),
-                              get_policy().param_dtype)
-        if self.padding_value is not None:
-            pad_idx = int(self.padding_value) - (1 if self.one_based else 0)
-            if 0 <= pad_idx < self.n_index:
-                w = w.at[pad_idx].set(0.0)
-        return {"weight": w}
-
-    def _apply(self, params, idx):
-        w = params["weight"]
-        if self.max_norm is not None:
-            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
-            w = jnp.where(norms > self.max_norm, w * (self.max_norm / norms), w)
-        i = idx.astype(jnp.int32)
-        if self.one_based:
-            i = i - 1
-        return jnp.take(w, i, axis=0)
 
 
 class GradientReversal(Module):

@@ -1,0 +1,67 @@
+"""Embedding layers.
+
+Reference: BigDL `nn/LookupTable.scala` (embedding with optional max-norm
+renorm).  Lived in nn/dropout.py through PR 19; moved here because the
+recommendation workload (models/widedeep.py) makes embeddings a
+first-class model family rather than a dropout-file tenant.  nn/dropout
+keeps a re-export, so existing imports AND bigdl-format save/load (keyed
+by class NAME, interop/bigdl.py) are unchanged.
+
+TPU-native notes: LookupTable is a gather (one-hot matmul is left to
+XLA's discretion).  The weight carries the ``embedding_row`` role
+(parallel/layout.ROLES), so under a MeshLayout the vocab axis shards
+jointly over fsdp x tp (and expert where it divides) — each device holds
+exactly 1/N of the table and the forward lowers to a local gather, never
+a full-table materialization (tools/perf_gate.py `embed.*` rows pin
+this).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common import get_policy
+from .module import Module
+
+__all__ = ["LookupTable"]
+
+
+class LookupTable(Module):
+    """Embedding lookup (nn/LookupTable.scala): indices -> rows of a
+    (n_index, n_output) weight.  Indices are 0-based (reference is 1-based Torch;
+    pass `one_based=True` for parity with reference data)."""
+
+    #: rows shard over fsdp x tp (the wide-embedding role, SNIPPETS.md [2])
+    PARAM_ROLES = {"weight": "embedding_row"}
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = None,
+                 max_norm: float = None, norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, one_based: bool = False,
+                 w_regularizer=None):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.one_based = one_based
+        self.w_regularizer = w_regularizer
+
+    def _init(self, rng):
+        w = jax.random.normal(rng, (self.n_index, self.n_output),
+                              get_policy().param_dtype)
+        if self.padding_value is not None:
+            pad_idx = int(self.padding_value) - (1 if self.one_based else 0)
+            if 0 <= pad_idx < self.n_index:
+                w = w.at[pad_idx].set(0.0)
+        return {"weight": w}
+
+    def _apply(self, params, idx):
+        w = params["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = jnp.where(norms > self.max_norm, w * (self.max_norm / norms), w)
+        i = idx.astype(jnp.int32)
+        if self.one_based:
+            i = i - 1
+        return jnp.take(w, i, axis=0)

@@ -302,10 +302,16 @@ class MeshLayout:
                 parts[1] = TP_AXIS
             return P(*parts)
         if role == "embedding_row" and ndim >= 1:
-            # rows over fsdp x tp together; degrade to fsdp alone, then
-            # tp alone, when the vocab axis does not divide the product
+            # rows over fsdp x tp together — folding 'expert' in too when
+            # it exists and divides (a wide-embedding recommender under
+            # an expert layout has no reason to replicate tables across
+            # the expert axis); degrade to fsdp x tp, then fsdp alone,
+            # then tp alone, when the vocab axis does not divide
             if self.fsdp * self.tp > 1 and size >= min_size:
-                if shape[0] % (self.fsdp * self.tp) == 0:
+                if self.expert > 1 and \
+                        shape[0] % (self.fsdp * self.tp * self.expert) == 0:
+                    parts[0] = (FSDP_AXIS, TP_AXIS, EXPERT_AXIS)
+                elif shape[0] % (self.fsdp * self.tp) == 0:
                     parts[0] = (FSDP_AXIS, TP_AXIS)
                 elif shape[0] % self.fsdp == 0 and self.fsdp > 1:
                     parts[0] = FSDP_AXIS

@@ -47,8 +47,8 @@ See docs/serving.md.
 from .autoscale import AutoScaler
 from .batcher import (DecodeQueue, DynamicBatcher, PendingRequest,
                       RequestTimeout, ServeError, ServerClosed,
-                      ServerOverloaded, default_buckets, pad_rows,
-                      predict_in_fixed_batches)
+                      ServerOverloaded, default_buckets, fit_bucket,
+                      pad_rows, pad_tail, predict_in_fixed_batches)
 from .decode import DecodeEngine, SlotFault, page_ladder
 from .continuous import (DeployController, ReleasePublisher,
                          ReleaseRejected, read_release)
@@ -67,7 +67,7 @@ __all__ = ["InferenceServer", "ModelVersion", "DynamicBatcher",
            "ServerClosed", "RequestTimeout", "ReplicaLostError",
            "CanaryRejected", "QuotaExceeded", "TenantQuotas",
            "CanaryController", "ReplicaMonitor", "default_buckets",
-           "pad_rows", "predict_in_fixed_batches",
+           "pad_rows", "pad_tail", "fit_bucket", "predict_in_fixed_batches",
            "AutoScaler", "TopologyRouter", "PlacementError",
            "plan_subsets", "TraceEvent", "TraceFormatError",
            "TraceRecorder", "read_trace", "write_trace", "replay",

@@ -54,8 +54,8 @@ from ..utils import chaos, metrics_export, telemetry
 
 __all__ = ["ServeError", "ServerOverloaded", "ServerClosed",
            "RequestTimeout", "PendingRequest", "DynamicBatcher",
-           "DecodeQueue", "default_buckets", "pad_rows",
-           "predict_in_fixed_batches"]
+           "DecodeQueue", "default_buckets", "fit_bucket", "pad_rows",
+           "pad_tail", "predict_in_fixed_batches"]
 
 
 class ServeError(RuntimeError):
@@ -176,6 +176,37 @@ def default_buckets(max_batch: int) -> tuple:
         b *= 2
     buckets.append(max_batch)
     return tuple(buckets)
+
+
+def fit_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= ``n`` from an ascending ladder, or None when
+    ``n`` overflows the largest bucket.  The sequence-length counterpart
+    of :meth:`DynamicBatcher.bucket_for` (which serves the batch axis and
+    clamps instead — a batch can split, a sequence cannot)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+def pad_tail(arr: np.ndarray, length: int) -> np.ndarray:
+    """Zero-pad ONLY the trailing axis up to ``length`` — the per-request
+    half of :func:`pad_rows`'s ``length=`` handling, used when requests
+    must land on a deterministic per-request sequence bucket BEFORE batch
+    assembly (so a request's answer never depends on its batch-mates'
+    lengths).  Refuses to truncate, like pad_rows."""
+    arr = np.asarray(arr)
+    if arr.ndim < 1:
+        raise ValueError("pad_tail: needs at least a 1-D array, got "
+                         f"ndim={arr.ndim}")
+    have = arr.shape[-1]
+    if have > length:
+        raise ValueError(f"pad_tail: trailing axis {have} exceeds "
+                         f"length={length} (refusing to truncate)")
+    if have == length:
+        return arr
+    pad = [(0, 0)] * (arr.ndim - 1) + [(0, length - have)]
+    return np.pad(arr, pad, mode="constant", constant_values=0)
 
 
 def pad_rows(arr: np.ndarray, n: int,

@@ -78,7 +78,7 @@ from ..utils import chaos, config, metrics_export, telemetry
 from ..utils.supervisor import StallError, Supervisor
 from . import control
 from .batcher import (DynamicBatcher, PendingRequest, ServeError,
-                      default_buckets, pad_rows)
+                      default_buckets, fit_bucket, pad_rows, pad_tail)
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -144,6 +144,7 @@ class InferenceServer:
                  replicas: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
                  example: Optional[np.ndarray] = None,
                  strategy=None,
                  supervisor: Optional[Supervisor] = None,
@@ -184,6 +185,15 @@ class InferenceServer:
         self.batcher = DynamicBatcher(self.max_batch, wait_ms / 1000.0,
                                       self.queue_limit, buckets=buckets,
                                       clock=clock)
+        # sequence-length ladder for variable-length workloads (None =
+        # fixed-shape samples, byte-identical behavior).  Requests pad
+        # their TRAILING axis to the smallest bucket that fits at batch
+        # assembly, so the device only ever sees (batch-bucket, seq-bucket)
+        # product shapes — all warmed up front — and a request's answer
+        # never depends on its batch-mates' lengths (bit-match with bulk
+        # Predictor at the same padded length).
+        self.seq_buckets = (tuple(sorted(int(b) for b in seq_buckets))
+                            if seq_buckets else None)
         self._example = None if example is None else np.asarray(example)
         self._version = ModelVersion(1, model, "initial", strategy,
                                      mesh=mesh)
@@ -370,6 +380,20 @@ class InferenceServer:
             # remember the sample shape so later swaps can warm up the
             # new version's batch shapes before taking traffic
             self._example = np.zeros_like(x)
+        elif self.seq_buckets is not None:
+            # variable-length admission: leading dims fixed, trailing axis
+            # may be any length that fits the sequence ladder
+            if x.ndim != self._example.ndim or \
+                    x.shape[:-1] != self._example.shape[:-1]:
+                raise ServeError(
+                    f"serve: sample shape {x.shape} does not match the "
+                    f"server's example shape {self._example.shape} "
+                    "(leading dims must agree under seq_buckets)")
+            if fit_bucket(x.shape[-1], self.seq_buckets) is None:
+                raise ServeError(
+                    f"serve: sample length {x.shape[-1]} exceeds the "
+                    f"largest sequence bucket {self.seq_buckets[-1]} "
+                    "(refusing to truncate)")
         elif x.shape != self._example.shape:
             # reject shape strays at admission: one odd sample must not
             # reach np.stack inside a coalesced batch, where the failure
@@ -662,10 +686,11 @@ class InferenceServer:
                 chan.close()
 
     def _execute(self, reqs, beat) -> None:
-        # one version snapshot per batch: a swap mid-batch cannot split
-        # the batch across versions (no misrouted requests).  Canary
-        # routing happens here — per BATCH, deterministic, bounded by the
-        # configured fraction (serve/control.CanaryController).
+        # one version snapshot per collect: a swap mid-batch cannot split
+        # the collected requests across versions (no misrouted requests).
+        # Canary routing happens here — per COLLECT, deterministic,
+        # bounded by the configured fraction
+        # (serve/control.CanaryController).
         with self._lock:
             version = self._version
             canary = self._canary
@@ -674,21 +699,43 @@ class InferenceServer:
                     and canary.route():
                 version = canary.version
                 is_canary = True
+        if self.seq_buckets is None:
+            groups = [(None, reqs)]
+        else:
+            # variable-length workloads: each request lands on the
+            # smallest sequence bucket that fits it, and each bucket is
+            # its own device batch — a request's padded length is a
+            # function of ITS length only, never its batch-mates'
+            by: dict = {}
+            for r in reqs:
+                by.setdefault(fit_bucket(r.payload.shape[-1],
+                                         self.seq_buckets), []).append(r)
+            groups = sorted(by.items())
+        for seq, group in groups:
+            self._run_batch(group, version, canary, is_canary, seq)
+        if beat is not None:
+            beat()
+
+    def _run_batch(self, reqs, version, canary, is_canary: bool,
+                   seq: Optional[int]) -> None:
         n = len(reqs)
         bucket = self.batcher.bucket_for(n)
+        seq_extra = {} if seq is None else {"seq": seq}
         t0 = self.batcher.clock()
         if telemetry.get_active() is not None:
             for r in reqs:
                 telemetry.flow_step(r.rid, hop="batch.assemble", size=n,
-                                    bucket=bucket)
+                                    bucket=bucket, **seq_extra)
         try:
             # batch assembly is inside the guard too: a stray payload that
             # defeats admission-time shape checks (or OOMs the stack) must
             # fail ITS batch typed, not kill the replica thread
-            batch = pad_rows(np.stack([r.payload for r in reqs]), bucket)
+            rows = ([r.payload for r in reqs] if seq is None
+                    else [pad_tail(r.payload, seq) for r in reqs])
+            batch = pad_rows(np.stack(rows), bucket)
             with telemetry.span("serve.batch", cat="serve", size=n,
                                 bucket=bucket, version=version.id,
-                                canary=is_canary):
+                                canary=is_canary, **seq_extra):
                 chaos.fire("serve.batch")
                 if is_canary:
                     # canary drill point: stall*S@c inflates exactly the
@@ -719,8 +766,6 @@ class InferenceServer:
         telemetry.counter("serve", queue_depth=self.batcher.depth(),
                           batch_fill=n / bucket)
         self._canary_observe(canary, is_canary, now - t0, False)
-        if beat is not None:
-            beat()
 
     def _canary_observe(self, canary, is_canary: bool, dur_s: float,
                         errored: bool) -> None:
@@ -779,7 +824,14 @@ class InferenceServer:
         with telemetry.span("serve.warmup", cat="serve",
                             version=version.id):
             for b in self.batcher.buckets:
-                version.predict(np.stack([ex] * b))
+                if self.seq_buckets is None:
+                    version.predict(np.stack([ex] * b))
+                    continue
+                # variable-length ladder: warm the full (batch x seq)
+                # product so steady state never sees a fresh shape
+                for length in self.seq_buckets:
+                    row = pad_tail(ex[..., :length], length)
+                    version.predict(np.stack([row] * b))
 
     # -- hot swap -------------------------------------------------------
 

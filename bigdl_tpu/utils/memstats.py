@@ -27,7 +27,7 @@ import jax
 
 __all__ = ["device_memory_stats", "live_device_bytes", "tree_device_bytes",
            "tree_total_bytes", "memory_record", "pipeline_stage_bytes",
-           "compiled_memory_analysis"]
+           "embedding_table_bytes", "compiled_memory_analysis"]
 
 
 def device_memory_stats(device=None) -> Optional[dict]:
@@ -114,6 +114,46 @@ def pipeline_stage_bytes(model, params, device=None):
         if kids is not None and isinstance(p, list) and len(kids) == len(p):
             for m, cp in zip(kids, p):
                 walk(m, cp)
+
+    walk(model, params)
+    return out or None
+
+
+def embedding_table_bytes(model, params, device=None):
+    """Per-table accounting for every module whose param_roles() place a
+    parameter under ``embedding_row`` (LookupTable and friends): logical
+    table bytes, bytes resident on one device, and the resident fraction
+    — exactly 1/N under an fsdp×tp=N row-sharded layout, 1.0 when
+    replicated.  Embedding tables dominate recommender memory (the
+    wide-and-deep workload's whole FSDP story), so bench.py reports this
+    block per config.  Walks the module tree parallel to the params
+    pytree (the Container/Graph list-alignment, like
+    pipeline_stage_bytes).  Returns a list of one dict per table, or
+    None when the model has no embedding-role parameters."""
+    dev = device or jax.devices()[0]
+    out = []
+
+    def walk(mod, p):
+        kids = getattr(mod, "modules", None)
+        if kids is not None and isinstance(p, list) and len(kids) == len(p):
+            for m, cp in zip(kids, p):
+                walk(m, cp)
+            return
+        roles = mod.param_roles() if hasattr(mod, "param_roles") else None
+        if not roles or not isinstance(p, dict):
+            return
+        for name, leaf in p.items():
+            role = roles.get(name, roles.get("*"))
+            if role != "embedding_row":
+                continue
+            total = tree_total_bytes(leaf)
+            per_dev = tree_device_bytes(leaf, dev)
+            out.append({"module": type(mod).__name__, "param": name,
+                        "rows": int(leaf.shape[0]) if leaf.ndim else 0,
+                        "table_bytes": total,
+                        "table_bytes_per_device": per_dev,
+                        "device_fraction": round(per_dev / total, 6)
+                        if total else 0.0})
 
     walk(model, params)
     return out or None

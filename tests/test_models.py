@@ -71,6 +71,22 @@ def test_textclassifier_shape():
     assert out.shape == (2, 20)
 
 
+def test_textclassifier_token_id_front():
+    """vocab_size set: a trained LookupTable front takes raw token ids
+    (batch, seq) instead of pre-embedded (batch, seq, dim) floats —
+    the end-to-end text workload's input contract, and the table the
+    embedding_row role shards 1/N."""
+    model = M.TextClassifier(5, embed_dim=32, seq_len=192, vocab_size=64)
+    model.build(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (3, 192)),
+                      jnp.int32)
+    out, _ = model.apply(model.params, model.state, ids)
+    assert out.shape == (3, 5)
+    front = model.modules[0]
+    assert isinstance(front, nn.LookupTable)
+    assert front.param_roles() == {"weight": "embedding_row"}
+
+
 def test_ptb_lstm_shape():
     model = M.PTBModel(500, 32, 32, num_layers=2)
     model.build(jax.random.key(0))

@@ -1,0 +1,162 @@
+"""Tabular recommendation pipeline tests (dataset/recsys): stable
+cross-process hashing, Criteo-style featurization layout, CorruptRecord
+semantics for schema strays, seeded download-free generation, and shard
+write / stream read with bounded quarantine — the recsys records ride
+the SAME DataSet -> Transformer -> quarantine chain as every other
+workload (the ISSUE-20 zero-workload-specific-pipeline claim)."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (DataSet, FeatureSpec, SampleToMiniBatch,
+                               TabularToSample, cross_bucket, hash_bucket,
+                               synthetic_criteo_records, write_criteo_shards)
+from bigdl_tpu.utils.recordio import CorruptRecord
+
+
+def _record(spec=None):
+    spec = spec or FeatureSpec()
+    return {"cats": [f"c{i}:v1" for i in range(spec.n_cat)],
+            "tags": ["t:v1", "t:v2"],
+            "dense": [float(i) for i in range(spec.n_dense)],
+            "label": 1}
+
+
+# ------------------------------------------------------------- hashing
+
+
+def test_hash_bucket_stable_across_processes():
+    """crc32-based, NOT hash(): the same value must land in the same
+    bucket on every host/run (rank shards and bit-match oracles
+    desynchronize otherwise)."""
+    assert hash_bucket("c0:v7", 100) == \
+        zlib.crc32("\x1fc0:v7".encode()) % 100
+    assert hash_bucket("c0:v7", 100, salt="col3") == \
+        zlib.crc32("col3\x1fc0:v7".encode()) % 100
+    for v in range(50):
+        assert 0 <= hash_bucket(f"v{v}", 17) < 17
+    # the salt actually separates columns
+    assert any(hash_bucket(f"v{v}", 1000, salt="a")
+               != hash_bucket(f"v{v}", 1000, salt="b") for v in range(20))
+
+
+def test_cross_bucket_order_sensitive():
+    assert cross_bucket(("x", "y"), 4096) != cross_bucket(("y", "x"), 4096)
+    assert 0 <= cross_bucket(("x", "y"), 64) < 64
+
+
+# -------------------------------------------------------- feature spec
+
+
+def test_feature_spec_layout():
+    spec = FeatureSpec()
+    assert spec.n_deep_slots == 12 and spec.n_wide == 7
+    assert spec.input_dim == 12 + 7 + 4
+    # every one-hot column owns a disjoint row range of the ONE shared
+    # deep table (so a single 1/N-sharded LookupTable serves them all)
+    for c in range(spec.n_cat):
+        rid = spec.deep_id(c, "some:value")
+        assert rid // spec.stride == c
+    assert spec.tag_id("t:v1") // spec.stride == spec.n_cat
+
+
+def test_feature_spec_validation():
+    with pytest.raises(ValueError):
+        FeatureSpec(n_cat=0)
+    with pytest.raises(ValueError):
+        FeatureSpec(cross_pairs=[(0, 99)])
+    with pytest.raises(ValueError):
+        FeatureSpec(n_cat=64, multihot_slots=1, deep_buckets=32)
+
+
+# --------------------------------------------------------- featurize
+
+
+def test_featurize_layout_and_determinism():
+    spec = FeatureSpec()
+    s1 = spec.featurize(_record(spec))
+    s2 = spec.featurize(_record(spec))
+    np.testing.assert_array_equal(s1.feature, s2.feature)
+    assert s1.feature.shape == (spec.input_dim,)
+    assert s1.feature.dtype == np.float32
+    assert s1.label.dtype == np.int32 and int(s1.label) == 1
+    # 2 tags fill 2 multihot slots; the rest pad with -1 (model masks)
+    slots = s1.feature[spec.n_cat:spec.n_deep_slots]
+    assert np.sum(slots >= 0) == 2 and np.sum(slots == -1.0) == 2
+    # dense floats are log1p-compressed
+    np.testing.assert_allclose(
+        s1.feature[spec.n_deep_slots + spec.n_wide:],
+        np.log1p(np.arange(spec.n_dense, dtype=np.float64)), rtol=1e-6)
+
+
+def test_featurize_schema_strays_raise_corrupt_record():
+    spec = FeatureSpec()
+    bad_missing = _record(spec)
+    del bad_missing["cats"]
+    bad_arity = _record(spec)
+    bad_arity["dense"] = bad_arity["dense"][:-1]
+    bad_value = _record(spec)
+    bad_value["dense"] = ["not-a-number"] * spec.n_dense
+    for bad in (bad_missing, bad_arity, bad_value, "not a dict", None):
+        with pytest.raises(CorruptRecord):
+            spec.featurize(bad)
+
+
+# ---------------------------------------------------------- generator
+
+
+def test_generator_seeded_and_learnable_labels():
+    a = list(synthetic_criteo_records(64, seed=7))
+    b = list(synthetic_criteo_records(64, seed=7))
+    assert a == b  # byte-identical stream per seed, no download
+    labels = [r["label"] for r in a]
+    assert 0 < sum(labels) < len(labels)  # both classes present
+    assert list(synthetic_criteo_records(8, seed=8)) != a[:8]
+
+
+# ------------------------------------------- shards + streaming chain
+
+
+def test_shards_stream_through_generic_chain(tmp_path):
+    spec = FeatureSpec()
+    paths = write_criteo_shards(str(tmp_path / "criteo.bd"), 64, shards=4,
+                                seed=3, spec=spec)
+    assert len(paths) == 4
+    ds = DataSet.record_stream(sorted(paths)).transform(
+        TabularToSample(spec) >> SampleToMiniBatch(16, drop_last=True))
+    batches = list(ds.data(train=False))
+    assert len(batches) == 4
+    for mb in batches:
+        assert mb.input.shape == (16, spec.input_dim)
+        assert mb.target.shape in ((16,), (16, 1))  # gather_rows keeps
+        # scalar labels as one trailing unit axis (same as the LeNet e2e
+        # chain; ClassNLLCriterion squeezes it)
+    # byte-identical to in-memory featurization of the same seed
+    # (write_records round-robins over shards, so compare as a SET of
+    # feature rows, order-free)
+    got = sorted(tuple(map(float, row)) for mb in batches
+                 for row in np.asarray(mb.input))
+    want = sorted(tuple(map(float, spec.featurize(r).feature)) for r in
+                  synthetic_criteo_records(64, seed=3, spec=spec))
+    assert got == want
+
+
+def test_corrupt_shard_quarantined_under_budget(tmp_path):
+    """On-disk rot in a recsys shard rides the SAME CRC/quarantine chain
+    as every other record stream: skipped under budget, loud without."""
+    from bigdl_tpu.dataset import StreamingRecordDataSet
+
+    spec = FeatureSpec()
+    [p] = write_criteo_shards(str(tmp_path / "c.bd"), 20, shards=1,
+                              seed=1, spec=spec)
+    data = bytearray(open(p, "rb").read())
+    data[len(data) // 2] ^= 0xFF  # mid-payload bit flip
+    open(p, "wb").write(bytes(data))
+    ds = StreamingRecordDataSet([p], skip_budget=2)
+    out = [spec.featurize(r) for r in ds.data(train=False)]
+    assert ds.last_quarantined >= 1
+    assert len(out) + ds.last_quarantined == 20
+    with pytest.raises(CorruptRecord):
+        list(StreamingRecordDataSet([p]).data(train=False))

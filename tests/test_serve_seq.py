@@ -1,0 +1,148 @@
+"""Variable-length (bucketed-sequence) serving tests.
+
+The ISSUE-20 text-serving contract: token requests of ANY length that
+fits the ladder coalesce onto a fixed (batch-bucket, seq-bucket) shape
+grid — one device batch per seq bucket per collect — bit-identical to a
+bulk Predictor fed the same padded rows; oversized or rank-stray
+samples are rejected TYPED at admission (never truncated); deadlines
+and tenant quotas behave exactly as on fixed-shape workloads (the
+zero-workload-specific-serving claim)."""
+
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import Engine
+from bigdl_tpu.optim import Predictor
+from bigdl_tpu.serve import (InferenceServer, QuotaExceeded, RequestTimeout,
+                             ServeError, fit_bucket, pad_tail)
+
+LADDER = (4, 8, 16)
+VOCAB, DIM = 32, 4
+
+
+def _token_model(seed=0):
+    return nn.Sequential().add(nn.LookupTable(VOCAB, DIM)).build(
+        jax.random.key(seed))
+
+
+def _tokens(length, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, VOCAB, size=(length,)).astype(np.int32)
+
+
+# ----------------------------------------------------- ladder helpers
+
+
+def test_fit_bucket_ladder():
+    assert fit_bucket(1, LADDER) == 4
+    assert fit_bucket(4, LADDER) == 4
+    assert fit_bucket(5, LADDER) == 8
+    assert fit_bucket(16, LADDER) == 16
+    assert fit_bucket(17, LADDER) is None  # overflow: no silent clamp
+
+
+def test_pad_tail_trailing_axis_only():
+    x = np.arange(6, dtype=np.int32).reshape(2, 3)
+    p = pad_tail(x, 5)
+    assert p.shape == (2, 5)
+    np.testing.assert_array_equal(p[:, :3], x)
+    np.testing.assert_array_equal(p[:, 3:], 0)
+    assert pad_tail(x, 3) is x  # exact fit untouched
+    with pytest.raises(ValueError):
+        pad_tail(x, 2)  # refuses to truncate
+    with pytest.raises(ValueError):
+        pad_tail(np.int32(3), 2)  # scalars have no trailing axis
+
+
+# ------------------------------------------------------- acceptance
+
+
+def test_variable_lengths_coalesce_bit_identical():
+    """Six requests at five distinct lengths land on exactly three
+    (batch, seq) grid points — one device batch per seq bucket — and
+    every answer bit-matches bulk Predictor fed the same padded row."""
+    Engine.init()
+    model = _token_model()
+    lengths = [3, 4, 6, 7, 8, 12]
+    xs = [_tokens(n, seed=i) for i, n in enumerate(lengths)]
+    server = InferenceServer(model, max_batch=8, max_wait_ms=10,
+                             queue_limit=32, seq_buckets=LADDER,
+                             example=np.zeros((4,), np.int32))
+    # queued before start -> one collect sees all six
+    handles = [server.submit(x) for x in xs]
+    server.start()
+    outs = [h.result(30) for h in handles]
+    stats = server.stats()
+    assert stats["batches"] == 3, stats  # one per distinct seq bucket
+    assert stats["batch_rows"] == len(lengths)
+    for x, out in zip(xs, outs):
+        seq = fit_bucket(len(x), LADDER)
+        ref = Predictor(model).predict(pad_tail(x, seq)[None, :])[0]
+        assert out.shape == (seq, DIM)
+        np.testing.assert_array_equal(out, ref)
+
+    # hot swap keeps the ladder: the new version is warmed per seq
+    # bucket and answers with ITS numbers
+    model_b = _token_model(seed=9)
+    server.swap(model_b)
+    x = _tokens(6, seed=99)
+    out = server.submit(x).result(30)
+    ref = Predictor(model_b).predict(pad_tail(x, 8)[None, :])[0]
+    np.testing.assert_array_equal(out, ref)
+    server.stop()
+    assert server.stats()["shed_overload"] == 0
+    assert server.stats()["shed_timeout"] == 0
+
+
+# ----------------------------------------------------- typed rejects
+
+
+def test_overflow_and_rank_strays_rejected_at_admission():
+    Engine.init()
+    with InferenceServer(_token_model(), max_wait_ms=2, seq_buckets=LADDER,
+                         example=np.zeros((4,), np.int32)) as server:
+        with pytest.raises(ServeError):
+            server.submit(np.zeros((LADDER[-1] + 1,), np.int32))
+        with pytest.raises(ServeError):
+            server.submit(np.zeros((2, 4), np.int32))  # rank stray
+        # the server keeps serving well-shaped variable-length traffic
+        assert server.predict(_tokens(5), timeout=30).shape == (8, DIM)
+
+
+def test_expired_deadline_sheds_before_device():
+    """Deadline shedding is workload-agnostic: expired token requests
+    die typed at dequeue and never reach a (batch, seq) grid point."""
+    Engine.init()
+    server = InferenceServer(_token_model(), max_batch=4, queue_limit=8,
+                             max_wait_ms=2, seq_buckets=LADDER,
+                             example=np.zeros((4,), np.int32))
+    late = [server.submit(_tokens(n, seed=n), deadline_ms=1)
+            for n in (3, 6, 12)]
+    fresh = server.submit(_tokens(6, seed=0))
+    time.sleep(0.05)
+    server.start()
+    for h in late:
+        with pytest.raises(RequestTimeout):
+            h.result(30)
+    assert fresh.result(30).shape == (8, DIM)
+    stats = server.stats()
+    assert stats["shed_timeout"] == 3
+    assert stats["batch_rows"] == 1
+    server.stop()
+
+
+def test_tenant_quota_on_token_workload():
+    Engine.init()
+    with InferenceServer(_token_model(), max_wait_ms=2, seq_buckets=LADDER,
+                         example=np.zeros((4,), np.int32),
+                         tenant_qps=0.001, tenant_burst=1.0) as server:
+        ok = server.submit(_tokens(6, seed=1), tenant="t0")
+        with pytest.raises(QuotaExceeded):
+            server.submit(_tokens(3, seed=2), tenant="t0")
+        other = server.submit(_tokens(3, seed=3), tenant="t1")
+        assert ok.result(30).shape == (8, DIM)
+        assert other.result(30).shape == (4, DIM)

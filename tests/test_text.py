@@ -2,6 +2,7 @@
 models/rnn/Train.scala:49-96 pipeline)."""
 
 import numpy as np
+import pytest
 
 from bigdl_tpu.dataset import (Dictionary, LabeledSentenceToSample,
                                SentenceBiPadding, SentenceSplitter,
@@ -43,6 +44,56 @@ def test_dictionary_save_load(tmp_path):
     d2 = Dictionary.load(str(tmp_path))
     assert d2.word2index() == d.word2index()
     assert d2.index2word() == d.index2word()
+
+
+def test_dictionary_unk_pinned_last():
+    """PINNED contract: UNK is always the LAST index — models size their
+    LookupTable as vocab_size() and a moving UNK would scramble
+    embeddings between a trained checkpoint and its server."""
+    d = Dictionary([["a", "a", "b", "c"]], vocab_size=2)
+    assert d.unk_index() == d.vocab_size() - 1
+    assert d.get_word(d.unk_index()) == Dictionary.UNK
+    assert d.get_index("never-seen") == d.unk_index()
+
+
+def test_dictionary_versioned_payload_and_unk_contract(tmp_path):
+    """save() writes a versioned JSON payload through file_io; load()
+    rejects unknown formats and UNK-contract violations loud."""
+    import json
+    import os
+
+    d = Dictionary([["a", "b", "a"]])
+    d.save(str(tmp_path))
+    raw = json.load(open(os.path.join(str(tmp_path), "dictionary.json")))
+    assert raw["format"] == "bigdl_tpu-dictionary-v1"
+    assert raw["index2word"][-1] == Dictionary.UNK
+    d2 = Dictionary.load(str(tmp_path))
+    assert d2.unk_index() == d.unk_index() == d.vocab_size() - 1
+
+    bad = dict(raw, format="somebody-elses-v9")
+    open(os.path.join(str(tmp_path), "dictionary.json"), "w").write(
+        json.dumps(bad))
+    with pytest.raises(ValueError):
+        Dictionary.load(str(tmp_path))
+
+    nounk = dict(raw, index2word=["a", "b"])  # UNK not last: refuse
+    open(os.path.join(str(tmp_path), "dictionary.json"), "w").write(
+        json.dumps(nounk))
+    with pytest.raises(ValueError):
+        Dictionary.load(str(tmp_path))
+
+
+def test_dictionary_legacy_bare_list_loads(tmp_path):
+    """Pre-v1 files were a bare JSON list — they still load, under the
+    same UNK-last check."""
+    import json
+    import os
+
+    open(os.path.join(str(tmp_path), "dictionary.json"), "w").write(
+        json.dumps(["x", "y", Dictionary.UNK]))
+    d = Dictionary.load(str(tmp_path))
+    assert d.index2word() == ["x", "y", Dictionary.UNK]
+    assert d.get_index("x") == 0 and d.unk_index() == 2
 
 
 def test_text_to_labeled_sentence():

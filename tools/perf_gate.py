@@ -507,6 +507,46 @@ def measure(batch_size=64):
     measured["moe.all_to_all"] = ep_card.get("ops", {}).get("all-to-all", 0)
     context["expert"]["ep_collectives"] = ep_card.get("collectives")
 
+    # ---- proxy 6: sharded embedding gather (nn/embedding.LookupTable)
+    # the recommender memory story (ISSUE 20): an embedding_row table
+    # under fsdp×tp must lower to GATHER ops with the table resident at
+    # 1/N per device and ZERO full-table all-gathers on the forward — an
+    # all-gather here would silently rebuild the whole table per device
+    # and void the 1/N residency the workload shards for
+    import bigdl_tpu.nn as nn_mod
+    from bigdl_tpu.parallel import LayoutSharding, MeshLayout
+    from bigdl_tpu.utils import memstats as _memstats
+    Engine.reset()
+    emb_layout = MeshLayout(1, 2, 2)
+    emb_mesh = emb_layout.install(jax.devices()[:emb_layout.size])
+    tbl = nn_mod.Sequential().add(
+        nn_mod.LookupTable(4096, 64)).build(jax.random.key(3))
+    emb_sh = LayoutSharding(tbl, min_size=0).param_sharding(emb_mesh,
+                                                            tbl.params)
+    emb_placed = jax.device_put(tbl.params, emb_sh)
+    emb_ids = jnp.asarray(np.random.default_rng(2).integers(
+        0, 4096, size=(32, 8)), jnp.float32)
+
+    def _emb_fwd(params, xs):
+        out, _ = tbl.apply(params, tbl.state, xs)
+        return out
+
+    lowered = jax.jit(_emb_fwd).lower(emb_placed, emb_ids)
+    compiled = lowered.compile()
+    emb_card = hlostats.compile_card(compiled, lowered, label="embed.fwd")
+    emb_ops = emb_card.get("ops", {})
+    measured["embed.gather_ops"] = sum(
+        v for k, v in emb_ops.items()
+        if "gather" in k and not k.startswith("all-"))
+    measured["embed.table_allgather"] = emb_ops.get("all-gather", 0)
+    measured["embed.table_fraction"] = _memstats.embedding_table_bytes(
+        tbl, emb_placed)[0]["device_fraction"]
+    context["embed"] = {"layout": "1,2,2",
+                        "ops_sample": {k: v for k, v in emb_ops.items()
+                                       if "gather" in k},
+                        "collectives": emb_card.get("collectives"),
+                        "total_ops": emb_card.get("total_ops")}
+
     # ---- proxy 8: router dispatch overhead (serve/router.py) ---------
     # the (bucket, depth) routing decision is pure host work in front of
     # EVERY request — bound its per-call cost over a 4-member pool so a

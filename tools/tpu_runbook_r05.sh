@@ -346,6 +346,24 @@ if [ "$SMOKE" = 1 ]; then
   else
     echo "[runbook] decode smoke FAILED rc=$DECODE_RC at $(date -u +%H:%M:%S)" >> "$LOG"
   fi
+
+  # 2s. workload smoke (ISSUE 20): BOTH non-LM workloads — wide-and-deep
+  # recsys (fsdp×tp-sharded embedding tables, data.record chaos + host
+  # loss mid-train) and bucketed-sequence text classification — through
+  # the UNMODIFIED train → publish → canary → promote → serve chain in
+  # one invocation; per-device table fractions must be exactly 1/N,
+  # served answers must BIT-match the bulk Predictor oracle under the
+  # same sharding, and both workloads must emit the same serve
+  # span/counter tracks; one JSON line, exit-coded
+  echo "[runbook] 2s/4 workload smoke (widedeep + textclassifier end-to-end, zero workload branches)" >> "$LOG"
+  timeout 420 python tools/workload_smoke.py --platform cpu \
+    > /tmp/workload_smoke.json 2>/tmp/workload_smoke.log
+  WORKLOAD_RC=$?
+  if [ "$WORKLOAD_RC" = 0 ]; then
+    echo "[runbook] workload smoke OK (1/N tables, bit-match both workloads, same trace tracks) at $(date -u +%H:%M:%S)" >> "$LOG"
+  else
+    echo "[runbook] workload smoke FAILED rc=$WORKLOAD_RC at $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
 fi
 
 echo "[runbook] 3/4 lenet cold-compile WITH pad (fresh cache)" >> "$LOG"
@@ -374,7 +392,7 @@ if [ "$SMOKE" != 1 ]; then
   cp -f /tmp/lenet_cold_pad.log /tmp/lenet_cold_nopad.log /root/repo/bench_artifacts_r05/ 2>/dev/null
   echo "[runbook] artifacts copied into repo at $(date -u +%H:%M:%S)" >> "$LOG"
 else
-  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, fleet_smoke.json, decode_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
+  echo "[runbook] smoke mode: artifacts left in /tmp (bench_r05_warm.json, bn_experiment_r05.log, supervise_smoke.json, input_bench.json, bench_data_micro.json, trace_report.txt, r05_trace/, serve_smoke.json, bench_serve.json, lenet_aot.json, fused_smoke.json, conv_route_ab.json, elastic_smoke.json, elastic_grow_smoke.json, fleet_smoke.json, decode_smoke.json, workload_smoke.json, resilience_smoke.json, perf_gate.json, scale_smoke.json, continuous_smoke.json, lenet_cold_*.log)" >> "$LOG"
   echo "smoke summary:"
   tail -n 20 "$LOG"
 fi
